@@ -1,0 +1,20 @@
+"""Test configuration: run everything on a virtual 8-device CPU mesh so
+multi-chip sharding paths compile and execute without TPU hardware (the
+driver's dryrun does the same; real-chip benchmarking lives in bench.py)."""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+import random
+
+import pytest
+
+
+@pytest.fixture
+def rng():
+    return random.Random(1234)

@@ -1,0 +1,129 @@
+"""TPU operator pipelines (reference ``tests/graph_tests_gpu``): device ops
+mixed with host ops in one graph, validated with the same metamorphic oracle.
+On the test backend these compile to CPU-XLA; the programs are identical to
+what runs on a TPU chip."""
+
+import random
+
+import jax.numpy as jnp
+import pytest
+
+import windflow_tpu as wf
+
+
+def stream(n_keys, length):
+    return [{"key": i % n_keys, "value": float(i)} for i in range(length)]
+
+
+class Acc:
+    def __init__(self):
+        self.total = 0.0
+        self.count = 0
+
+    def __call__(self, item):
+        if item is not None:
+            self.total += float(item["value"])
+            self.count += 1
+
+
+def run_tpu_linear(par, batch, length=1000, n_keys=7):
+    acc = Acc()
+    src = (wf.Source_Builder(lambda: iter(stream(n_keys, length)))
+           .withOutputBatchSize(batch).build())
+    m = (wf.MapTPU_Builder(
+            lambda t: {"key": t["key"], "value": t["value"] * 3.0})
+         .withParallelism(par[0]).build())
+    f = (wf.FilterTPU_Builder(lambda t: t["value"] % 2.0 == 0.0)
+         .withParallelism(par[1]).build())
+    snk = wf.Sink_Builder(acc).withParallelism(par[2]).build()
+    g = wf.PipeGraph("tpu_linear", wf.ExecutionMode.DEFAULT)
+    g.add_source(src).add(m).add(f).add_sink(snk)
+    g.run()
+    return acc
+
+
+def test_tpu_map_filter_metamorphic():
+    rnd = random.Random(11)
+    reference = None
+    for run in range(4):
+        par = [rnd.randint(1, 3) for _ in range(3)]
+        batch = rnd.choice([16, 64, 128])
+        acc = run_tpu_linear(par, batch)
+        if reference is None:
+            reference = (acc.total, acc.count)
+        else:
+            assert (acc.total, acc.count) == reference, \
+                f"run {run} diverged par={par} batch={batch}"
+    expected = sum(v * 3.0 for v in map(float, range(1000))
+                   if (v * 3.0) % 2.0 == 0.0)
+    assert reference == (expected, 500)
+
+
+def test_tpu_chain_fuses_to_one_program():
+    """chain() on TPU ops composes one XLA program (reference chaining is
+    thread fusion, multipipe.hpp:553-569)."""
+    acc = Acc()
+    src = (wf.Source_Builder(lambda: iter(stream(3, 300)))
+           .withOutputBatchSize(32).build())
+    m1 = wf.MapTPU_Builder(
+        lambda t: {"key": t["key"], "value": t["value"] + 1.0}).build()
+    m2 = wf.MapTPU_Builder(
+        lambda t: {"key": t["key"], "value": t["value"] * 2.0}).build()
+    f1 = wf.FilterTPU_Builder(lambda t: t["value"] > 100.0).build()
+    snk = wf.Sink_Builder(acc).build()
+    g = wf.PipeGraph("tpu_chain", wf.ExecutionMode.DEFAULT)
+    mp = g.add_source(src)
+    mp.chain(m1)
+    mp.chain(m2)
+    mp.chain(f1)
+    mp.add_sink(snk)
+    # the three TPU ops fused into one operator stage
+    assert len(mp.operators) == 3
+    g.run()
+    expected = [(v + 1) * 2 for v in range(300) if (v + 1) * 2 > 100]
+    assert acc.count == len(expected)
+    assert acc.total == sum(expected)
+
+
+def test_tpu_keyed_reduce():
+    """Keyed ReduceTPU shrinks each batch to one combined record per distinct
+    key (reference Reduce_GPU reduce_by_key semantics)."""
+    per_key = {}
+
+    def sink_fn(item):
+        if item is not None:
+            per_key[item["key"]] = per_key.get(item["key"], 0.0) + item["value"]
+
+    length, n_keys, batch = 640, 5, 64
+    src = (wf.Source_Builder(lambda: iter(stream(n_keys, length)))
+           .withOutputBatchSize(batch).build())
+    red = (wf.ReduceTPU_Builder(
+            lambda a, b: {"key": a["key"], "value": a["value"] + b["value"]})
+           .withKeyBy(lambda t: t["key"]).build())
+    snk = wf.Sink_Builder(sink_fn).build()
+    g = wf.PipeGraph("tpu_reduce", wf.ExecutionMode.DEFAULT)
+    g.add_source(src).add(red).add_sink(snk)
+    g.run()
+    expected = {}
+    for t in stream(n_keys, length):
+        expected[t["key"]] = expected.get(t["key"], 0.0) + t["value"]
+    assert per_key == expected
+
+
+def test_tpu_rejects_non_default_mode():
+    src = wf.Source_Builder(lambda: iter(stream(2, 10))) \
+        .withOutputBatchSize(4).build()
+    m = wf.MapTPU_Builder(lambda t: t).build()
+    snk = wf.Sink_Builder(lambda t: None).build()
+    g = wf.PipeGraph("bad", wf.ExecutionMode.DETERMINISTIC)
+    g.add_source(src).add(m).add_sink(snk)
+    with pytest.raises(wf.WindFlowError):
+        g.run()
+
+
+def test_tpu_requires_batching_upstream():
+    src = wf.Source_Builder(lambda: iter(stream(2, 10))).build()  # no batching
+    m = wf.MapTPU_Builder(lambda t: t).build()
+    g = wf.PipeGraph("bad2", wf.ExecutionMode.DEFAULT)
+    with pytest.raises(wf.WindFlowError):
+        g.add_source(src).add(m)

@@ -1,0 +1,180 @@
+"""Data plane: stream messages as batches.
+
+TPU-first re-design of the reference message layer
+(``/root/reference/wf/single_t.hpp``, ``batch_cpu_t.hpp``, ``batch_gpu_t.hpp``):
+
+* The reference's host-side unit is ``Single_t``/``Batch_CPU_t`` — a vector of
+  ``{tuple, ts}`` plus watermark slots.  Here :class:`HostBatch` plays that
+  role: a list of arbitrary Python records with parallel timestamp list and a
+  scalar watermark.
+
+* The reference's device unit is ``Batch_GPU_t`` — a device array of
+  ``batch_item_gpu_t{tuple, ts}`` with keyby support arrays and a per-batch
+  CUDA stream (``batch_gpu_t.hpp:51-229``).  Here :class:`DeviceBatch` holds a
+  **structure-of-arrays pytree** of JAX arrays (leading dim = static capacity),
+  an ``int64`` timestamp lane, and a validity mask.  Static capacity + mask is
+  the XLA answer to ragged batches: every compiled program sees one shape, so
+  it is traced and tiled once.  Asynchronous dispatch replaces CUDA streams —
+  JAX ops enqueue without blocking, so the host driver naturally keeps several
+  batches in flight (the reference's 2-deep double buffering,
+  ``forward_emitter_gpu.hpp:254-300``).
+
+Watermarks are host metadata: the reference embeds per-destination watermark
+slots in every message (``single_t.hpp:159-178``) because messages are shared
+pointers multicast across thread queues.  Here routing is done by a host
+driver that tracks watermarks per channel, so one scalar per batch suffices.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+TS_DTYPE = jnp.int64
+#: Watermark value meaning "no watermark yet".
+WM_NONE = -1
+#: Watermark value attached to the end-of-stream punctuation.
+WM_MAX = (1 << 62)
+
+
+@dataclasses.dataclass
+class Punctuation:
+    """Control message carrying only a watermark (reference: punctuation flag
+    on ``Single_t``/``Batch_t``, ``single_t.hpp:54``).  ``watermark == WM_MAX``
+    marks end-of-stream."""
+
+    watermark: int
+
+    @property
+    def is_eos(self) -> bool:
+        return self.watermark >= WM_MAX
+
+
+@dataclasses.dataclass
+class HostBatch:
+    """A batch of host-resident records (reference ``Batch_CPU_t``,
+    ``batch_cpu_t.hpp:51-205``).
+
+    ``items[i]`` is an arbitrary Python object; ``tss[i]`` its timestamp in
+    microseconds.  ``watermark`` is the minimum watermark folded over the
+    inputs that produced this batch (the reference folds min-watermark in
+    ``Batch_CPU_t::addTuple``)."""
+
+    items: list
+    tss: list
+    watermark: int = WM_NONE
+
+    def __len__(self) -> int:
+        return len(self.items)
+
+
+class DeviceBatch:
+    """A batch resident in TPU HBM (reference ``Batch_GPU_t``,
+    ``batch_gpu_t.hpp:51-229``) as a structure-of-arrays pytree.
+
+    Attributes
+    ----------
+    payload : pytree of jnp arrays, each with leading dimension ``capacity``.
+    ts      : int64 [capacity] timestamps (microseconds).
+    valid   : bool [capacity] mask; padding slots are False.  The reference
+              carries an exact ``size``; a mask keeps shapes static for XLA.
+    keys    : optional int32 [capacity] dense key-slot ids, attached by the
+              keyby boundary (reference: ``dist_keys_cpu`` + per-key index
+              chains built by ``keyby_emitter_gpu.hpp:519-583``; here key
+              grouping is done with XLA sorts/segment ops at use sites).
+    watermark, size : host-side metadata.
+    """
+
+    __slots__ = ("payload", "ts", "valid", "keys", "watermark", "_size")
+
+    def __init__(self, payload, ts, valid, keys=None, watermark: int = WM_NONE,
+                 size: Optional[int] = None):
+        self.payload = payload
+        self.ts = ts
+        self.valid = valid
+        self.keys = keys
+        self.watermark = watermark
+        self._size = size
+
+    @property
+    def size(self) -> int:
+        """Number of valid items.  Lazily counted: reading it after a filter
+        forces a device sync, so hot paths use :attr:`known_size` instead."""
+        if self._size is None:
+            self._size = int(self.valid.sum())
+        return self._size
+
+    @property
+    def known_size(self) -> Optional[int]:
+        return self._size
+
+    @property
+    def capacity(self) -> int:
+        return int(self.valid.shape[0])
+
+    def __len__(self) -> int:
+        return self.size
+
+
+# ---------------------------------------------------------------------------
+# Host <-> device conversion (the reference's pinned-staging H2D/D2H path,
+# forward_emitter_gpu.hpp:254-300 and Batch_GPU_t::transfer2CPU).
+# ---------------------------------------------------------------------------
+
+def _stack_records(items: Sequence[Any]):
+    """Convert a list of per-tuple pytrees (scalars, tuples, dicts, ...) into
+    one structure-of-arrays pytree of numpy arrays."""
+    treedef = jax.tree.structure(items[0])
+    leaves = [jax.tree.leaves(it) for it in items]
+    cols = [np.asarray(col) for col in zip(*leaves)]
+    return jax.tree.unflatten(treedef, cols)
+
+
+def _pad_leading(arr: np.ndarray, capacity: int) -> np.ndarray:
+    n = arr.shape[0]
+    if n == capacity:
+        return arr
+    pad = [(0, capacity - n)] + [(0, 0)] * (arr.ndim - 1)
+    return np.pad(arr, pad)
+
+
+def host_to_device(batch: HostBatch, capacity: Optional[int] = None,
+                   device=None) -> DeviceBatch:
+    """Stage a HostBatch into device buffers, padding to ``capacity``."""
+    n = len(batch)
+    if n == 0:
+        raise ValueError("cannot stage an empty batch")
+    cap = capacity or n
+    if n > cap:
+        raise ValueError(f"batch of {n} items exceeds capacity {cap}")
+    soa = _stack_records(batch.items)
+    payload = jax.tree.map(lambda a: jnp.asarray(_pad_leading(a, cap)), soa)
+    ts = jnp.asarray(_pad_leading(np.asarray(batch.tss, dtype=np.int64), cap),
+                     dtype=TS_DTYPE)
+    valid = jnp.asarray(np.arange(cap) < n)
+    if device is not None:
+        payload = jax.device_put(payload, device)
+        ts = jax.device_put(ts, device)
+        valid = jax.device_put(valid, device)
+    return DeviceBatch(payload, ts, valid, watermark=batch.watermark, size=n)
+
+
+def device_to_host(batch: DeviceBatch) -> HostBatch:
+    """Transfer a DeviceBatch back to host records (reference
+    ``Batch_GPU_t::transfer2CPU``), dropping padding slots."""
+    valid = np.asarray(batch.valid)
+    idx = np.nonzero(valid)[0]
+    treedef = jax.tree.structure(batch.payload)
+    cols = [np.asarray(leaf)[idx] for leaf in jax.tree.leaves(batch.payload)]
+    tss = np.asarray(batch.ts)[idx]
+    items = [jax.tree.unflatten(treedef, [c[i] for c in cols])
+             for i in range(len(idx))]
+    # Unwrap 0-d numpy scalars for ergonomic host-side records.
+    items = [jax.tree.map(lambda v: v.item() if np.ndim(v) == 0 else v, it)
+             for it in items]
+    return HostBatch(items=items, tss=[int(t) for t in tss],
+                     watermark=batch.watermark)

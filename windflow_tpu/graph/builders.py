@@ -1,0 +1,193 @@
+"""Fluent operator builders (reference ``/root/reference/wf/builders.hpp:57-127``
+and the GPU variants in ``builders_gpu.hpp:54-673``).
+
+Method names keep the reference's camelCase (``withParallelism``,
+``withKeyBy``, ``withOutputBatchSize``) so a WindFlow user can transliterate
+their program; TPU builders mirror the ``*GPU_Builder`` family.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+from windflow_tpu.basic import RoutingMode, WindFlowError
+from windflow_tpu.ops.filter_op import Filter
+from windflow_tpu.ops.flatmap_op import FlatMap
+from windflow_tpu.ops.map_op import Map
+from windflow_tpu.ops.reduce_op import Reduce
+from windflow_tpu.ops.sink import Sink
+from windflow_tpu.ops.source import Source
+from windflow_tpu.ops.tpu import FilterTPU, MapTPU, ReduceTPU
+
+
+class _BuilderBase:
+    _default_name = "op"
+
+    def __init__(self) -> None:
+        self._name = self._default_name
+        self._parallelism = 1
+        self._output_batch_size = 0
+        self._key_extractor: Optional[Callable] = None
+
+    def withName(self, name: str):
+        self._name = name
+        return self
+
+    def withParallelism(self, parallelism: int):
+        self._parallelism = parallelism
+        return self
+
+    def withOutputBatchSize(self, size: int):
+        self._output_batch_size = size
+        return self
+
+    def withKeyBy(self, key_extractor: Callable[[Any], Any]):
+        self._key_extractor = key_extractor
+        return self
+
+    def _routing(self) -> RoutingMode:
+        return (RoutingMode.KEYBY if self._key_extractor is not None
+                else RoutingMode.FORWARD)
+
+
+class Source_Builder(_BuilderBase):
+    _default_name = "source"
+
+    def __init__(self, gen_fn: Callable) -> None:
+        super().__init__()
+        self._gen_fn = gen_fn
+        self._ts_extractor = None
+
+    def withTimestampExtractor(self, fn: Callable[[Any], int]):
+        """EVENT-time sources: extract the event timestamp (µs) from each
+        generated item (reference: ``Source_Shipper::pushWithTimestamp``)."""
+        self._ts_extractor = fn
+        return self
+
+    def withKeyBy(self, *_):
+        raise WindFlowError("a Source has no input to key by")
+
+    def build(self) -> Source:
+        return Source(self._gen_fn, name=self._name,
+                      parallelism=self._parallelism,
+                      output_batch_size=self._output_batch_size,
+                      ts_extractor=self._ts_extractor)
+
+
+class Map_Builder(_BuilderBase):
+    _default_name = "map"
+
+    def __init__(self, fn: Callable) -> None:
+        super().__init__()
+        self._fn = fn
+
+    def build(self) -> Map:
+        return Map(self._fn, name=self._name, parallelism=self._parallelism,
+                   routing=self._routing(),
+                   output_batch_size=self._output_batch_size,
+                   key_extractor=self._key_extractor)
+
+
+class Filter_Builder(_BuilderBase):
+    _default_name = "filter"
+
+    def __init__(self, fn: Callable) -> None:
+        super().__init__()
+        self._fn = fn
+
+    def build(self) -> Filter:
+        return Filter(self._fn, name=self._name,
+                      parallelism=self._parallelism,
+                      routing=self._routing(),
+                      output_batch_size=self._output_batch_size,
+                      key_extractor=self._key_extractor)
+
+
+class FlatMap_Builder(_BuilderBase):
+    _default_name = "flatmap"
+
+    def __init__(self, fn: Callable) -> None:
+        super().__init__()
+        self._fn = fn
+
+    def build(self) -> FlatMap:
+        return FlatMap(self._fn, name=self._name,
+                       parallelism=self._parallelism,
+                       routing=self._routing(),
+                       output_batch_size=self._output_batch_size,
+                       key_extractor=self._key_extractor)
+
+
+class Reduce_Builder(_BuilderBase):
+    _default_name = "reduce"
+
+    def __init__(self, fn: Callable, initial_state: Any) -> None:
+        super().__init__()
+        self._fn = fn
+        self._initial_state = initial_state
+
+    def build(self) -> Reduce:
+        return Reduce(self._fn, self._initial_state, name=self._name,
+                      parallelism=self._parallelism,
+                      key_extractor=self._key_extractor,
+                      output_batch_size=self._output_batch_size)
+
+
+class Sink_Builder(_BuilderBase):
+    _default_name = "sink"
+
+    def __init__(self, fn: Callable) -> None:
+        super().__init__()
+        self._fn = fn
+
+    def build(self) -> Sink:
+        return Sink(self._fn, name=self._name, parallelism=self._parallelism,
+                    routing=self._routing(),
+                    key_extractor=self._key_extractor)
+
+
+# ---------------------------------------------------------------------------
+# TPU builders (reference MapGPU_Builder / FilterGPU_Builder /
+# ReduceGPU_Builder, builders_gpu.hpp:54-673)
+# ---------------------------------------------------------------------------
+
+class MapTPU_Builder(_BuilderBase):
+    _default_name = "map_tpu"
+
+    def __init__(self, fn: Callable, batch_fn: bool = False) -> None:
+        super().__init__()
+        self._fn = fn
+        self._batch_fn = batch_fn
+
+    def build(self) -> MapTPU:
+        return MapTPU(self._fn, name=self._name,
+                      parallelism=self._parallelism,
+                      batch_fn=self._batch_fn, routing=self._routing(),
+                      key_extractor=self._key_extractor)
+
+
+class FilterTPU_Builder(_BuilderBase):
+    _default_name = "filter_tpu"
+
+    def __init__(self, fn: Callable) -> None:
+        super().__init__()
+        self._fn = fn
+
+    def build(self) -> FilterTPU:
+        return FilterTPU(self._fn, name=self._name,
+                         parallelism=self._parallelism,
+                         routing=self._routing(),
+                         key_extractor=self._key_extractor)
+
+
+class ReduceTPU_Builder(_BuilderBase):
+    _default_name = "reduce_tpu"
+
+    def __init__(self, comb: Callable) -> None:
+        super().__init__()
+        self._comb = comb
+
+    def build(self) -> ReduceTPU:
+        return ReduceTPU(self._comb, name=self._name,
+                         parallelism=self._parallelism,
+                         key_extractor=self._key_extractor)

@@ -1,0 +1,3 @@
+"""Example streaming applications built on windflow_tpu (the reference ships
+these as test/benchmark programs; see models/wordcount.py and
+models/yahoo_bench.py)."""

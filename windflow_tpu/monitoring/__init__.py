@@ -1,0 +1,1 @@
+from windflow_tpu.monitoring.stats import StatsRecord

@@ -1,0 +1,51 @@
+"""Sink operator (reference ``/root/reference/wf/sink.hpp:56-``): terminal
+consumer.  The user function receives each tuple, and ``None`` once at
+end-of-stream (the reference passes an empty ``std::optional`` at EOS)."""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+from windflow_tpu.basic import RoutingMode
+from windflow_tpu.meta import adapt
+from windflow_tpu.ops.base import Operator, Replica
+
+
+class SinkReplica(Replica):
+    def __init__(self, op: "Sink", index: int) -> None:
+        super().__init__(op, index)
+        self._fn = adapt(op.fn, 1)
+
+    def process_single(self, item, ts, wm):
+        self._fn(item, self.context)
+
+    def process_device_batch(self, batch):
+        # A sink fed directly by a TPU operator pulls the batch to host
+        # (reference GPU→CPU boundary) and consumes per tuple.
+        from windflow_tpu.batch import device_to_host
+        hb = device_to_host(batch)
+        self.stats.d2h_bytes += sum(
+            getattr(l, "nbytes", 0) for l in _leaves(batch.payload))
+        for item, ts in zip(hb.items, hb.tss):
+            self.context._set_context(ts, batch.watermark)
+            self._fn(item, self.context)
+
+    def on_eos(self):
+        self._fn(None, self.context)
+
+
+def _leaves(tree):
+    import jax
+    return jax.tree.leaves(tree)
+
+
+class Sink(Operator):
+    replica_class = SinkReplica
+
+    def __init__(self, fn: Callable[[Optional[Any]], None], name: str = "sink",
+                 parallelism: int = 1,
+                 routing: RoutingMode = RoutingMode.FORWARD,
+                 key_extractor=None) -> None:
+        super().__init__(name, parallelism, routing=routing,
+                         key_extractor=key_extractor)
+        self.fn = fn

@@ -1,0 +1,91 @@
+"""Source operator (reference ``/root/reference/wf/source.hpp:55-309`` and the
+``Source_Shipper`` at ``source_shipper.hpp:59-``).
+
+The reference runs the user's generation function on a dedicated thread which
+pushes tuples through a ``Source_Shipper`` (timestamp + watermark assignment).
+Here a source replica is *pulled* by the host driver: the user supplies a
+generator function returning an iterable, and each scheduler tick pulls a
+bounded chunk so the pipeline stays balanced without threads.  Timestamping
+follows the reference policies: INGRESS assigns arrival time, EVENT uses a
+user timestamp extractor; watermarks are the monotone max of assigned
+timestamps (``source_shipper.hpp`` behavior).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Iterable, Optional
+
+from windflow_tpu.basic import RoutingMode, TimePolicy, WindFlowError, \
+    current_time_usecs
+from windflow_tpu.batch import WM_NONE
+from windflow_tpu.meta import adapt
+from windflow_tpu.ops.base import Operator, Replica
+
+
+class SourceReplica(Replica):
+    def __init__(self, op: "Source", index: int) -> None:
+        super().__init__(op, index)
+        self._iter = None
+        self._last_ts = WM_NONE
+        self._exhausted = False
+        # A source has no input channels; the driver calls tick().
+
+    def start(self) -> None:
+        gen = adapt(self.op.gen_fn, 0)
+        iterable = gen(self.context)
+        if iterable is None:
+            raise WindFlowError(
+                f"source '{self.op.name}' generator returned None")
+        self._iter = iter(iterable)
+
+    def tick(self, max_items: int) -> bool:
+        """Pull up to ``max_items`` tuples; returns False once exhausted."""
+        if self._exhausted:
+            return False
+        assert self._iter is not None, "source not started"
+        produced = 0
+        while produced < max_items:
+            try:
+                item = next(self._iter)
+            except StopIteration:
+                self._exhausted = True
+                self._terminate()
+                return False
+            ts = self._assign_ts(item)
+            self._advance_wm(ts)
+            self.stats.outputs_sent += 1
+            self.emitter.emit(item, ts, self.current_wm)
+            produced += 1
+        return True
+
+    def _assign_ts(self, item: Any) -> int:
+        if self.time_policy == TimePolicy.EVENT:
+            if self.op.ts_extractor is None:
+                raise WindFlowError(
+                    f"source '{self.op.name}': EVENT time policy requires a "
+                    "timestamp extractor (with_timestamp_extractor)")
+            ts = int(self.op.ts_extractor(item))
+        else:
+            ts = current_time_usecs()
+            # Keep timestamps monotone per replica even if the clock stalls
+            # within a microsecond.
+            if ts <= self._last_ts:
+                ts = self._last_ts + 1
+        self._last_ts = max(self._last_ts, ts)
+        return ts
+
+    @property
+    def exhausted(self) -> bool:
+        return self._exhausted
+
+
+class Source(Operator):
+    replica_class = SourceReplica
+
+    def __init__(self, gen_fn: Callable[..., Iterable], name: str = "source",
+                 parallelism: int = 1, output_batch_size: int = 0,
+                 ts_extractor: Optional[Callable[[Any], int]] = None) -> None:
+        super().__init__(name, parallelism, routing=RoutingMode.NONE,
+                         output_batch_size=output_batch_size)
+        self.gen_fn = gen_fn
+        self.ts_extractor = ts_extractor

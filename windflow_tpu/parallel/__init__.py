@@ -1,0 +1,16 @@
+"""Routing + distribution plane: emitters, collectors, and multi-chip sharding.
+
+This package is the TPU-native replacement for the reference's communication
+backend (SURVEY.md §5.8): lock-free thread queues + pointer multicast become a
+host driver moving batch handles between stages, and cross-chip distribution
+rides XLA collectives over ICI (``windflow_tpu.parallel.mesh``).
+"""
+
+from windflow_tpu.parallel.emitters import (
+    Emitter, ForwardEmitter, KeyByEmitter, BroadcastEmitter,
+    DeviceStageEmitter, create_emitter,
+)
+from windflow_tpu.parallel.collectors import (
+    Collector, WatermarkCollector, OrderingCollector, KSlackCollector,
+    create_collector,
+)

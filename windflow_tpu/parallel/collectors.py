@@ -1,0 +1,173 @@
+"""Collectors: per-replica input alignment for the three execution modes.
+
+Re-design of the reference collectors, which are FastFlow multi-input nodes
+prepended to each replica (``multipipe.hpp:199-232``):
+
+* DEFAULT        → :class:`WatermarkCollector` (``watermark_collector.hpp:50-140``)
+* DETERMINISTIC  → :class:`OrderingCollector`  (``ordering_collector.hpp:51-``)
+* PROBABILISTIC  → :class:`KSlackCollector`    (``kslack_collector.hpp:52-``)
+
+Here a collector is a plain object the replica consults when draining its
+inbox: it receives ``(channel, message)`` and returns the messages that are
+ready to process, with their watermark rewritten to the alignment frontier.
+Control stays on the host — exactly as in the reference, where collectors run
+on the replica's thread before the operator logic.
+"""
+
+from __future__ import annotations
+
+import heapq
+from typing import List
+
+from windflow_tpu.basic import ExecutionMode
+from windflow_tpu.batch import HostBatch, Punctuation, WM_NONE
+
+
+class Collector:
+    def __init__(self, num_channels: int) -> None:
+        self.num_channels = num_channels
+        self.num_dropped = 0
+
+    def on_message(self, channel: int, msg) -> List:
+        """Feed one inbound message; return messages ready for the operator."""
+        raise NotImplementedError
+
+    def on_channel_eos(self, channel: int) -> List:
+        """A channel reached end-of-stream; release anything it was holding."""
+        return []
+
+
+class WatermarkCollector(Collector):
+    """DEFAULT mode: track the max watermark per input channel and rewrite each
+    message's watermark to the min over channels that have been heard from
+    (reference ``watermark_collector.hpp:63-76,109-130``).  Data flows through
+    unchanged and unordered — out-of-order tolerance is downstream's job
+    (lateness gates on windows)."""
+
+    def __init__(self, num_channels: int) -> None:
+        super().__init__(num_channels)
+        self._wms = [WM_NONE] * num_channels
+        self._closed = [False] * num_channels
+
+    def _frontier(self) -> int:
+        seen = [w for w, c in zip(self._wms, self._closed)
+                if not c and w != WM_NONE]
+        if seen:
+            return min(seen)
+        return WM_NONE
+
+    def on_message(self, channel, msg):
+        wm = msg.watermark
+        if wm != WM_NONE and wm > self._wms[channel]:
+            self._wms[channel] = wm
+        msg.watermark = self._frontier()
+        return [msg]
+
+    def on_channel_eos(self, channel):
+        self._closed[channel] = True
+        return []
+
+
+class OrderingCollector(Collector):
+    """DETERMINISTIC mode: merge the (per-channel ordered) input streams into
+    one globally timestamp-ordered stream, releasing a tuple only when every
+    open channel has something buffered — so no earlier tuple can still arrive
+    (reference ``ordering_collector.hpp``; also used for id-ordering in WLQ /
+    REDUCE window stages).  Batches are unpacked: determinism is defined at
+    tuple granularity.  Ties break on (ts, channel, arrival seq)."""
+
+    def __init__(self, num_channels: int) -> None:
+        super().__init__(num_channels)
+        self._queues: List[List] = [[] for _ in range(num_channels)]
+        self._closed = [False] * num_channels
+        self._seq = 0
+
+    def _drain_ready(self):
+        out = []
+        while True:
+            heads = []
+            for ch in range(self.num_channels):
+                if self._queues[ch]:
+                    heads.append((self._queues[ch][0], ch))
+                elif not self._closed[ch]:
+                    # An open, empty channel could still deliver the minimum.
+                    return out
+            if not heads:
+                return out
+            (key, item, ts, wm), ch = min(heads, key=lambda h: h[0][0])
+            self._queues[ch].pop(0)
+            out.append(HostBatch([item], [ts], wm))
+        return out
+
+    def on_message(self, channel, msg):
+        if isinstance(msg, Punctuation):
+            # Watermarks are deterministic byproducts here; punctuations only
+            # matter for EOS, which arrives via on_channel_eos.
+            return []
+        assert isinstance(msg, HostBatch), \
+            "DETERMINISTIC mode supports host operators only (parity: GPU ops are DEFAULT-only)"
+        for item, ts in zip(msg.items, msg.tss):
+            self._seq += 1
+            self._queues[channel].append(
+                ((ts, channel, self._seq), item, ts, msg.watermark))
+        return self._drain_ready()
+
+    def on_channel_eos(self, channel):
+        self._closed[channel] = True
+        return self._drain_ready()
+
+
+class KSlackCollector(Collector):
+    """PROBABILISTIC mode: adaptive K-slack reordering buffer (reference
+    ``kslack_collector.hpp:58,120``).  K tracks the maximum observed delay
+    ``max_ts_seen - ts``; a buffered tuple is released once
+    ``ts <= max_ts_seen - K``.  Tuples arriving behind the release frontier
+    are dropped and counted (reference ``atomic_num_dropped``)."""
+
+    def __init__(self, num_channels: int) -> None:
+        super().__init__(num_channels)
+        self._heap: List = []  # (ts, seq, item, wm)
+        self._seq = 0
+        self._k = 0
+        self._max_ts = WM_NONE
+        self._frontier = WM_NONE  # last released ts
+        self._open = num_channels
+
+    def _release(self, limit: int) -> List[HostBatch]:
+        out = []
+        while self._heap and self._heap[0][0] <= limit:
+            ts, _, item, _ = heapq.heappop(self._heap)
+            self._frontier = max(self._frontier, ts)
+            out.append(HostBatch([item], [ts], self._frontier))
+        return out
+
+    def on_message(self, channel, msg):
+        if isinstance(msg, Punctuation):
+            return []
+        assert isinstance(msg, HostBatch), \
+            "PROBABILISTIC mode supports host operators only"
+        for item, ts in zip(msg.items, msg.tss):
+            if ts < self._frontier:
+                self.num_dropped += 1  # too late even for the slack buffer
+                continue
+            self._max_ts = max(self._max_ts, ts)
+            self._k = max(self._k, self._max_ts - ts)
+            self._seq += 1
+            heapq.heappush(self._heap, (ts, self._seq, item, msg.watermark))
+        return self._release(self._max_ts - self._k)
+
+    def on_channel_eos(self, channel):
+        self._open -= 1
+        if self._open == 0 and self._heap:
+            return self._release(max(h[0] for h in self._heap))
+        return []
+
+
+def create_collector(mode: ExecutionMode, num_channels: int) -> Collector:
+    """Reference ``multipipe.hpp:199-232``: DETERMINISTIC→Ordering,
+    PROBABILISTIC→KSlack, DEFAULT→Watermark."""
+    if mode == ExecutionMode.DETERMINISTIC:
+        return OrderingCollector(num_channels)
+    if mode == ExecutionMode.PROBABILISTIC:
+        return KSlackCollector(num_channels)
+    return WatermarkCollector(num_channels)
