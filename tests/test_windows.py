@@ -1,0 +1,313 @@
+"""Window operator tests in the reference's style (``tests/win_tests/``):
+every window operator × {count-based, time-based}, swept over random
+parallelism/batch sizes with a pure-Python oracle
+(cf. ``test_win_{kw,pw,paw,mrw,fat}_{cb,tb}.cpp``)."""
+
+import random
+
+import pytest
+
+import windflow_tpu as wf
+
+
+N_KEYS = 4
+LENGTH = 400
+
+
+def stream():
+    # ordered event-time stream: ts = i milliseconds
+    return [{"key": i % N_KEYS, "value": i, "ts": i * 1000}
+            for i in range(LENGTH)]
+
+
+def oracle_cb(win, slide):
+    """Expected (#windows, total sum) for per-key count windows, including
+    EOS partials (windows whose start index is before the key's end)."""
+    per_key = {}
+    for t in stream():
+        per_key.setdefault(t["key"], []).append(t["value"])
+    count, total = 0, 0
+    for vals in per_key.values():
+        w = 0
+        while w * slide < len(vals):
+            items = vals[w * slide: w * slide + win]
+            count += 1
+            total += sum(items)
+            w += 1
+    return count, total
+
+
+def oracle_tb(win_us, slide_us):
+    """Expected (#windows, total) for per-key time windows: every window that
+    contains at least one tuple fires, with its full contents."""
+    per_key = {}
+    for t in stream():
+        per_key.setdefault(t["key"], []).append((t["ts"], t["value"]))
+    count, total = 0, 0
+    for pts in per_key.values():
+        wids = set()
+        for ts, _ in pts:
+            last = ts // slide_us
+            first = max(0, -(-(ts - win_us + 1) // slide_us))
+            wids.update(range(first, last + 1))
+        for w in sorted(wids):
+            items = [v for ts, v in pts
+                     if w * slide_us <= ts < w * slide_us + win_us]
+            if items:
+                count += 1
+                total += sum(items)
+    return count, total
+
+
+class WinAcc:
+    def __init__(self):
+        self.count = 0
+        self.total = 0
+
+    def __call__(self, r):
+        if r is not None:
+            self.count += 1
+            self.total += int(r.value)
+
+
+def run_graph(win_op, batch, mode=wf.ExecutionMode.DEFAULT,
+              sink_parallelism=1):
+    acc = WinAcc()
+    src = (wf.Source_Builder(lambda: iter(stream()))
+           .withTimestampExtractor(lambda t: t["ts"])
+           .withOutputBatchSize(batch).build())
+    snk = wf.Sink_Builder(acc).withParallelism(sink_parallelism).build()
+    g = wf.PipeGraph("win", mode, wf.TimePolicy.EVENT)
+    g.add_source(src).add(win_op).add_sink(snk)
+    g.run()
+    return acc
+
+
+WIN, SLIDE = 16, 4          # count windows
+TWIN, TSLIDE = 16_000, 4_000  # time windows (µs)
+
+
+@pytest.mark.parametrize("mode", [wf.ExecutionMode.DEFAULT,
+                                  wf.ExecutionMode.DETERMINISTIC])
+def test_keyed_windows_cb(mode):
+    rnd = random.Random(5)
+    exp = oracle_cb(WIN, SLIDE)
+    for incremental_fn in [lambda items: sum(t["value"] for t in items),
+                           lambda t, acc: (acc or 0) + t["value"]]:
+        for _ in range(3):
+            op = (wf.Keyed_Windows_Builder(incremental_fn)
+                  .withCBWindows(WIN, SLIDE)
+                  .withKeyBy(lambda t: t["key"])
+                  .withParallelism(rnd.randint(1, 3)).build())
+            acc = run_graph(op, rnd.randint(1, 16), mode)
+            assert (acc.count, acc.total) == exp
+
+
+@pytest.mark.parametrize("mode", [wf.ExecutionMode.DEFAULT,
+                                  wf.ExecutionMode.DETERMINISTIC])
+def test_keyed_windows_tb(mode):
+    rnd = random.Random(6)
+    exp = oracle_tb(TWIN, TSLIDE)
+    for _ in range(3):
+        op = (wf.Keyed_Windows_Builder(
+                lambda items: sum(t["value"] for t in items))
+              .withTBWindows(TWIN, TSLIDE)
+              .withKeyBy(lambda t: t["key"])
+              .withParallelism(rnd.randint(1, 3)).build())
+        acc = run_graph(op, rnd.randint(1, 16), mode)
+        assert (acc.count, acc.total) == exp
+
+
+def test_parallel_windows_cb_tb():
+    rnd = random.Random(7)
+    for _ in range(3):
+        op = (wf.Parallel_Windows_Builder(
+                lambda items: sum(t["value"] for t in items))
+              .withCBWindows(WIN, SLIDE)
+              .withKeyBy(lambda t: t["key"])
+              .withParallelism(rnd.randint(1, 3)).build())
+        acc = run_graph(op, rnd.randint(1, 16))
+        assert (acc.count, acc.total) == oracle_cb(WIN, SLIDE)
+    for _ in range(3):
+        op = (wf.Parallel_Windows_Builder(
+                lambda items: sum(t["value"] for t in items))
+              .withTBWindows(TWIN, TSLIDE)
+              .withKeyBy(lambda t: t["key"])
+              .withParallelism(rnd.randint(1, 3)).build())
+        acc = run_graph(op, rnd.randint(1, 16))
+        assert (acc.count, acc.total) == oracle_tb(TWIN, TSLIDE)
+
+
+def test_paned_windows_cb_tb():
+    rnd = random.Random(8)
+    plq = lambda items: sum(t["value"] for t in items)
+    wlq = lambda panes: sum(panes)
+    for _ in range(2):
+        op = (wf.Paned_Windows_Builder(plq, wlq)
+              .withCBWindows(WIN, SLIDE)
+              .withKeyBy(lambda t: t["key"])
+              .withParallelisms(rnd.randint(1, 3), rnd.randint(1, 3)).build())
+        acc = run_graph(op, rnd.randint(1, 16))
+        assert (acc.count, acc.total) == oracle_cb(WIN, SLIDE)
+    for _ in range(2):
+        op = (wf.Paned_Windows_Builder(plq, wlq)
+              .withTBWindows(TWIN, TSLIDE)
+              .withKeyBy(lambda t: t["key"])
+              .withParallelisms(rnd.randint(1, 3), rnd.randint(1, 3)).build())
+        acc = run_graph(op, rnd.randint(1, 16))
+        assert (acc.count, acc.total) == oracle_tb(TWIN, TSLIDE)
+
+
+def test_mapreduce_windows_cb_tb():
+    rnd = random.Random(9)
+    map_fn = lambda items: sum(t["value"] for t in items)
+    red_fn = lambda partials: sum(partials)
+    for _ in range(2):
+        op = (wf.MapReduce_Windows_Builder(map_fn, red_fn)
+              .withCBWindows(WIN, SLIDE)
+              .withKeyBy(lambda t: t["key"])
+              .withParallelisms(rnd.randint(1, 3), rnd.randint(1, 3)).build())
+        acc = run_graph(op, rnd.randint(1, 16))
+        assert (acc.count, acc.total) == oracle_cb(WIN, SLIDE)
+    for _ in range(2):
+        op = (wf.MapReduce_Windows_Builder(map_fn, red_fn)
+              .withTBWindows(TWIN, TSLIDE)
+              .withKeyBy(lambda t: t["key"])
+              .withParallelisms(rnd.randint(1, 3), rnd.randint(1, 3)).build())
+        acc = run_graph(op, rnd.randint(1, 16))
+        assert (acc.count, acc.total) == oracle_tb(TWIN, TSLIDE)
+
+
+def test_ffat_windows_cb_tb():
+    rnd = random.Random(10)
+    lift = lambda t: t["value"]
+    comb = lambda a, b: a + b
+    for _ in range(3):
+        op = (wf.Ffat_Windows_Builder(lift, comb)
+              .withCBWindows(WIN, SLIDE)
+              .withKeyBy(lambda t: t["key"])
+              .withParallelism(rnd.randint(1, 3)).build())
+        acc = run_graph(op, rnd.randint(1, 16))
+        assert (acc.count, acc.total) == oracle_cb(WIN, SLIDE)
+    for _ in range(3):
+        op = (wf.Ffat_Windows_Builder(lift, comb)
+              .withTBWindows(TWIN, TSLIDE)
+              .withKeyBy(lambda t: t["key"])
+              .withParallelism(rnd.randint(1, 3)).build())
+        acc = run_graph(op, rnd.randint(1, 16))
+        assert (acc.count, acc.total) == oracle_tb(TWIN, TSLIDE)
+
+
+def test_ffat_windows_non_invertible():
+    """FlatFAT works for non-invertible combiners (max), unlike
+    subtract-based sliding sums."""
+    lift = lambda t: t["value"]
+    comb = max
+    op = (wf.Ffat_Windows_Builder(lift, comb)
+          .withCBWindows(WIN, SLIDE)
+          .withKeyBy(lambda t: t["key"]).build())
+    got = []
+    src = (wf.Source_Builder(lambda: iter(stream()))
+           .withOutputBatchSize(8).build())
+    snk = wf.Sink_Builder(
+        lambda r: got.append((r.key, r.wid, r.value))
+        if r is not None else None).build()
+    g = wf.PipeGraph("ffmax", wf.ExecutionMode.DEFAULT)
+    g.add_source(src).add(op).add_sink(snk)
+    g.run()
+    per_key = {}
+    for t in stream():
+        per_key.setdefault(t["key"], []).append(t["value"])
+    exp = {}
+    for k, vals in per_key.items():
+        w = 0
+        while w * SLIDE < len(vals):
+            exp[(k, w)] = max(vals[w * SLIDE: w * SLIDE + WIN])
+            w += 1
+    assert dict(((k, w), v) for k, w, v in got) == exp
+
+
+def test_ffat_tpu_cb():
+    """FfatWindowsTPU vs the host oracle (reference win_tests_gpu pattern:
+    accelerator windows must reproduce host results)."""
+    exp = oracle_cb(WIN, SLIDE)
+    for batch in (32, 64):
+        acc = WinAcc()
+        src = (wf.Source_Builder(lambda: iter(stream()))
+               .withOutputBatchSize(batch).build())
+        op = (wf.Ffat_WindowsTPU_Builder(
+                lambda t: t["value"], lambda a, b: a + b)
+              .withCBWindows(WIN, SLIDE)
+              .withKeyBy(lambda t: t["key"])
+              .withMaxKeys(N_KEYS).build())
+        snk = wf.Sink_Builder(
+            lambda r: acc(_as_result(r)) if r is not None else None).build()
+        g = wf.PipeGraph("ffat_tpu", wf.ExecutionMode.DEFAULT)
+        g.add_source(src).add(op).add_sink(snk)
+        g.run()
+        assert (acc.count, acc.total) == exp
+
+
+def _as_result(rec):
+    return wf.WindowResult(rec["key"], rec["wid"], rec["value"])
+
+
+def test_flatfat_structure():
+    """FlatFAT unit check against naive range folds (reference flatfat.hpp)."""
+    import operator
+    rnd = random.Random(11)
+    fat = wf.FlatFAT(operator.add, 16)
+    vals = []
+    for pos in range(50):
+        v = rnd.randint(0, 100)
+        vals.append(v)
+        fat.update(pos, v)
+        lo = max(0, pos - 15)
+        assert fat.query(lo, pos + 1) == sum(vals[lo:pos + 1])
+        for old in range(max(0, pos - 15)):
+            fat.evict(old)
+
+
+def test_tb_boundary_ties_ordered_mode():
+    """Regression: in ordered modes, tuples sharing the frontier timestamp
+    must all land in their window — a window ending at ts+1 may not fire
+    until a strictly later timestamp arrives."""
+    items = [{"k": 0, "v": "a", "ts": 5}, {"k": 0, "v": "b", "ts": 9},
+             {"k": 0, "v": "c", "ts": 9}, {"k": 0, "v": "d", "ts": 12}]
+    for build in [
+        lambda: (wf.Keyed_Windows_Builder(lambda its: len(its))
+                 .withTBWindows(10, 10).withKeyBy(lambda t: t["k"]).build()),
+        lambda: (wf.Ffat_Windows_Builder(lambda t: 1, lambda a, b: a + b)
+                 .withTBWindows(10, 10).withKeyBy(lambda t: t["k"]).build()),
+    ]:
+        got = []
+        src = (wf.Source_Builder(lambda: iter(items))
+               .withTimestampExtractor(lambda t: t["ts"])
+               .withOutputBatchSize(1).build())
+        snk = wf.Sink_Builder(
+            lambda r: got.append((r.wid, r.value))
+            if r is not None else None).build()
+        g = wf.PipeGraph("ties", wf.ExecutionMode.DETERMINISTIC,
+                         wf.TimePolicy.EVENT)
+        g.add_source(src).add(build()).add_sink(snk)
+        g.run()
+        assert sorted(got) == [(0, 3), (1, 1)], got
+
+
+def test_ffat_tpu_parallelism_no_duplicate_flush():
+    """Regression: multiple FfatWindowsTPU replicas share one logical state
+    table; EOS must flush it exactly once."""
+    exp = oracle_cb(WIN, SLIDE)
+    acc = WinAcc()
+    src = (wf.Source_Builder(lambda: iter(stream()))
+           .withOutputBatchSize(64).build())
+    op = (wf.Ffat_WindowsTPU_Builder(lambda t: t["value"],
+                                     lambda a, b: a + b)
+          .withCBWindows(WIN, SLIDE).withKeyBy(lambda t: t["key"])
+          .withMaxKeys(N_KEYS).withParallelism(2).build())
+    snk = wf.Sink_Builder(
+        lambda r: acc(_as_result(r)) if r is not None else None).build()
+    g = wf.PipeGraph("ffat_tpu_p2", wf.ExecutionMode.DEFAULT)
+    g.add_source(src).add(op).add_sink(snk)
+    g.run()
+    assert (acc.count, acc.total) == exp

@@ -26,11 +26,17 @@ from windflow_tpu.basic import (Config, EMPTY_KEY, ExecutionMode, RoutingMode,
 from windflow_tpu.batch import (DeviceBatch, HostBatch, Punctuation,
                                 device_to_host, host_to_device)
 from windflow_tpu.context import LocalStorage, RuntimeContext
-from windflow_tpu.graph.builders import (Filter_Builder, FilterTPU_Builder,
-                                         FlatMap_Builder, Map_Builder,
-                                         MapTPU_Builder, Reduce_Builder,
-                                         ReduceTPU_Builder, Sink_Builder,
-                                         Source_Builder)
+from windflow_tpu.graph.builders import (Ffat_Windows_Builder,
+                                         Ffat_WindowsTPU_Builder,
+                                         Filter_Builder, FilterTPU_Builder,
+                                         FlatMap_Builder,
+                                         Keyed_Windows_Builder, Map_Builder,
+                                         MapReduce_Windows_Builder,
+                                         MapTPU_Builder,
+                                         Paned_Windows_Builder,
+                                         Parallel_Windows_Builder,
+                                         Reduce_Builder, ReduceTPU_Builder,
+                                         Sink_Builder, Source_Builder)
 from windflow_tpu.graph.multipipe import MultiPipe
 from windflow_tpu.graph.pipegraph import PipeGraph
 from windflow_tpu.ops.base import Operator, Replica
@@ -41,6 +47,13 @@ from windflow_tpu.ops.reduce_op import Reduce
 from windflow_tpu.ops.sink import Sink
 from windflow_tpu.ops.source import Source
 from windflow_tpu.ops.tpu import FilterTPU, MapTPU, ReduceTPU
+from windflow_tpu.windows.engine import WindowSpec
+from windflow_tpu.windows.ffat_op import FfatWindows
+from windflow_tpu.windows.ffat_tpu import FfatWindowsTPU
+from windflow_tpu.windows.flatfat import FlatFAT
+from windflow_tpu.windows.ops import (KeyedWindows, MapReduceWindows,
+                                      PanedWindows, ParallelWindows,
+                                      WindowResult)
 
 __version__ = "0.1.0"
 

@@ -191,3 +191,187 @@ class ReduceTPU_Builder(_BuilderBase):
         return ReduceTPU(self._comb, name=self._name,
                          parallelism=self._parallelism,
                          key_extractor=self._key_extractor)
+
+
+# ---------------------------------------------------------------------------
+# Window builders (reference Keyed_Windows_Builder / Parallel_Windows_Builder /
+# Paned_Windows_Builder / MapReduce_Windows_Builder / Ffat_Windows_Builder /
+# Ffat_WindowsGPU_Builder, builders.hpp + builders_gpu.hpp:576)
+# ---------------------------------------------------------------------------
+
+from windflow_tpu.basic import WinType  # noqa: E402
+from windflow_tpu.meta import _positional_arity  # noqa: E402
+from windflow_tpu.windows.engine import WindowSpec  # noqa: E402
+from windflow_tpu.windows.ops import (KeyedWindows, MapReduceWindows,  # noqa: E402
+                                      PanedWindows, ParallelWindows)
+from windflow_tpu.windows.ffat_op import FfatWindows  # noqa: E402
+from windflow_tpu.windows.ffat_tpu import FfatWindowsTPU  # noqa: E402
+
+
+class _WindowBuilderBase(_BuilderBase):
+    def __init__(self):
+        super().__init__()
+        self._win_type = None
+        self._win_len = 0
+        self._slide = 0
+        self._lateness = 0
+
+    def withCBWindows(self, win_len: int, slide: int):
+        self._win_type = WinType.CB
+        self._win_len, self._slide = int(win_len), int(slide)
+        return self
+
+    def withTBWindows(self, win_usec: int, slide_usec: int):
+        self._win_type = WinType.TB
+        self._win_len, self._slide = int(win_usec), int(slide_usec)
+        return self
+
+    def withLateness(self, lateness_usec: int):
+        self._lateness = int(lateness_usec)
+        return self
+
+    def _spec(self) -> WindowSpec:
+        if self._win_type is None:
+            raise WindFlowError(
+                "window operator needs withCBWindows or withTBWindows")
+        if self._win_len <= 0 or self._slide <= 0:
+            raise WindFlowError("window length and slide must be > 0")
+        return WindowSpec(self._win_type, self._win_len, self._slide,
+                          self._lateness)
+
+
+def _detect_incremental(fn) -> bool:
+    """Non-incremental window logic takes the item list (arity 1);
+    incremental logic takes (tuple, accumulator) (arity 2) — the Python
+    analogue of the reference's type-based dispatch (meta.hpp)."""
+    return _positional_arity(fn) == 2
+
+
+class Keyed_Windows_Builder(_WindowBuilderBase):
+    _default_name = "keyed_windows"
+
+    def __init__(self, fn):
+        super().__init__()
+        self._fn = fn
+
+    def build(self) -> KeyedWindows:
+        return KeyedWindows(
+            self._fn, self._spec(), name=self._name,
+            parallelism=self._parallelism, key_extractor=self._key_extractor,
+            incremental=_detect_incremental(self._fn),
+            output_batch_size=self._output_batch_size)
+
+
+class Parallel_Windows_Builder(_WindowBuilderBase):
+    _default_name = "parallel_windows"
+
+    def __init__(self, fn):
+        super().__init__()
+        self._fn = fn
+
+    def build(self) -> ParallelWindows:
+        return ParallelWindows(
+            self._fn, self._spec(), name=self._name,
+            parallelism=self._parallelism, key_extractor=self._key_extractor,
+            incremental=_detect_incremental(self._fn),
+            output_batch_size=self._output_batch_size)
+
+
+class Paned_Windows_Builder(_WindowBuilderBase):
+    _default_name = "paned_windows"
+
+    def __init__(self, plq_fn, wlq_fn):
+        super().__init__()
+        self._plq_fn = plq_fn
+        self._wlq_fn = wlq_fn
+        self._wlq_parallelism = 1
+
+    def withParallelisms(self, plq: int, wlq: int):
+        self._parallelism = plq
+        self._wlq_parallelism = wlq
+        return self
+
+    def build(self) -> PanedWindows:
+        return PanedWindows(
+            self._plq_fn, self._wlq_fn, self._spec(),
+            name=self._name,
+            plq_parallelism=self._parallelism,
+            wlq_parallelism=self._wlq_parallelism,
+            key_extractor=self._key_extractor,
+            plq_incremental=_detect_incremental(self._plq_fn),
+            wlq_incremental=_detect_incremental(self._wlq_fn),
+            output_batch_size=self._output_batch_size)
+
+
+class MapReduce_Windows_Builder(_WindowBuilderBase):
+    _default_name = "mapreduce_windows"
+
+    def __init__(self, map_fn, reduce_fn):
+        super().__init__()
+        self._map_fn = map_fn
+        self._reduce_fn = reduce_fn
+        self._reduce_parallelism = 1
+
+    def withParallelisms(self, map_p: int, reduce_p: int):
+        self._parallelism = map_p
+        self._reduce_parallelism = reduce_p
+        return self
+
+    def build(self) -> MapReduceWindows:
+        return MapReduceWindows(
+            self._map_fn, self._reduce_fn, self._spec(),
+            name=self._name,
+            map_parallelism=self._parallelism,
+            reduce_parallelism=self._reduce_parallelism,
+            key_extractor=self._key_extractor,
+            map_incremental=_detect_incremental(self._map_fn),
+            reduce_incremental=_detect_incremental(self._reduce_fn),
+            output_batch_size=self._output_batch_size)
+
+
+class Ffat_Windows_Builder(_WindowBuilderBase):
+    _default_name = "ffat_windows"
+
+    def __init__(self, lift_fn, comb_fn):
+        super().__init__()
+        self._lift = lift_fn
+        self._comb = comb_fn
+
+    def build(self) -> FfatWindows:
+        return FfatWindows(
+            self._lift, self._comb, self._spec(),
+            name=self._name,
+            parallelism=self._parallelism, key_extractor=self._key_extractor,
+            lateness=self._lateness,
+            output_batch_size=self._output_batch_size)
+
+
+class Ffat_WindowsTPU_Builder(_WindowBuilderBase):
+    """Reference ``Ffat_WindowsGPU_Builder`` (builders_gpu.hpp:576); the
+    ``withNumWinPerBatch`` knob is unnecessary here — every window a batch
+    completes is computed in the one fused program."""
+
+    _default_name = "ffat_windows_tpu"
+
+    def __init__(self, lift_fn, comb_fn):
+        super().__init__()
+        self._lift = lift_fn
+        self._comb = comb_fn
+        self._max_keys = 1
+
+    def withMaxKeys(self, n: int):
+        """Size of the dense device key space [0, n)."""
+        self._max_keys = int(n)
+        return self
+
+    def withLateness(self, lateness_usec: int):
+        raise WindFlowError(
+            "FfatWindowsTPU does not support lateness yet (time-based TPU "
+            "windows are planned); use the host Ffat_Windows for lateness")
+
+    def build(self) -> FfatWindowsTPU:
+        return FfatWindowsTPU(
+            self._lift, self._comb, self._spec(), max_keys=self._max_keys,
+            name=self._name,
+            parallelism=self._parallelism,
+            key_extractor=self._key_extractor)

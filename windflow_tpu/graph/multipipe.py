@@ -49,6 +49,13 @@ class MultiPipe:
     def add(self, op: Operator) -> "MultiPipe":
         """Append an operator with a shuffle/forward connection (reference
         ``MultiPipe::add``, ``multipipe.hpp:936-1027``)."""
+        if hasattr(op, "stages"):
+            # composite window operators expand into their pipeline stages
+            # (reference adds PLQ+WLQ / MAP+REDUCE as two operators,
+            # multipipe.hpp:965-999)
+            for stage in op.stages():
+                self.add(stage)
+            return self
         self._check_open()
         if isinstance(op, Source):
             raise WindFlowError("a Source can only start a MultiPipe")
@@ -65,8 +72,8 @@ class MultiPipe:
         and FORWARD routing (reference conditions, ``multipipe.hpp:553``);
         otherwise falls back to ``add`` exactly like the reference."""
         from windflow_tpu.ops.reduce_op import Reduce
-        if isinstance(op, Reduce):
-            # Parity: Reduce cannot be chained (multipipe.hpp:1042-1045).
+        if hasattr(op, "stages") or isinstance(op, Reduce):
+            # composites and Reduce cannot be chained (multipipe.hpp:1042-1045)
             return self.add(op)
         prev = self.operators[-1]
         can_fuse = (op.routing == RoutingMode.FORWARD

@@ -43,6 +43,7 @@ class Replica:
         self._eos_channels = set()
         self.done = False
         self.current_wm = WM_NONE
+        self._hooked_wm = WM_NONE   # last watermark passed to on_watermark
         self.stats = StatsRecord(operator_name=op.name, replica_index=index,
                                  is_tpu=op.is_tpu)
         self.mode = ExecutionMode.DEFAULT
@@ -99,6 +100,7 @@ class Replica:
     def _dispatch(self, msg) -> None:
         if isinstance(msg, Punctuation):
             self._advance_wm(msg.watermark)
+            self._maybe_hook_wm()
             if self.emitter is not None:
                 self.emitter.propagate_punctuation(self.current_wm)
             return
@@ -114,7 +116,14 @@ class Replica:
             for item, ts in zip(msg.items, msg.tss):
                 self.context._set_context(ts, msg.watermark)
                 self.process_single(item, ts, msg.watermark)
+        self._maybe_hook_wm()
         self.stats.end_sample()
+
+    def _maybe_hook_wm(self) -> None:
+        # only invoke the (potentially O(open windows)) hook on a real advance
+        if self.current_wm != self._hooked_wm:
+            self._hooked_wm = self.current_wm
+            self.on_watermark(self.current_wm)
 
     def _advance_wm(self, wm: int) -> None:
         if wm != WM_NONE and wm > self.current_wm:
@@ -132,6 +141,9 @@ class Replica:
 
     def on_eos(self) -> None:
         """Flush hook: window firing, sink finalization, etc."""
+
+    def on_watermark(self, wm: int) -> None:
+        """Watermark-advance hook (fires time windows past the frontier)."""
 
 
 class Operator:

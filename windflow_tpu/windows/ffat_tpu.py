@@ -1,0 +1,370 @@
+"""FfatWindowsTPU: incremental sliding-window aggregation on TPU.
+
+Device equivalent of the reference's ``Ffat_Windows_GPU``
+(``/root/reference/wf/ffat_replica_gpu.hpp:424``, ``flatfat_gpu.hpp:143``),
+re-designed for XLA rather than translated from CUDA:
+
+* The reference lifts tuples into pane aggregates with per-key kernels
+  (``ffat_replica_gpu.hpp:92-216`` lift, ``Aggregate_Panes_Kernel``); here the
+  whole batch is sorted by key once and panes are built with a segmented
+  ``associative_scan`` — the XLA expression of the same reduction.
+* The reference maintains a per-key FlatFAT tree on device and computes
+  ``numWinsPerBatch`` window results per launch (``flatfat_gpu.hpp:60-139``).
+  Here per-key state is **dense over a static key space** [0, max_keys): a
+  carry ring of the trailing R-1 pane aggregates per key plus the current
+  partial pane.  Window results gather their R panes and reduce them with a
+  log-depth scan, for every key and every fired window in one fused program —
+  the "batch many windows per launch" trick (``builders_gpu.hpp:576``
+  ``withNumWinPerBatch``) taken to its TPU conclusion: *all* windows a batch
+  completes, across *all* keys, in one launch.
+* Count-based windows of length W sliding by S decompose into panes of
+  P = gcd(W, S) (same decomposition as the reference's pane logic): R = W/P
+  panes per window, fired every D = S/P panes.
+
+Invariants/contract:
+* key extractor is JAX-traceable and returns ints in [0, max_keys);
+  out-of-range keys are dropped (masked), as are invalid lanes.
+* ``lift`` maps a record pytree to an aggregate pytree; ``comb`` is an
+  associative combiner of aggregates.  No identity element is required.
+* One step processes one fixed-capacity batch; all shapes are static, so the
+  program compiles exactly once per batch capacity.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+from windflow_tpu.basic import RoutingMode, WindFlowError, WinType
+from windflow_tpu.batch import DeviceBatch
+from windflow_tpu.ops.base import Operator
+from windflow_tpu.ops.tpu import _TPUReplica
+from windflow_tpu.windows.engine import WindowSpec
+
+
+def _seg_scan(comb, flags, values):
+    """Inclusive segmented scan: within each flagged segment, fold ``comb``.
+    ``values`` is a pytree of [B, ...] leaves; ``flags`` [B] marks segment
+    starts."""
+    def op(a, b):
+        fa, va = a
+        fb, vb = b
+        combined = comb(va, vb)
+        v = jax.tree.map(
+            lambda c, nb: jnp.where(_b(fb, c), nb, c), combined, vb)
+        return (fa | fb, v)
+
+    _, scanned = jax.lax.associative_scan(op, (flags, values))
+    return scanned
+
+
+def _masked_reduce_last(comb, flags, values, axis):
+    """Reduce ``values`` along ``axis`` with ``comb``, skipping entries whose
+    flag is False; returns (any_flag, reduction).  Flag-aware monoid:
+    associative, no identity needed."""
+    def op(a, b):
+        fa, va = a
+        fb, vb = b
+        both = comb(va, vb)
+        v = jax.tree.map(
+            lambda c, xa, xb: jnp.where(_b(fb, c), jnp.where(_b(fa, c), c, xb),
+                                        xa), both, va, vb)
+        return (fa | fb, v)
+
+    f, v = jax.lax.associative_scan(op, (flags, values), axis=axis)
+    take = lambda x: jax.lax.index_in_dim(x, x.shape[axis] - 1, axis,
+                                          keepdims=False)
+    return take(f), jax.tree.map(take, v)
+
+
+def _b(mask, ref):
+    """Broadcast a bool mask against a leaf with trailing dims."""
+    return mask.reshape(mask.shape + (1,) * (ref.ndim - mask.ndim))
+
+
+class FfatTPUReplica(_TPUReplica):
+    def on_eos(self):
+        out = self.op._flush()
+        if out is not None:
+            self.stats.device_programs_launched += 1
+            self.emitter.emit_device_batch(out)
+
+
+class FfatWindowsTPU(Operator):
+    replica_class = FfatTPUReplica
+
+    def __init__(self, lift: Callable, comb: Callable, spec: WindowSpec, *,
+                 max_keys: int, name: str = "ffat_windows_tpu",
+                 parallelism: int = 1,
+                 key_extractor: Optional[Callable] = None) -> None:
+        if spec.win_type != WinType.CB:
+            raise WindFlowError(
+                "FfatWindowsTPU currently supports count-based windows "
+                "(time-based via quantum panes is planned; use the host "
+                "Ffat_Windows for TB)")
+        routing = (RoutingMode.KEYBY if key_extractor is not None
+                   else RoutingMode.FORWARD)
+        super().__init__(name, parallelism, routing=routing, is_tpu=True,
+                         key_extractor=key_extractor)
+        self.lift = lift
+        self.comb = comb
+        self.spec = spec
+        self.max_keys = max_keys
+        self.P = math.gcd(spec.win_len, spec.slide)
+        self.R = spec.win_len // self.P
+        self.D = spec.slide // self.P
+        self._state = None          # device state, created on first batch
+        self._jit_step = None
+        self._jit_flush = None
+        self._capacity = None
+        self._flushed = False
+
+    # -- state layout --------------------------------------------------------
+    def _init_state(self, agg_spec):
+        K, R = self.max_keys, self.R
+        zeros = lambda shape: jax.tree.map(
+            lambda s: jnp.zeros(shape + s.shape, s.dtype), agg_spec)
+        return {
+            "carry": zeros((K, R - 1)),               # trailing R-1 panes
+            "carry_valid": jnp.zeros((K, R - 1), bool),
+            "cur": zeros((K,)),                       # partial pane aggregate
+            "cur_valid": jnp.zeros((K,), bool),
+            "cur_fill": jnp.zeros((K,), jnp.int32),   # tuples in partial pane
+            "pane_base": jnp.zeros((K,), jnp.int64),  # completed panes
+            "win_next": jnp.full((K,), self.R, jnp.int64),  # next end pane
+        }
+
+    # -- per-batch program ---------------------------------------------------
+    def _build_step(self, capacity: int):
+        K, P, R, D = self.max_keys, self.P, self.R, self.D
+        NP1 = capacity // P + 2           # pane cells incl. continuation cell
+        MW = (capacity // P) // D + 2     # max windows fired per batch
+        lift, comb, key_fn = self.lift, self.comb, self.key_extractor
+
+        def step(state, payload, ts, valid):
+            B = capacity
+            keys = jax.vmap(key_fn)(payload).astype(jnp.int32) \
+                if key_fn is not None else jnp.zeros(B, jnp.int32)
+            ok = valid & (keys >= 0) & (keys < K)
+            skey_for_sort = jnp.where(ok, keys, K)
+            order = jnp.argsort(skey_for_sort, stable=True)
+            sk = skey_for_sort[order]
+            slift = jax.tree.map(lambda a: a[order],
+                                 jax.vmap(lift)(payload))
+            pos = jnp.arange(B)
+            starts = jnp.concatenate([jnp.array([True]), sk[1:] != sk[:-1]])
+            seg_start_pos = jax.lax.associative_scan(
+                jnp.maximum, jnp.where(starts, pos, 0))
+            rank = pos - seg_start_pos
+
+            n_k = jax.ops.segment_sum(ok[order].astype(jnp.int32), sk,
+                                      num_segments=K + 1)[:K]
+            fill0 = state["cur_fill"][jnp.minimum(sk, K - 1)]
+            pane_rel = ((fill0 + rank) // P).astype(jnp.int32)
+
+            # pane partials: segmented scan over (key, pane) runs
+            pane_starts = starts | jnp.concatenate(
+                [jnp.array([True]), pane_rel[1:] != pane_rel[:-1]])
+            scanned = _seg_scan(comb, pane_starts, slift)
+            ends = jnp.concatenate(
+                [(sk[1:] != sk[:-1]) | (pane_rel[1:] != pane_rel[:-1]),
+                 jnp.array([True])])
+            # scatter segment-end partials into dense [K+1, NP1] cells
+            row = jnp.where(ends, sk, K)
+            col = jnp.where(ends, pane_rel, 0)
+            def scat(leaf):
+                buf = jnp.zeros((K + 1, NP1) + leaf.shape[1:], leaf.dtype)
+                return buf.at[row, col].set(
+                    jnp.where(_b(ends, leaf), leaf, 0))[:K]
+            cells = jax.tree.map(scat, scanned)
+            cell_has = jnp.zeros((K + 1, NP1), bool) \
+                .at[row, col].set(ends)[:K]
+
+            # merge continuation cell with the carried partial pane
+            def merge0(cur_leaf, cell_leaf):
+                both = comb(cur_leaf, cell_leaf[:, 0])
+                use_cur = state["cur_valid"]
+                use_cell = cell_has[:, 0]
+                v = jnp.where(_b(use_cur & use_cell, both), both,
+                              jnp.where(_b(use_cur, both), cur_leaf,
+                                        cell_leaf[:, 0]))
+                return cell_leaf.at[:, 0].set(v)
+            cells = jax.tree.map(
+                lambda cur_leaf, cell_leaf: merge0(cur_leaf, cell_leaf),
+                state["cur"], cells)
+
+            m_k = ((state["cur_fill"] + n_k) // P).astype(jnp.int32)
+            new_fill = ((state["cur_fill"] + n_k) % P).astype(jnp.int32)
+
+            # full pane sequence: carry (R-1 trailing) + this batch's panes
+            full = jax.tree.map(
+                lambda c, p: jnp.concatenate([c, p], axis=1),
+                state["carry"], cells)
+            col_ix = jnp.arange(NP1)[None, :]
+            pane_valid = col_ix < m_k[:, None]
+            full_valid = jnp.concatenate([state["carry_valid"], pane_valid],
+                                         axis=1)
+
+            # fire windows: end panes e = win_next + j*D while e <= done
+            done = state["pane_base"] + m_k
+            j = jnp.arange(MW, dtype=jnp.int64)
+            e = state["win_next"][:, None] + j[None, :] * D        # [K, MW]
+            fired = e <= done[:, None]
+            local_end = (e - state["pane_base"][:, None]
+                         + (R - 1)).astype(jnp.int32)              # exclusive
+            gidx = jnp.clip(local_end[:, :, None] - R
+                            + jnp.arange(R)[None, None, :],
+                            0, R - 1 + NP1 - 1)                    # [K,MW,R]
+
+            def gather_leaf(a):
+                # a: [K, R-1+NP1, ...] -> [K, MW, R, ...]
+                expanded = jnp.broadcast_to(
+                    a[:, None], (K, MW) + a.shape[1:])
+                idx = gidx.reshape(K, MW, R, *([1] * (a.ndim - 2)))
+                idx = jnp.broadcast_to(idx, (K, MW, R) + a.shape[2:])
+                return jnp.take_along_axis(expanded, idx, axis=2)
+            wpanes = jax.tree.map(gather_leaf, full)
+            _, wvals = _masked_reduce_last(
+                comb, jnp.ones((K, MW, R), bool), wpanes, axis=2)
+
+            n_fired = jnp.where(
+                fired[:, 0],
+                ((done - state["win_next"]) // D + 1), 0)
+            new_win_next = state["win_next"] + n_fired * D
+
+            # new carry: panes [pane_base+m_k-(R-1), pane_base+m_k)
+            cidx = m_k[:, None] + jnp.arange(R - 1)[None, :]       # [K, R-1]
+            def carry_leaf(a):
+                idx = cidx.reshape(K, R - 1, *([1] * (a.ndim - 2)))
+                idx = jnp.broadcast_to(idx, (K, R - 1) + a.shape[2:])
+                return jnp.take_along_axis(a, idx, axis=1)
+            new_carry = jax.tree.map(carry_leaf, full)
+            new_carry_valid = jnp.take_along_axis(full_valid, cidx, axis=1)
+
+            def cur_leaf(cell_leaf):
+                idx = m_k.reshape(K, 1, *([1] * (cell_leaf.ndim - 2)))
+                idx = jnp.broadcast_to(idx, (K, 1) + cell_leaf.shape[2:])
+                return jnp.take_along_axis(cell_leaf, idx, axis=1)[:, 0]
+            new_cur = jax.tree.map(cur_leaf, cells)
+            new_cur_valid = new_fill > 0
+
+            new_state = {
+                "carry": new_carry,
+                "carry_valid": new_carry_valid,
+                "cur": new_cur,
+                "cur_valid": new_cur_valid,
+                "cur_fill": new_fill,
+                "pane_base": done,
+                "win_next": new_win_next,
+            }
+
+            # output batch: one row per (key, window-slot)
+            wid = (e - R) // D
+            out_keys = jnp.broadcast_to(
+                jnp.arange(K, dtype=jnp.int32)[:, None], (K, MW))
+            out_ts = jnp.broadcast_to(
+                jnp.max(jnp.where(valid, ts, 0)), (K, MW))
+            out = {
+                "key": out_keys.reshape(-1),
+                "wid": wid.reshape(-1),
+                "value": jax.tree.map(
+                    lambda a: a.reshape((K * MW,) + a.shape[2:]), wvals),
+            }
+            return new_state, out, fired.reshape(-1), out_ts.reshape(-1)
+
+        return jax.jit(step, donate_argnums=(0,))
+
+    # -- operator plumbing ---------------------------------------------------
+    def _ensure(self, batch: DeviceBatch):
+        if self._state is None:
+            one = jax.tree.map(lambda a: jax.ShapeDtypeStruct(a.shape[1:],
+                                                              a.dtype),
+                               batch.payload)
+            agg_spec = jax.eval_shape(self.lift, one)
+            agg_spec = jax.tree.map(
+                lambda s: jnp.zeros(s.shape, s.dtype), agg_spec)
+            self._state = self._init_state(agg_spec)
+            self._capacity = batch.capacity
+            self._jit_step = self._build_step(batch.capacity)
+        elif batch.capacity != self._capacity:
+            raise WindFlowError(
+                "FfatWindowsTPU requires a fixed upstream batch capacity "
+                f"({self._capacity}), got {batch.capacity}")
+
+    def _step(self, batch: DeviceBatch) -> DeviceBatch:
+        self._ensure(batch)
+        self._state, out, fired, out_ts = self._jit_step(
+            self._state, batch.payload, batch.ts, batch.valid)
+        return DeviceBatch(out, out_ts, fired, keys=out["key"],
+                           watermark=batch.watermark, size=None)
+
+    def _flush(self) -> Optional[DeviceBatch]:
+        """EOS: fire remaining partial windows (reference EOS flush of open
+        windows).  Runs a dedicated flush program over the carried state.
+        State is operator-level (one logical device table regardless of
+        replica count), so only the first replica to reach EOS flushes."""
+        if self._state is None or self._flushed:
+            return None
+        self._flushed = True
+        if self._jit_flush is None:
+            self._jit_flush = self._build_flush()
+        out, fired, ts = self._jit_flush(self._state)
+        return DeviceBatch(out, ts, fired, keys=out["key"], watermark=0,
+                           size=None)
+
+    def _build_flush(self):
+        K, P, R, D = self.max_keys, self.P, self.R, self.D
+        MWF = R // D + 2
+        comb = self.comb
+
+        def flush(state):
+            # total panes including the partial pane
+            has_cur = state["cur_valid"]
+            total = state["pane_base"] + has_cur.astype(jnp.int64)
+            # available pane history: carry (R-1) + cur  -> [K, R]
+            hist = jax.tree.map(
+                lambda c, cur: jnp.concatenate([c, cur[:, None]], axis=1),
+                state["carry"], state["cur"])
+            hist_valid = jnp.concatenate(
+                [state["carry_valid"], has_cur[:, None]], axis=1)
+            # hist column i holds pane (pane_base - (R-1) + i)
+            j = jnp.arange(MWF, dtype=jnp.int64)
+            e = state["win_next"][:, None] + j[None, :] * D
+            start = e - R
+            fire = start < total[:, None]
+            # gather window panes from hist: local = pane - pane_base + R-1
+            lidx = (start[:, :, None] + jnp.arange(R)[None, None, :]
+                    - state["pane_base"][:, None, None] + (R - 1))
+            inb = (lidx >= 0) & (lidx < R)
+            lidx_c = jnp.clip(lidx, 0, R - 1).astype(jnp.int32)
+            pane_ok = jnp.take_along_axis(
+                jnp.broadcast_to(hist_valid[:, None], (K, MWF, R)),
+                lidx_c, axis=2) & inb
+            # panes must also be < total (cur counts once)
+            pane_abs = start[:, :, None] + jnp.arange(R)[None, None, :]
+            pane_ok = pane_ok & (pane_abs < total[:, None, None]) \
+                & (pane_abs >= 0)
+            def gather_leaf(a):
+                expanded = jnp.broadcast_to(a[:, None], (K, MWF) + a.shape[1:])
+                idx = lidx_c.reshape(K, MWF, R, *([1] * (a.ndim - 2)))
+                idx = jnp.broadcast_to(idx, (K, MWF, R) + a.shape[2:])
+                return jnp.take_along_axis(expanded, idx, axis=2)
+            wpanes = jax.tree.map(gather_leaf, hist)
+            any_ok, wvals = _masked_reduce_last(comb, pane_ok, wpanes, axis=2)
+            fired = fire & any_ok
+            wid = (e - R) // D
+            out = {
+                "key": jnp.broadcast_to(
+                    jnp.arange(K, dtype=jnp.int32)[:, None],
+                    (K, MWF)).reshape(-1),
+                "wid": wid.reshape(-1),
+                "value": jax.tree.map(
+                    lambda a: a.reshape((K * MWF,) + a.shape[2:]), wvals),
+            }
+            ts = jnp.zeros((K * MWF,), jnp.int64)
+            return out, fired.reshape(-1), ts
+
+        return jax.jit(flush)
