@@ -4,11 +4,17 @@ driver's dryrun does the same; real-chip benchmarking lives in bench.py)."""
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8").strip()
+
+# The environment may pin JAX_PLATFORMS to a hardware plugin at interpreter
+# startup (sitecustomize), so an env-var setdefault is not enough: force the
+# CPU backend through the config API before any backend is initialized.
+import jax
+
+jax.config.update("jax_platforms", "cpu")
 
 import random
 

@@ -1,0 +1,209 @@
+"""Multi-chip execution: device meshes, key-sharded window state, and
+collective keyed reduction over ICI.
+
+This is the slot the reference fills with thread replication + emitter routing
+(SURVEY.md §2.6 item 10: "GPU offload batching … This is the slot where the
+TPU backend goes").  Where WindFlow scales an operator by cloning replicas
+onto OS threads and hashing keys across lock-free queues
+(``keyby_emitter.hpp:216``), the TPU design scales by **sharding over a
+device mesh**:
+
+* mesh axes ``("data", "key")`` — ``data`` shards the *tuples* of each staged
+  batch (the analogue of replicating stateless operators), ``key`` shards the
+  *keyed state space* (the analogue of KEYBY partitioning of stateful
+  operators).
+* stateless Map/Filter steps run on data-sharded batches with zero
+  communication.
+* keyed windows (:func:`make_sharded_ffat_step`) keep their dense per-key
+  state sharded along ``key``; each key-shard sees the full batch via an
+  ``all_gather`` over ``data`` (tuples ride ICI once) and updates only the
+  keys it owns.
+* keyed reduction (:func:`make_sharded_keyed_reduce`) computes per-chip
+  dense partial tables and combines them across the mesh with ``psum``
+  (sum-like combiners) or a gather+fold (arbitrary associative combiners) —
+  the ICI expression of the reference's ``thrust::reduce_by_key`` +
+  inter-replica merge.
+
+All collectives are XLA collectives over the mesh (``psum``/``all_gather``);
+on real hardware they ride ICI, multi-host meshes extend over DCN with the
+same program (the driver validates this path on a virtual CPU mesh).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Callable, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from windflow_tpu.basic import WindFlowError
+from windflow_tpu.batch import DeviceBatch, HostBatch, host_to_device
+from windflow_tpu.windows.ffat_kernels import (_b, _masked_reduce_last, _seg_scan,
+                                           make_ffat_state, make_ffat_step)
+
+DATA_AXIS = "data"
+KEY_AXIS = "key"
+
+
+def make_mesh(n_devices: Optional[int] = None, data: int = 1,
+              devices: Optional[Sequence] = None) -> Mesh:
+    """Create a ``(data, key)`` mesh over the first ``n_devices`` devices.
+
+    ``data`` fixes the data-parallel extent; the key axis takes the rest.
+    With ``data=1`` the mesh degenerates to pure key sharding (the keyed
+    Reduce/FFAT scaling configuration from BASELINE.json)."""
+    devs = list(devices) if devices is not None else list(jax.devices())
+    if n_devices is not None:
+        if len(devs) < n_devices:
+            raise WindFlowError(
+                f"requested {n_devices} devices, only {len(devs)} visible")
+        devs = devs[:n_devices]
+    n = len(devs)
+    if n % data != 0:
+        raise WindFlowError(f"{n} devices not divisible by data={data}")
+    arr = np.array(devs).reshape(data, n // data)
+    return Mesh(arr, (DATA_AXIS, KEY_AXIS))
+
+
+def batch_sharding(mesh: Mesh) -> NamedSharding:
+    """Sharding for staged batch lanes: tuples split along ``data``,
+    replicated along ``key``."""
+    return NamedSharding(mesh, P(DATA_AXIS))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def state_sharding(mesh: Mesh) -> NamedSharding:
+    """Sharding for dense per-key state tables: split along ``key``."""
+    return NamedSharding(mesh, P(KEY_AXIS))
+
+
+def stage_batch(hb: HostBatch, capacity: int, mesh: Mesh) -> DeviceBatch:
+    """Host→mesh staging: pad to ``capacity`` and lay tuples out data-sharded
+    (the multi-chip form of the reference's pinned-staging H2D path)."""
+    db = host_to_device(hb, capacity=capacity)
+    sh = batch_sharding(mesh)
+    return DeviceBatch(
+        jax.tree.map(lambda a: jax.device_put(a, sh), db.payload),
+        jax.device_put(db.ts, sh), jax.device_put(db.valid, sh),
+        watermark=db.watermark, size=db.known_size)
+
+
+# ---------------------------------------------------------------------------
+# Keyed reduce over the mesh (reference Reduce_GPU + cross-replica merge;
+# BASELINE.json: "keyby-sharded Reduce … linear scaling to 8 chips").
+# ---------------------------------------------------------------------------
+
+def _dense_keyed_partial(keys, vals, valid, comb, K):
+    """Per-chip dense partial table: sort by key, segmented scan, scatter the
+    segment tails into rows of a ``[K, ...]`` table.  The XLA/ICI-friendly
+    replacement for ``thrust::sort_by_key`` + ``reduce_by_key``
+    (``reduce_gpu.hpp:227-258``) producing a *dense* table so cross-chip
+    combination is a collective, not a re-shuffle."""
+    sk = jnp.where(valid & (keys >= 0) & (keys < K), keys, K)
+    order = jnp.argsort(sk)
+    sk_s = sk[order]
+    sv = jax.tree.map(lambda a: a[order], vals)
+    starts = jnp.concatenate([jnp.array([True]), sk_s[1:] != sk_s[:-1]])
+    scanned = _seg_scan(comb, starts, sv)
+    ends = jnp.concatenate([sk_s[:-1] != sk_s[1:], jnp.array([True])])
+    row = jnp.where(ends & (sk_s < K), sk_s, K)
+
+    def scat(leaf):
+        buf = jnp.zeros((K + 1,) + leaf.shape[1:], leaf.dtype)
+        return buf.at[row].set(leaf, mode="drop")[:K]
+
+    table = jax.tree.map(scat, scanned)
+    has = jnp.zeros(K + 1, bool).at[row].set(True)[:K]
+    return table, has
+
+
+def make_sharded_keyed_reduce(mesh: Mesh, capacity: int, K: int,
+                              comb: Callable, key_fn: Callable,
+                              use_psum: bool = False):
+    """Compile a keyed reduce over the whole mesh.
+
+    Input batch lanes are sharded across *all* devices (both axes flattened);
+    each chip reduces its tuple shard into a dense ``[K]`` partial and the
+    partials combine across chips — ``lax.psum`` when the combiner is a sum
+    (``use_psum=True``), otherwise ``all_gather`` + log-fold of the generic
+    associative combiner.  Returns ``fn(payload, valid) -> (table, has)``
+    with both outputs replicated on every chip."""
+    n_total = math.prod(mesh.devices.shape)
+    if capacity % n_total:
+        raise WindFlowError(
+            f"capacity {capacity} not divisible by {n_total} devices")
+    axes = (DATA_AXIS, KEY_AXIS)
+
+    def local(payload, valid):
+        keys = jax.vmap(key_fn)(payload).astype(jnp.int32)
+        table, has = _dense_keyed_partial(keys, payload, valid, comb, K)
+        if use_psum:
+            z = jax.tree.map(lambda a: jnp.where(_b(has, a), a, 0), table)
+            out = jax.tree.map(lambda a: jax.lax.psum(a, axes), z)
+            any_has = jax.lax.psum(has.astype(jnp.int32), axes) > 0
+            return out, any_has
+        g_t = jax.tree.map(
+            lambda a: jax.lax.all_gather(a, axes), table)   # [n, K, ...]
+        g_h = jax.lax.all_gather(has, axes)                 # [n, K]
+        anyf, folded = _masked_reduce_last(comb, g_h, g_t, axis=0)
+        return folded, anyf
+
+    fn = jax.shard_map(local, mesh=mesh,
+                       in_specs=(P(axes), P(axes)),
+                       out_specs=(P(), P()), check_vma=False)
+    return jax.jit(fn)
+
+
+# ---------------------------------------------------------------------------
+# Key-sharded FFAT windows (reference Ffat_Windows_GPU replicas each owning a
+# key subset; here shards of one dense state table own key ranges).
+# ---------------------------------------------------------------------------
+
+def make_sharded_ffat_step(mesh: Mesh, capacity: int, K: int, Pn: int, R: int,
+                           D: int, lift: Callable, comb: Callable,
+                           key_fn: Optional[Callable]):
+    """Compile one FFAT window step sharded over the mesh.
+
+    State tables are split along ``key`` (chip *i* owns keys
+    ``[i*K/kk, (i+1)*K/kk)``); the staged batch arrives data-sharded and is
+    ``all_gather``-ed across ``data`` inside the program so every key shard
+    sees every tuple exactly once over ICI.  Fired-window outputs come back
+    key-sharded, one row block per chip."""
+    kk = mesh.shape[KEY_AXIS]
+    dd = mesh.shape[DATA_AXIS]
+    if K % kk:
+        raise WindFlowError(f"max_keys {K} not divisible by key axis {kk}")
+    if capacity % dd:
+        raise WindFlowError(
+            f"capacity {capacity} not divisible by data axis {dd}")
+    K_local = K // kk
+    step_local = make_ffat_step(
+        capacity, K_local, Pn, R, D, lift, comb, key_fn,
+        key_base_fn=lambda: jax.lax.axis_index(KEY_AXIS) * K_local)
+
+    def local(state, payload, ts, valid):
+        if dd > 1:
+            ag = lambda a: jax.lax.all_gather(a, DATA_AXIS, axis=0, tiled=True)
+            payload = jax.tree.map(ag, payload)
+            ts, valid = ag(ts), ag(valid)
+        return step_local(state, payload, ts, valid)
+
+    fn = jax.shard_map(
+        local, mesh=mesh,
+        in_specs=(P(KEY_AXIS), P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS)),
+        out_specs=(P(KEY_AXIS), P(KEY_AXIS), P(KEY_AXIS), P(KEY_AXIS)),
+        check_vma=False)
+    return jax.jit(fn, donate_argnums=(0,))
+
+
+def make_sharded_ffat_state(agg_spec, K: int, R: int, mesh: Mesh):
+    """Allocate the dense FFAT state pre-sharded along ``key``."""
+    state = make_ffat_state(agg_spec, K, R)
+    sh = state_sharding(mesh)
+    return jax.tree.map(lambda a: jax.device_put(a, sh), state)

@@ -1,0 +1,234 @@
+"""Pure FFAT device-program builders (no operator-layer dependencies).
+
+The segmented-scan / pane / window-firing programs shared by the single-chip
+operator (``windows/ffat_tpu.py``) and the multi-chip sharded path
+(``parallel/mesh.py``).  Kept free of ``ops``/``graph`` imports so the
+distribution layer can use them without cycles.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def _seg_scan(comb, flags, values):
+    """Inclusive segmented scan: within each flagged segment, fold ``comb``.
+    ``values`` is a pytree of [B, ...] leaves; ``flags`` [B] marks segment
+    starts."""
+    def op(a, b):
+        fa, va = a
+        fb, vb = b
+        combined = comb(va, vb)
+        v = jax.tree.map(
+            lambda c, nb: jnp.where(_b(fb, c), nb, c), combined, vb)
+        return (fa | fb, v)
+
+    _, scanned = jax.lax.associative_scan(op, (flags, values))
+    return scanned
+
+
+def _masked_reduce_last(comb, flags, values, axis):
+    """Reduce ``values`` along ``axis`` with ``comb``, skipping entries whose
+    flag is False; returns (any_flag, reduction).  Flag-aware monoid:
+    associative, no identity needed."""
+    def op(a, b):
+        fa, va = a
+        fb, vb = b
+        both = comb(va, vb)
+        v = jax.tree.map(
+            lambda c, xa, xb: jnp.where(_b(fb, c), jnp.where(_b(fa, c), c, xb),
+                                        xa), both, va, vb)
+        return (fa | fb, v)
+
+    f, v = jax.lax.associative_scan(op, (flags, values), axis=axis)
+    take = lambda x: jax.lax.index_in_dim(x, x.shape[axis] - 1, axis,
+                                          keepdims=False)
+    return take(f), jax.tree.map(take, v)
+
+
+def _b(mask, ref):
+    """Broadcast a bool mask against a leaf with trailing dims."""
+    return mask.reshape(mask.shape + (1,) * (ref.ndim - mask.ndim))
+
+
+def make_ffat_step(capacity: int, K: int, P: int, R: int, D: int,
+                   lift: Callable, comb: Callable,
+                   key_fn: Optional[Callable],
+                   key_base_fn: Optional[Callable[[], Any]] = None):
+    """Build the (un-jitted) FFAT per-batch program.
+
+    Pure-function form of the operator step so the multi-chip layer
+    (``parallel/mesh.py``) can trace it *inside* ``shard_map`` with a per-shard
+    key base: when ``key_base_fn`` is given, raw keys are rebased by its traced
+    value, so a chip owning keys ``[base, base+K)`` sees them as ``[0, K)`` and
+    out-of-range keys are masked out (the dense-key sharding answer to the
+    reference's per-key device state, ``ffat_replica_gpu.hpp:438-514``)."""
+    NP1 = capacity // P + 2           # pane cells incl. continuation cell
+    MW = (capacity // P) // D + 2     # max windows fired per batch
+
+    def step(state, payload, ts, valid):
+        B = capacity
+        kb = key_base_fn() if key_base_fn is not None else None
+        keys = jax.vmap(key_fn)(payload).astype(jnp.int32) \
+            if key_fn is not None else jnp.zeros(B, jnp.int32)
+        if kb is not None:
+            keys = keys - jnp.int32(kb)
+        ok = valid & (keys >= 0) & (keys < K)
+        skey_for_sort = jnp.where(ok, keys, K)
+        order = jnp.argsort(skey_for_sort, stable=True)
+        sk = skey_for_sort[order]
+        slift = jax.tree.map(lambda a: a[order],
+                             jax.vmap(lift)(payload))
+        pos = jnp.arange(B)
+        starts = jnp.concatenate([jnp.array([True]), sk[1:] != sk[:-1]])
+        seg_start_pos = jax.lax.associative_scan(
+            jnp.maximum, jnp.where(starts, pos, 0))
+        rank = pos - seg_start_pos
+
+        n_k = jax.ops.segment_sum(ok[order].astype(jnp.int32), sk,
+                                  num_segments=K + 1)[:K]
+        fill0 = state["cur_fill"][jnp.minimum(sk, K - 1)]
+        pane_rel = ((fill0 + rank) // P).astype(jnp.int32)
+
+        # pane partials: segmented scan over (key, pane) runs
+        pane_starts = starts | jnp.concatenate(
+            [jnp.array([True]), pane_rel[1:] != pane_rel[:-1]])
+        scanned = _seg_scan(comb, pane_starts, slift)
+        ends = jnp.concatenate(
+            [(sk[1:] != sk[:-1]) | (pane_rel[1:] != pane_rel[:-1]),
+             jnp.array([True])])
+        # scatter segment-end partials into dense [K+1, NP1] cells
+        row = jnp.where(ends, sk, K)
+        col = jnp.where(ends, pane_rel, 0)
+        def scat(leaf):
+            buf = jnp.zeros((K + 1, NP1) + leaf.shape[1:], leaf.dtype)
+            return buf.at[row, col].set(
+                jnp.where(_b(ends, leaf), leaf, 0))[:K]
+        cells = jax.tree.map(scat, scanned)
+        cell_has = jnp.zeros((K + 1, NP1), bool) \
+            .at[row, col].set(ends)[:K]
+
+        # merge continuation cell with the carried partial pane
+        def merge0(cur_leaf, cell_leaf):
+            both = comb(cur_leaf, cell_leaf[:, 0])
+            use_cur = state["cur_valid"]
+            use_cell = cell_has[:, 0]
+            v = jnp.where(_b(use_cur & use_cell, both), both,
+                          jnp.where(_b(use_cur, both), cur_leaf,
+                                    cell_leaf[:, 0]))
+            return cell_leaf.at[:, 0].set(v)
+        cells = jax.tree.map(
+            lambda cur_leaf, cell_leaf: merge0(cur_leaf, cell_leaf),
+            state["cur"], cells)
+
+        m_k = ((state["cur_fill"] + n_k) // P).astype(jnp.int32)
+        new_fill = ((state["cur_fill"] + n_k) % P).astype(jnp.int32)
+
+        # full pane sequence: carry (R-1 trailing) + this batch's panes
+        full = jax.tree.map(
+            lambda c, p: jnp.concatenate([c, p], axis=1),
+            state["carry"], cells)
+        col_ix = jnp.arange(NP1)[None, :]
+        pane_valid = col_ix < m_k[:, None]
+        full_valid = jnp.concatenate([state["carry_valid"], pane_valid],
+                                     axis=1)
+
+        # fire windows: end panes e = win_next + j*D while e <= done
+        done = state["pane_base"] + m_k
+        j = jnp.arange(MW, dtype=jnp.int64)
+        e = state["win_next"][:, None] + j[None, :] * D        # [K, MW]
+        fired = e <= done[:, None]
+        local_end = (e - state["pane_base"][:, None]
+                     + (R - 1)).astype(jnp.int32)              # exclusive
+        gidx = jnp.clip(local_end[:, :, None] - R
+                        + jnp.arange(R)[None, None, :],
+                        0, R - 1 + NP1 - 1)                    # [K,MW,R]
+
+        def gather_leaf(a):
+            # a: [K, R-1+NP1, ...] -> [K, MW, R, ...]
+            expanded = jnp.broadcast_to(
+                a[:, None], (K, MW) + a.shape[1:])
+            idx = gidx.reshape(K, MW, R, *([1] * (a.ndim - 2)))
+            idx = jnp.broadcast_to(idx, (K, MW, R) + a.shape[2:])
+            return jnp.take_along_axis(expanded, idx, axis=2)
+        wpanes = jax.tree.map(gather_leaf, full)
+        _, wvals = _masked_reduce_last(
+            comb, jnp.ones((K, MW, R), bool), wpanes, axis=2)
+
+        n_fired = jnp.where(
+            fired[:, 0],
+            ((done - state["win_next"]) // D + 1), 0)
+        new_win_next = state["win_next"] + n_fired * D
+
+        # new carry: panes [pane_base+m_k-(R-1), pane_base+m_k)
+        cidx = m_k[:, None] + jnp.arange(R - 1)[None, :]       # [K, R-1]
+        def carry_leaf(a):
+            idx = cidx.reshape(K, R - 1, *([1] * (a.ndim - 2)))
+            idx = jnp.broadcast_to(idx, (K, R - 1) + a.shape[2:])
+            return jnp.take_along_axis(a, idx, axis=1)
+        new_carry = jax.tree.map(carry_leaf, full)
+        new_carry_valid = jnp.take_along_axis(full_valid, cidx, axis=1)
+
+        def cur_leaf(cell_leaf):
+            idx = m_k.reshape(K, 1, *([1] * (cell_leaf.ndim - 2)))
+            idx = jnp.broadcast_to(idx, (K, 1) + cell_leaf.shape[2:])
+            return jnp.take_along_axis(cell_leaf, idx, axis=1)[:, 0]
+        new_cur = jax.tree.map(cur_leaf, cells)
+        new_cur_valid = new_fill > 0
+
+        new_state = {
+            "carry": new_carry,
+            "carry_valid": new_carry_valid,
+            "cur": new_cur,
+            "cur_valid": new_cur_valid,
+            "cur_fill": new_fill,
+            "pane_base": done,
+            "win_next": new_win_next,
+        }
+
+        # output batch: one row per (key, window-slot)
+        wid = (e - R) // D
+        out_keys = jnp.broadcast_to(
+            jnp.arange(K, dtype=jnp.int32)[:, None], (K, MW))
+        if kb is not None:
+            out_keys = out_keys + jnp.int32(kb)
+        out_ts = jnp.broadcast_to(
+            jnp.max(jnp.where(valid, ts, 0)), (K, MW))
+        out = {
+            "key": out_keys.reshape(-1),
+            "wid": wid.reshape(-1),
+            "value": jax.tree.map(
+                lambda a: a.reshape((K * MW,) + a.shape[2:]), wvals),
+        }
+        return new_state, out, fired.reshape(-1), out_ts.reshape(-1)
+
+    return step
+
+
+def make_ffat_state(agg_spec, K: int, R: int):
+    """Dense per-key FFAT device state over a static key space ``[0, K)``
+    (see :class:`FfatWindowsTPU` for the layout)."""
+    zeros = lambda shape: jax.tree.map(
+        lambda s: jnp.zeros(shape + s.shape, s.dtype), agg_spec)
+    return {
+        "carry": zeros((K, R - 1)),               # trailing R-1 panes
+        "carry_valid": jnp.zeros((K, R - 1), bool),
+        "cur": zeros((K,)),                       # partial pane aggregate
+        "cur_valid": jnp.zeros((K,), bool),
+        "cur_fill": jnp.zeros((K,), jnp.int32),   # tuples in partial pane
+        "pane_base": jnp.zeros((K,), jnp.int64),  # completed panes
+        "win_next": jnp.full((K,), R, jnp.int64),  # next end pane
+    }
+
+
+def agg_spec_for(lift: Callable, payload_tree) -> Any:
+    """Shape/dtype skeleton of one aggregate, from a batch payload pytree."""
+    one = jax.tree.map(
+        lambda a: jax.ShapeDtypeStruct(a.shape[1:], a.dtype), payload_tree)
+    spec = jax.eval_shape(lift, one)
+    return jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype), spec)
+
+
