@@ -1,0 +1,201 @@
+"""Merge/split topology tests in the reference's metamorphic-oracle style
+(``/root/reference/tests/split_tests/``, ``tests/merge_tests/``, and the
+``*_gpu`` variants): randomized parallelism/batch sweeps over DAGs with
+splits and merges must reproduce run 0's sink accumulations exactly; TPU
+variants mix device operators into the same topologies."""
+
+import random
+
+import pytest
+
+import windflow_tpu as wf
+
+
+def stream(n_keys, length):
+    return [{"key": i % n_keys, "value": i} for i in range(length)]
+
+
+class Acc:
+    def __init__(self):
+        self.total = 0
+        self.count = 0
+
+    def __call__(self, item, ctx=None):
+        if item is not None:
+            self.total += int(item["value"])
+            self.count += 1
+
+    @property
+    def pair(self):
+        return (self.total, self.count)
+
+
+def run_split(mode, length, n_keys, par, batch):
+    """Source → Map → split(2): even keys → Filter → Sink0,
+    odd keys → Map(+100) → Sink1 (reference split_tests DAG shape)."""
+    a0, a1 = Acc(), Acc()
+    src = (wf.Source_Builder(lambda: iter(stream(n_keys, length)))
+           .withOutputBatchSize(batch).build())
+    pre = (wf.Map_Builder(lambda t: dict(t))
+           .withParallelism(par[0]).withOutputBatchSize(batch).build())
+    g = wf.PipeGraph("split", mode)
+    mp = g.add_source(src).add(pre)
+    mp.split(lambda t: t["key"] % 2, 2)
+    (mp.select(0)
+       .add(wf.Filter_Builder(lambda t: t["value"] % 3 == 0)
+            .withParallelism(par[1]).withOutputBatchSize(batch).build())
+       .add_sink(wf.Sink_Builder(a0).withParallelism(par[2]).build()))
+    (mp.select(1)
+       .add(wf.Map_Builder(lambda t: {"key": t["key"],
+                                      "value": t["value"] + 100})
+            .withParallelism(par[3]).withOutputBatchSize(batch).build())
+       .add_sink(wf.Sink_Builder(a1).withParallelism(par[4]).build()))
+    g.run()
+    return a0.pair, a1.pair
+
+
+@pytest.mark.parametrize("mode", [wf.ExecutionMode.DEFAULT,
+                                  wf.ExecutionMode.DETERMINISTIC])
+def test_split_metamorphic(mode):
+    rnd = random.Random(11)
+    length, n_keys = 900, 6
+    reference = None
+    for run in range(5):
+        par = [rnd.randint(1, 4) for _ in range(5)]
+        batch = rnd.randint(1, 9)
+        got = run_split(mode, length, n_keys, par, batch)
+        if reference is None:
+            reference = got
+        else:
+            assert got == reference, f"run {run} diverged par={par}"
+    # oracle: branch totals computed in plain python
+    ev = [t for t in stream(n_keys, length) if t["key"] % 2 == 0]
+    od = [t for t in stream(n_keys, length) if t["key"] % 2 == 1]
+    exp0 = sum(t["value"] for t in ev if t["value"] % 3 == 0)
+    exp1 = sum(t["value"] + 100 for t in od)
+    assert reference[0][0] == exp0
+    assert reference[1][0] == exp1
+
+
+def test_split_multicast():
+    """A split function returning an iterable multicasts the tuple to several
+    branches (reference splitting signatures, splitting_emitter.hpp:54-62)."""
+    length = 300
+    a0, a1 = Acc(), Acc()
+    src = (wf.Source_Builder(lambda: iter(stream(3, length)))
+           .withOutputBatchSize(5).build())
+    pre = wf.Map_Builder(lambda t: dict(t)).withOutputBatchSize(5).build()
+    g = wf.PipeGraph("split_mc", wf.ExecutionMode.DEFAULT)
+    mp = g.add_source(src).add(pre)
+    mp.split(lambda t: (0, 1) if t["key"] == 0 else (t["key"] % 2,), 2)
+    mp.select(0).add_sink(wf.Sink_Builder(a0).build())
+    mp.select(1).add_sink(wf.Sink_Builder(a1).build())
+    g.run()
+    exp0 = sum(t["value"] for t in stream(3, length) if t["key"] in (0, 2))
+    exp1 = sum(t["value"] for t in stream(3, length) if t["key"] in (0, 1))
+    assert a0.total == exp0
+    assert a1.total == exp1
+
+
+def run_merge(mode, length, par, batch):
+    """Two sources → (Map, Filter) → merge → Map → Sink (reference
+    merge_tests shape: DAG fan-in via PipeGraph LCA)."""
+    acc = Acc()
+    g = wf.PipeGraph("merge", mode)
+    s1 = (wf.Source_Builder(lambda: iter(stream(4, length)))
+          .withOutputBatchSize(batch).build())
+    s2 = (wf.Source_Builder(
+            lambda: iter([{"key": 9, "value": 1000 + i}
+                          for i in range(length // 2)]))
+          .withOutputBatchSize(batch).build())
+    p1 = g.add_source(s1).add(
+        wf.Map_Builder(lambda t: {"key": t["key"], "value": t["value"] * 2})
+        .withParallelism(par[0]).withOutputBatchSize(batch).build())
+    p2 = g.add_source(s2).add(
+        wf.Filter_Builder(lambda t: t["value"] % 2 == 0)
+        .withParallelism(par[1]).withOutputBatchSize(batch).build())
+    merged = p1.merge(p2)
+    merged.add(
+        wf.Map_Builder(lambda t: {"key": t["key"], "value": t["value"] + 1})
+        .withParallelism(par[2]).withOutputBatchSize(batch).build())
+    merged.add_sink(wf.Sink_Builder(acc).withParallelism(par[3]).build())
+    g.run()
+    return acc.pair
+
+
+@pytest.mark.parametrize("mode", [wf.ExecutionMode.DEFAULT,
+                                  wf.ExecutionMode.DETERMINISTIC])
+def test_merge_metamorphic(mode):
+    rnd = random.Random(5)
+    length = 700
+    reference = None
+    for run in range(5):
+        par = [rnd.randint(1, 4) for _ in range(4)]
+        batch = rnd.randint(1, 8)
+        got = run_merge(mode, length, par, batch)
+        if reference is None:
+            reference = got
+        else:
+            assert got == reference, f"run {run} diverged par={par}"
+    exp = sum(2 * t["value"] + 1 for t in stream(4, length))
+    exp += sum(v + 1 for v in range(1000, 1000 + length // 2) if v % 2 == 0)
+    assert reference[0] == exp
+
+
+def test_split_with_tpu_branch():
+    """Split where one branch runs on TPU (reference split_tests_gpu): host
+    branch and device branch must both see exactly their tuples."""
+    length = 400
+    a0, a1 = Acc(), Acc()
+    src = (wf.Source_Builder(lambda: iter(stream(4, length)))
+           .withOutputBatchSize(16).build())
+    pre = wf.Map_Builder(lambda t: dict(t)).withOutputBatchSize(16).build()
+    g = wf.PipeGraph("split_tpu", wf.ExecutionMode.DEFAULT)
+    mp = g.add_source(src).add(pre)
+    mp.split(lambda t: 0 if t["key"] < 2 else 1, 2)
+    (mp.select(0)
+       .add(wf.MapTPU_Builder(
+            lambda t: {"key": t["key"], "value": t["value"] * 3}).build())
+       .add_sink(wf.Sink_Builder(a0).build()))
+    (mp.select(1)
+       .add(wf.Map_Builder(lambda t: {"key": t["key"],
+                                      "value": t["value"] * 5})
+            .withOutputBatchSize(8).build())
+       .add_sink(wf.Sink_Builder(a1).build()))
+    g.run()
+    exp0 = sum(3 * t["value"] for t in stream(4, length) if t["key"] < 2)
+    exp1 = sum(5 * t["value"] for t in stream(4, length) if t["key"] >= 2)
+    assert a0.total == exp0
+    assert a1.total == exp1
+
+
+def test_merge_into_tpu_keyed_reduce():
+    """Merged pipes feeding a keyed TPU reduce (reference merge_tests_gpu
+    ``_kb_`` variants): per-key sums must match the host oracle."""
+    length = 360
+    sums = {}
+
+    def sink_fn(t, ctx=None):
+        if t is not None:
+            sums[int(t["key"])] = sums.get(int(t["key"]), 0) + int(t["value"])
+
+    g = wf.PipeGraph("merge_tpu", wf.ExecutionMode.DEFAULT)
+    s1 = (wf.Source_Builder(lambda: iter(stream(4, length)))
+          .withOutputBatchSize(16).build())
+    s2 = (wf.Source_Builder(lambda: iter(stream(4, length)))
+          .withOutputBatchSize(16).build())
+    p1 = g.add_source(s1).add(
+        wf.Map_Builder(lambda t: dict(t)).withOutputBatchSize(16).build())
+    p2 = g.add_source(s2).add(
+        wf.Map_Builder(lambda t: dict(t)).withOutputBatchSize(16).build())
+    merged = p1.merge(p2)
+    merged.add(
+        wf.ReduceTPU_Builder(
+            lambda a, b: {"key": a["key"], "value": a["value"] + b["value"]})
+        .withKeyBy(lambda t: t["key"]).build())
+    merged.add_sink(wf.Sink_Builder(sink_fn).build())
+    g.run()
+    exp = {}
+    for t in stream(4, length) * 2:
+        exp[t["key"]] = exp.get(t["key"], 0) + t["value"]
+    assert sums == exp

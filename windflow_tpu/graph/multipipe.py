@@ -33,8 +33,25 @@ class MultiPipe:
         self.merged_into: Optional["MultiPipe"] = None
         self.split_children: List["MultiPipe"] = []
         self.split_fn = None
+        self.split_parent: Optional["MultiPipe"] = None
+        self.merge_parents: List["MultiPipe"] = []
         # Edges are (upstream_op, downstream_op, routing) triples resolved at
         # wiring time; intra-pipe edges are implicit in `operators` order.
+
+    @classmethod
+    def _empty(cls, graph: "PipeGraph") -> "MultiPipe":
+        """A source-less pipe: a split branch or a merge result."""
+        mp = cls.__new__(cls)
+        mp.graph = graph
+        mp.operators = []
+        mp.has_sink = False
+        mp.has_source = False
+        mp.merged_into = None
+        mp.split_children = []
+        mp.split_fn = None
+        mp.split_parent = None
+        mp.merge_parents = []
+        return mp
 
     # -- composition ---------------------------------------------------------
     def _check_open(self):
@@ -59,21 +76,38 @@ class MultiPipe:
         self._check_open()
         if isinstance(op, Source):
             raise WindFlowError("a Source can only start a MultiPipe")
-        prev = self.operators[-1]
-        if op.is_tpu and prev.output_batch_size <= 0 and not prev.is_tpu:
-            raise WindFlowError(
-                f"TPU operator '{op.name}' must be preceded by an operator "
-                "with output batch size > 0 (reference multipipe.hpp:441-444)")
+        for prev in self._upstream_ops():
+            if op.is_tpu and prev.output_batch_size <= 0 and not prev.is_tpu:
+                raise WindFlowError(
+                    f"TPU operator '{op.name}' must be preceded by an "
+                    "operator with output batch size > 0 (reference "
+                    "multipipe.hpp:441-444)")
         self.operators.append(op)
         return self
+
+    def _upstream_ops(self) -> List[Operator]:
+        """Operators feeding the next appended operator: the pipe's own tail,
+        or — for a fresh split branch / merged pipe — the tails of the parent
+        pipes (the reference resolves these via the Application Tree,
+        ``pipegraph.hpp:268-464``)."""
+        if self.operators:
+            return [self.operators[-1]]
+        if self.split_parent is not None:
+            return self.split_parent._upstream_ops()
+        if self.merge_parents:
+            return [p.operators[-1] for p in self.merge_parents
+                    if p.operators]
+        return []
 
     def chain(self, op: Operator) -> "MultiPipe":
         """Fuse ``op`` with the previous stage when possible: same parallelism
         and FORWARD routing (reference conditions, ``multipipe.hpp:553``);
         otherwise falls back to ``add`` exactly like the reference."""
         from windflow_tpu.ops.reduce_op import Reduce
-        if hasattr(op, "stages") or isinstance(op, Reduce):
-            # composites and Reduce cannot be chained (multipipe.hpp:1042-1045)
+        if hasattr(op, "stages") or isinstance(op, Reduce) \
+                or not self.operators:
+            # composites and Reduce cannot be chained (multipipe.hpp:1042-1045);
+            # a fresh split branch / merged pipe has nothing to fuse with
             return self.add(op)
         prev = self.operators[-1]
         can_fuse = (op.routing == RoutingMode.FORWARD
@@ -103,16 +137,13 @@ class MultiPipe:
         """Split this MultiPipe into ``n_branches`` children; ``split_fn(item)``
         returns a destination index or an iterable of indexes."""
         self._check_open()
+        if not self.operators:
+            raise WindFlowError(
+                "cannot split an empty MultiPipe — add an operator to this "
+                "branch first")
         self.split_fn = split_fn
         for _ in range(n_branches):
-            child = MultiPipe.__new__(MultiPipe)
-            child.graph = self.graph
-            child.operators = []
-            child.has_sink = False
-            child.has_source = False
-            child.merged_into = None
-            child.split_children = []
-            child.split_fn = None
+            child = MultiPipe._empty(self.graph)
             child.split_parent = self
             self.split_children.append(child)
         self.graph._register_split(self)
@@ -129,14 +160,7 @@ class MultiPipe:
         pipes = [self, *others]
         for p in pipes:
             p._check_open()
-        merged = MultiPipe.__new__(MultiPipe)
-        merged.graph = self.graph
-        merged.operators = []
-        merged.has_sink = False
-        merged.has_source = False
-        merged.merged_into = None
-        merged.split_children = []
-        merged.split_fn = None
+        merged = MultiPipe._empty(self.graph)
         merged.merge_parents = pipes
         for p in pipes:
             p.merged_into = merged

@@ -65,11 +65,26 @@ class PipeGraph:
         self.pipes.append(mp)
 
     # -- wiring --------------------------------------------------------------
+    def _all_pipes(self):
+        """Every MultiPipe in the graph, including transitive split branches
+        (the single traversal used by both replica construction and edge
+        wiring, so the two can never diverge)."""
+        out = []
+
+        def collect(mp: MultiPipe):
+            out.append(mp)
+            for child in mp.split_children:
+                collect(child)
+
+        for mp in self.pipes:
+            collect(mp)
+        return out
+
     def _edges(self):
         """Yield (src_op, dst_op_or_split, routing) for every graph edge, in
         topological order of the MultiPipe DAG."""
         edges = []
-        for mp in self.pipes:
+        for mp in self._all_pipes():
             ops = mp.operators
             for a, b in zip(ops, ops[1:]):
                 edges.append(("op", a, b))
@@ -86,16 +101,12 @@ class PipeGraph:
     def _build(self) -> None:
         # 1. instantiate replicas
         seen = set()
-        def visit(mp: MultiPipe):
+        for mp in self._all_pipes():
             for op in mp.operators:
                 if id(op) not in seen:
                     seen.add(id(op))
                     self._operators.append(op)
                     op.build_replicas(self.mode, self.time_policy)
-            for child in mp.split_children:
-                visit(child)
-        for mp in self.pipes:
-            visit(mp)
         for op in self._operators:
             self._all_replicas.extend(op.replicas)
             if isinstance(op, Source):
