@@ -142,6 +142,23 @@ def _pad_leading(arr: np.ndarray, capacity: int) -> np.ndarray:
     return np.pad(arr, pad)
 
 
+def _stage_soa(soa, tss, n: int, capacity: int, watermark: int,
+               device) -> DeviceBatch:
+    """Shared staging tail: pad an SoA numpy pytree + timestamps to
+    ``capacity``, build the validity mask, optionally pin to a device."""
+    payload = jax.tree.map(
+        lambda a: jnp.asarray(_pad_leading(np.ascontiguousarray(a),
+                                           capacity)), soa)
+    ts = jnp.asarray(_pad_leading(np.asarray(tss, dtype=np.int64), capacity),
+                     dtype=TS_DTYPE)
+    valid = jnp.asarray(np.arange(capacity) < n)
+    if device is not None:
+        payload = jax.device_put(payload, device)
+        ts = jax.device_put(ts, device)
+        valid = jax.device_put(valid, device)
+    return DeviceBatch(payload, ts, valid, watermark=watermark, size=n)
+
+
 def host_to_device(batch: HostBatch, capacity: Optional[int] = None,
                    device=None) -> DeviceBatch:
     """Stage a HostBatch into device buffers, padding to ``capacity``."""
@@ -151,16 +168,22 @@ def host_to_device(batch: HostBatch, capacity: Optional[int] = None,
     cap = capacity or n
     if n > cap:
         raise ValueError(f"batch of {n} items exceeds capacity {cap}")
-    soa = _stack_records(batch.items)
-    payload = jax.tree.map(lambda a: jnp.asarray(_pad_leading(a, cap)), soa)
-    ts = jnp.asarray(_pad_leading(np.asarray(batch.tss, dtype=np.int64), cap),
-                     dtype=TS_DTYPE)
-    valid = jnp.asarray(np.arange(cap) < n)
-    if device is not None:
-        payload = jax.device_put(payload, device)
-        ts = jax.device_put(ts, device)
-        valid = jax.device_put(valid, device)
-    return DeviceBatch(payload, ts, valid, watermark=batch.watermark, size=n)
+    return _stage_soa(_stack_records(batch.items), batch.tss, n, cap,
+                      batch.watermark, device)
+
+
+def columns_to_device(cols, tss, capacity: int, watermark: int = WM_NONE,
+                      device=None) -> DeviceBatch:
+    """Stage columnar (SoA numpy) data directly into a DeviceBatch — the
+    zero-per-tuple-Python path used by bulk sources (windflow_tpu/io) and the
+    columnar staging emitter.  ``cols`` is a dict of [n]-leading numpy
+    arrays, ``tss`` an int64 [n] array; n must be <= capacity."""
+    n = len(tss)
+    if n == 0:
+        raise ValueError("cannot stage an empty column batch")
+    if n > capacity:
+        raise ValueError(f"column batch of {n} exceeds capacity {capacity}")
+    return _stage_soa(dict(cols), tss, n, capacity, watermark, device)
 
 
 def device_to_host(batch: DeviceBatch) -> HostBatch:

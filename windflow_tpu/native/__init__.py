@@ -1,0 +1,259 @@
+"""ctypes bindings for the native host runtime (``native/wf_host.cpp``).
+
+The native layer mirrors the reference's C++ runtime surface (SURVEY.md §2.1
+recycling pools, §2.2 keyby hashing, §5.8 lock-free queues): bulk ingest
+parsing, key partitioning, throttled buffer pools, and an SPSC ring.  The
+library is built on demand with ``make -C native`` and loaded via ctypes;
+every entry point has a numpy fallback so the framework works (slower)
+without a C++ toolchain.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+from typing import Optional
+
+import numpy as np
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+_NATIVE_DIR = os.path.join(_REPO_ROOT, "native")
+_SO_PATH = os.path.join(_NATIVE_DIR, "libwfhost.so")
+
+_lib = None
+_load_attempted = False
+
+
+def _build() -> bool:
+    src = os.path.join(_NATIVE_DIR, "wf_host.cpp")
+    if not os.path.exists(src):
+        return False
+    try:
+        subprocess.run(["make", "-C", _NATIVE_DIR], check=True,
+                       capture_output=True, timeout=120)
+        return os.path.exists(_SO_PATH)
+    except Exception:
+        return False
+
+
+def lib() -> Optional[ctypes.CDLL]:
+    """The loaded native library, building it first if needed; None when the
+    toolchain or sources are unavailable (callers fall back to numpy)."""
+    global _lib, _load_attempted
+    if _lib is not None or _load_attempted:
+        return _lib
+    _load_attempted = True
+    if os.environ.get("WF_TPU_NO_NATIVE"):
+        return None
+    src = os.path.join(_NATIVE_DIR, "wf_host.cpp")
+    stale = (not os.path.exists(_SO_PATH)
+             or (os.path.exists(src)
+                 and os.path.getmtime(src) > os.path.getmtime(_SO_PATH)))
+    if stale and not _build():
+        return None
+    try:
+        L = ctypes.CDLL(_SO_PATH)
+    except OSError:
+        return None
+    i8, i4, u8 = ctypes.c_int64, ctypes.c_int32, ctypes.c_uint64
+    p = ctypes.c_void_p
+    L.wf_hash64.restype = u8
+    L.wf_hash64.argtypes = [i8]
+    L.wf_keyby_partition.restype = None
+    L.wf_keyby_partition.argtypes = [p, i8, i4, p, p]
+    L.wf_partition_offsets.restype = None
+    L.wf_partition_offsets.argtypes = [p, i8, i4, p]
+    L.wf_frame_record_bytes.restype = i8
+    L.wf_frame_record_bytes.argtypes = [i4]
+    L.wf_parse_frames.restype = i8
+    L.wf_parse_frames.argtypes = [p, i8, i4, p, p, p, i8]
+    L.wf_parse_csv.restype = i8
+    L.wf_parse_csv.argtypes = [p, i8, i4, p, p, p, i8, p]
+    L.wf_pool_create.restype = p
+    L.wf_pool_create.argtypes = [i8, i4]
+    L.wf_pool_destroy.argtypes = [p]
+    L.wf_pool_acquire.restype = p
+    L.wf_pool_acquire.argtypes = [p]
+    L.wf_pool_release.argtypes = [p, p]
+    L.wf_pool_outstanding.restype = i4
+    L.wf_pool_outstanding.argtypes = [p]
+    L.wf_ring_create.restype = p
+    L.wf_ring_create.argtypes = [i8]
+    L.wf_ring_destroy.argtypes = [p]
+    L.wf_ring_push.restype = i4
+    L.wf_ring_push.argtypes = [p, p]
+    L.wf_ring_pop.restype = p
+    L.wf_ring_pop.argtypes = [p]
+    L.wf_ring_size.restype = i8
+    L.wf_ring_size.argtypes = [p]
+    L.wf_min_watermark.restype = i8
+    L.wf_min_watermark.argtypes = [p, i4, i8]
+    _lib = L
+    return _lib
+
+
+def is_available() -> bool:
+    return lib() is not None
+
+
+def _ptr(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.c_void_p)
+
+
+# ---------------------------------------------------------------------------
+# High-level wrappers (numpy in / numpy out, with pure-numpy fallbacks)
+# ---------------------------------------------------------------------------
+
+_SM_C1 = np.uint64(0xBF58476D1CE4E5B9)
+_SM_C2 = np.uint64(0x94D049BB133111EB)
+_SM_ADD = np.uint64(0x9E3779B97F4A7C15)
+
+
+def hash64(keys: np.ndarray) -> np.ndarray:
+    """Vectorized splitmix64 (matches the native wf_hash64 bit-for-bit)."""
+    x = keys.astype(np.uint64) + _SM_ADD
+    with np.errstate(over="ignore"):
+        x = (x ^ (x >> np.uint64(30))) * _SM_C1
+        x = (x ^ (x >> np.uint64(27))) * _SM_C2
+    return x ^ (x >> np.uint64(31))
+
+
+def keyby_partition(keys: np.ndarray, ndest: int):
+    """(dests int32[n], counts int64[ndest]): hash-routing of each tuple."""
+    keys = np.ascontiguousarray(keys, dtype=np.int64)
+    n = len(keys)
+    L = lib()
+    if L is not None:
+        dests = np.empty(n, np.int32)
+        counts = np.empty(ndest, np.int64)
+        L.wf_keyby_partition(_ptr(keys), n, ndest, _ptr(dests), _ptr(counts))
+        return dests, counts
+    dests = (hash64(keys) % np.uint64(ndest)).astype(np.int32)
+    counts = np.bincount(dests, minlength=ndest).astype(np.int64)
+    return dests, counts
+
+
+def frame_record_bytes(nv: int) -> int:
+    return 16 + 8 * nv
+
+
+def parse_frames(buf: bytes, nv: int, max_records: int = 2 ** 62):
+    """Parse binary records (int64 key, int64 ts, nv×float64) into columns.
+    Returns (keys, tss, vals[n, nv], consumed_bytes)."""
+    rec = frame_record_bytes(nv)
+    n = min(len(buf) // rec, max_records)
+    L = lib()
+    if L is not None:
+        keys = np.empty(n, np.int64)
+        tss = np.empty(n, np.int64)
+        vals = np.empty((n, nv), np.float64)
+        raw = np.frombuffer(buf, np.uint8)
+        got = L.wf_parse_frames(_ptr(raw), len(buf), nv, _ptr(keys),
+                                _ptr(tss), _ptr(vals), n)
+        assert got == n
+        return keys, tss, vals, n * rec
+    arr = np.frombuffer(buf[:n * rec], np.uint8).reshape(n, rec)
+    keys = arr[:, 0:8].copy().view(np.int64).reshape(n)
+    tss = arr[:, 8:16].copy().view(np.int64).reshape(n)
+    vals = arr[:, 16:].copy().view(np.float64).reshape(n, nv)
+    return keys, tss, vals, n * rec
+
+
+def parse_csv(buf: bytes, nv: int, max_records: int = 2 ** 62):
+    """Parse "key,ts,v0[,v1...]\\n" lines into columns.
+    Returns (keys, tss, vals[n, nv], consumed_bytes)."""
+    L = lib()
+    if L is not None:
+        cap = min(max_records, buf.count(b"\n") + 1)
+        keys = np.empty(cap, np.int64)
+        tss = np.empty(cap, np.int64)
+        vals = np.empty((cap, nv), np.float64)
+        consumed = np.zeros(1, np.int64)
+        raw = np.frombuffer(buf, np.uint8)
+        n = L.wf_parse_csv(_ptr(raw), len(buf), nv, _ptr(keys), _ptr(tss),
+                           _ptr(vals), cap, _ptr(consumed))
+        return keys[:n].copy(), tss[:n].copy(), vals[:n].copy(), \
+            int(consumed[0])
+    keys, tss, rows = [], [], []
+    consumed = 0
+    for line in buf.split(b"\n")[:-1]:
+        end = consumed + len(line) + 1
+        if len(keys) >= max_records:
+            break
+        consumed = end
+        parts = line.split(b",")
+        if len(parts) != 2 + nv:
+            continue
+        try:
+            k, t = int(parts[0]), int(parts[1])
+            vs = [float(x) for x in parts[2:]]
+        except ValueError:
+            continue
+        keys.append(k)
+        tss.append(t)
+        rows.append(vs)
+    return (np.array(keys, np.int64), np.array(tss, np.int64),
+            np.array(rows, np.float64).reshape(len(keys), nv), consumed)
+
+
+class BufferPool:
+    """Throttled recycling pool of fixed-size host buffers (reference
+    ``recycling_gpu.hpp:88-126``): at most ``capacity`` buffers outstanding;
+    ``acquire`` returns None when the cap is hit (caller backs off)."""
+
+    def __init__(self, buf_bytes: int, capacity: int) -> None:
+        self.buf_bytes = buf_bytes
+        self.capacity = capacity
+        self._L = lib()
+        if self._L is not None:
+            self._pool = self._L.wf_pool_create(buf_bytes, capacity)
+        else:
+            self._free: list = []
+            self._outstanding = 0
+
+    def acquire(self):
+        if self._L is not None:
+            addr = self._L.wf_pool_acquire(self._pool)
+            if not addr:
+                return None
+            return (ctypes.c_uint8 * self.buf_bytes).from_address(addr), addr
+        if self._outstanding >= self.capacity:
+            return None
+        self._outstanding += 1
+        buf = self._free.pop() if self._free \
+            else np.empty(self.buf_bytes, np.uint8)
+        return buf, id(buf)
+
+    def release(self, handle) -> None:
+        buf, addr = handle
+        if self._L is not None:
+            self._L.wf_pool_release(self._pool, addr)
+        else:
+            self._outstanding -= 1
+            self._free.append(buf)
+
+    @property
+    def outstanding(self) -> int:
+        if self._L is not None:
+            return self._L.wf_pool_outstanding(self._pool)
+        return self._outstanding
+
+    def __del__(self):
+        if getattr(self, "_L", None) is not None \
+                and getattr(self, "_pool", None):
+            self._L.wf_pool_destroy(self._pool)
+            self._pool = None
+
+
+def min_watermark(channel_wms: np.ndarray, wm_none: int) -> int:
+    """Min over channel maxima; wm_none if any channel is still unset."""
+    channel_wms = np.ascontiguousarray(channel_wms, np.int64)
+    L = lib()
+    if L is not None:
+        return int(L.wf_min_watermark(_ptr(channel_wms), len(channel_wms),
+                                      wm_none))
+    if (channel_wms == wm_none).any() or len(channel_wms) == 0:
+        return wm_none
+    return int(channel_wms.min())

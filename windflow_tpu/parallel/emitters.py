@@ -29,9 +29,11 @@ from __future__ import annotations
 import zlib
 from typing import Any, Callable, List, Optional, Sequence, Tuple
 
+import numpy as np
+
 from windflow_tpu.basic import RoutingMode, WindFlowError
 from windflow_tpu.batch import (DeviceBatch, HostBatch, Punctuation, WM_NONE,
-                                host_to_device)
+                                columns_to_device, host_to_device)
 
 
 def stable_hash(key: Any) -> int:
@@ -95,6 +97,18 @@ class Emitter:
     def emit_device_batch(self, batch: DeviceBatch) -> None:
         raise NotImplementedError
 
+    # -- columnar interface (bulk sources, windflow_tpu/io) -----------------
+    def emit_columns(self, cols, tss, wm: int) -> None:
+        """Emit a block of tuples given as SoA numpy columns.  The default
+        explodes to per-tuple records (host destinations care about items,
+        not layout); the device staging emitter overrides this with a
+        zero-per-tuple path."""
+        names = list(cols)
+        arrs = [cols[n] for n in names]
+        for i in range(len(tss)):
+            item = {n: a[i].item() for n, a in zip(names, arrs)}
+            self.emit(item, int(tss[i]), wm)
+
     def propagate_punctuation(self, wm: int) -> None:
         """Flush open batches, then multicast a watermark punctuation
         (reference ``forward_emitter.hpp:226-262``)."""
@@ -109,6 +123,10 @@ class Emitter:
     def _send(self, dest_idx: int, msg) -> None:
         replica, ch = self.dests[dest_idx]
         replica.receive(ch, msg)
+
+
+def _concat(arrs):
+    return arrs[0] if len(arrs) == 1 else np.concatenate(arrs)
 
 
 class _OpenBatch:
@@ -229,13 +247,61 @@ class DeviceStageEmitter(Emitter):
         super().__init__(dests, output_batch_size)
         self._ob = _OpenBatch()
         self._next = 0
+        # columnar accumulation: list of (cols dict, tss) chunks + row count
+        self._col_chunks = []
+        self._col_rows = 0
+        self._col_wm = WM_NONE
 
     def emit(self, item, ts, wm):
         self._ob.add(item, ts, wm)
         if len(self._ob.items) >= self.output_batch_size:
             self.flush(wm)
 
+    def emit_columns(self, cols, tss, wm):
+        """Columnar fast path: accumulate SoA chunks, stage full batches with
+        one concatenate + one transfer (reference pinned staging without the
+        per-tuple fill loop, ``forward_emitter_gpu.hpp:254-300``)."""
+        self._col_chunks.append((cols, tss))
+        self._col_rows += len(tss)
+        self._col_wm = wm if self._col_wm == WM_NONE else min(self._col_wm,
+                                                              wm)
+        cap = self.output_batch_size
+        if self._col_rows >= cap:
+            names = list(self._col_chunks[0][0])
+            cat = {n: _concat([c[0][n] for c in self._col_chunks])
+                   for n in names}
+            tcat = _concat([c[1] for c in self._col_chunks])
+            total = len(tcat)
+            for lo in range(0, total - total % cap, cap):
+                self._stage_columns(
+                    {n: a[lo:lo + cap] for n, a in cat.items()},
+                    tcat[lo:lo + cap], self._col_wm)
+            rem = total % cap
+            self._col_chunks = [] if rem == 0 else [
+                ({n: a[total - rem:] for n, a in cat.items()},
+                 tcat[total - rem:])]
+            self._col_rows = rem
+            # remaining rows are the tail of the newest chunk
+            self._col_wm = wm if rem else WM_NONE
+
+    def _stage_columns(self, cols, tss, wm):
+        db = columns_to_device(cols, tss, self.output_batch_size,
+                               watermark=wm)
+        d = self._next
+        self._next = (self._next + 1) % len(self.dests)
+        self._send(d, db)
+
     def flush(self, wm):
+        if self._col_chunks:
+            names = list(self._col_chunks[0][0])
+            cat = {n: _concat([c[0][n] for c in self._col_chunks])
+                   for n in names}
+            tcat = _concat([c[1] for c in self._col_chunks])
+            self._col_chunks = []
+            self._col_rows = 0
+            w = self._col_wm if self._col_wm != WM_NONE else wm
+            self._col_wm = WM_NONE
+            self._stage_columns(cat, tcat, w)
         if not self._ob.items:
             return
         hb = HostBatch(self._ob.items, self._ob.tss, self._ob.wm)
