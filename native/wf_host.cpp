@@ -85,6 +85,7 @@ int64_t wf_parse_csv(const char* buf, int64_t nbytes, int32_t nv,
                      int64_t* keys, int64_t* tss, double* vals,
                      int64_t max_records, int64_t* consumed_out) {
   int64_t n = 0, pos = 0;
+  std::vector<char> scratch(512);
   while (n < max_records) {
     // find end of line
     int64_t eol = pos;
@@ -93,17 +94,19 @@ int64_t wf_parse_csv(const char* buf, int64_t nbytes, int32_t nv,
     // copy the line into a NUL-terminated scratch so strto* cannot scan
     // past the newline (a field like "5,50,\n6" must not steal digits from
     // the next line) or past the end of the buffer
-    char line[512];
     int64_t len = eol - pos;
-    if (len >= (int64_t)sizeof(line)) { pos = eol + 1; continue; }
+    if (len + 1 > (int64_t)scratch.size()) scratch.resize((size_t)len + 1);
+    char* line = scratch.data();
     memcpy(line, buf + pos, (size_t)len);
     line[len] = '\0';
     char* end;
     int64_t key = strtoll(line, &end, 10);
-    if (*end != ',') { pos = eol + 1; continue; }  // malformed: skip line
-    int64_t ts = strtoll(end + 1, &end, 10);
-    bool ok = true;
-    for (int32_t v = 0; v < nv; ++v) {
+    // malformed (empty key or no separator): skip line
+    if (end == line || *end != ',') { pos = eol + 1; continue; }
+    const char* ts_start = end + 1;
+    int64_t ts = strtoll(ts_start, &end, 10);
+    bool ok = (end != ts_start);
+    for (int32_t v = 0; ok && v < nv; ++v) {
       if (*end != ',') { ok = false; break; }
       const char* start = end + 1;
       vals[n * nv + v] = strtod(start, &end);

@@ -78,6 +78,28 @@ def test_parse_csv_empty_field_does_not_steal_next_line():
     np.testing.assert_allclose(vals[:, 0], [7.5])
 
 
+def test_parse_csv_long_lines():
+    # lines longer than any fixed scratch buffer must still parse (wide
+    # records are normal for multi-field CSV)
+    nv = 60
+    fields = ",".join(f"{1.5:.10f}" for _ in range(nv))  # ~13 chars per field
+    buf = (f"7,70,{fields}\n" * 3).encode()
+    assert len(buf) > 3 * 512
+    keys, tss, vals, consumed = native.parse_csv(buf, nv=nv)
+    np.testing.assert_array_equal(keys, [7, 7, 7])
+    np.testing.assert_array_equal(tss, [70, 70, 70])
+    assert vals.shape == (3, nv)
+    assert consumed == len(buf)
+
+
+def test_parse_csv_empty_ts_skipped():
+    # an empty ts field is malformed, not ts=0
+    buf = b"1,,2.5\n2,20,3.5\n"
+    keys, tss, vals, _ = native.parse_csv(buf, nv=1)
+    np.testing.assert_array_equal(keys, [2])
+    np.testing.assert_array_equal(tss, [20])
+
+
 def test_frame_source_csv_without_trailing_newline():
     blob = b"1,10,2.5\n2,20,3.5"  # no trailing \n: last record still counts
     got = []

@@ -47,6 +47,7 @@ from windflow_tpu.ops.reduce_op import Reduce
 from windflow_tpu.ops.sink import Sink
 from windflow_tpu.ops.source import Source
 from windflow_tpu.ops.tpu import FilterTPU, MapTPU, ReduceTPU
+from windflow_tpu.ops.tpu_stateful import StatefulFilterTPU, StatefulMapTPU
 from windflow_tpu.windows.engine import WindowSpec
 from windflow_tpu.windows.ffat_op import FfatWindows
 from windflow_tpu.windows.ffat_tpu import FfatWindowsTPU
@@ -64,6 +65,7 @@ __all__ = [
     "host_to_device", "LocalStorage", "RuntimeContext", "MultiPipe",
     "PipeGraph", "Operator", "Replica", "Source", "Map", "Filter", "FlatMap",
     "Shipper", "Reduce", "Sink", "MapTPU", "FilterTPU", "ReduceTPU",
+    "StatefulMapTPU", "StatefulFilterTPU",
     "Source_Builder", "Map_Builder", "Filter_Builder", "FlatMap_Builder",
     "Reduce_Builder", "Sink_Builder", "MapTPU_Builder", "FilterTPU_Builder",
     "ReduceTPU_Builder",

@@ -18,6 +18,7 @@ from windflow_tpu.ops.reduce_op import Reduce
 from windflow_tpu.ops.sink import Sink
 from windflow_tpu.ops.source import Source
 from windflow_tpu.ops.tpu import FilterTPU, MapTPU, ReduceTPU
+from windflow_tpu.ops.tpu_stateful import StatefulFilterTPU, StatefulMapTPU
 
 
 class _BuilderBase:
@@ -151,7 +152,28 @@ class Sink_Builder(_BuilderBase):
 # ReduceGPU_Builder, builders_gpu.hpp:54-673)
 # ---------------------------------------------------------------------------
 
-class MapTPU_Builder(_BuilderBase):
+class _StatefulTPUMixin:
+    """Stateful knobs shared by MapTPU/FilterTPU builders (reference:
+    stateful ``MapGPU_Builder``/``FilterGPU_Builder`` variants are selected
+    by the functor's (tuple, state) signature, ``builders_gpu.hpp:54-673``;
+    here the per-key initial state is explicit)."""
+
+    _initial_state = None
+    _num_key_slots = 4096
+
+    def withInitialState(self, state):
+        """Per-key initial state prototype — switches the operator to the
+        stateful keyed path (requires ``withKeyBy``)."""
+        self._initial_state = state
+        return self
+
+    def withNumKeySlots(self, n: int):
+        """Capacity of the dense device state table (max distinct keys)."""
+        self._num_key_slots = n
+        return self
+
+
+class MapTPU_Builder(_StatefulTPUMixin, _BuilderBase):
     _default_name = "map_tpu"
 
     def __init__(self, fn: Callable, batch_fn: bool = False) -> None:
@@ -159,21 +181,38 @@ class MapTPU_Builder(_BuilderBase):
         self._fn = fn
         self._batch_fn = batch_fn
 
-    def build(self) -> MapTPU:
+    def build(self):
+        if self._initial_state is not None:
+            if self._batch_fn:
+                raise WindFlowError(
+                    "batch_fn is not supported for stateful MapTPU: the "
+                    "stateful function operates per record as "
+                    "fn(record, state) -> (record, state)")
+            return StatefulMapTPU(self._fn, self._initial_state,
+                                  name=self._name,
+                                  parallelism=self._parallelism,
+                                  key_extractor=self._key_extractor,
+                                  num_key_slots=self._num_key_slots)
         return MapTPU(self._fn, name=self._name,
                       parallelism=self._parallelism,
                       batch_fn=self._batch_fn, routing=self._routing(),
                       key_extractor=self._key_extractor)
 
 
-class FilterTPU_Builder(_BuilderBase):
+class FilterTPU_Builder(_StatefulTPUMixin, _BuilderBase):
     _default_name = "filter_tpu"
 
     def __init__(self, fn: Callable) -> None:
         super().__init__()
         self._fn = fn
 
-    def build(self) -> FilterTPU:
+    def build(self):
+        if self._initial_state is not None:
+            return StatefulFilterTPU(self._fn, self._initial_state,
+                                     name=self._name,
+                                     parallelism=self._parallelism,
+                                     key_extractor=self._key_extractor,
+                                     num_key_slots=self._num_key_slots)
         return FilterTPU(self._fn, name=self._name,
                          parallelism=self._parallelism,
                          routing=self._routing(),

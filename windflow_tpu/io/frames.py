@@ -23,6 +23,7 @@ from windflow_tpu import native
 from windflow_tpu.basic import RoutingMode, TimePolicy, WindFlowError, \
     current_time_usecs
 from windflow_tpu.batch import WM_NONE
+from windflow_tpu.meta import adapt
 from windflow_tpu.ops.base import Operator, Replica
 from windflow_tpu.ops.source import Source
 
@@ -105,19 +106,8 @@ class FrameSource(Source):
             raise WindFlowError("fields must name all nv value columns")
         Operator.__init__(self, name, parallelism, routing=RoutingMode.NONE,
                           output_batch_size=output_batch_size)
-        self.chunks_fn = _adapt_chunks(chunks_fn)
+        self.chunks_fn = adapt(chunks_fn, 0)
         self.nv = nv
         self.fields = fields or [f"v{i}" for i in range(nv)]
         self.fmt = fmt
         self.ts_extractor = None
-
-
-def _adapt_chunks(fn):
-    import inspect
-    try:
-        n = len(inspect.signature(fn).parameters)
-    except (TypeError, ValueError):
-        n = 0
-    if n >= 1:
-        return fn
-    return lambda ctx: fn()

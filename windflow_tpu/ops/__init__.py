@@ -6,3 +6,4 @@ from windflow_tpu.ops.reduce_op import Reduce
 from windflow_tpu.ops.sink import Sink
 from windflow_tpu.ops.source import Source
 from windflow_tpu.ops.tpu import FilterTPU, MapTPU, ReduceTPU
+from windflow_tpu.ops.tpu_stateful import StatefulFilterTPU, StatefulMapTPU

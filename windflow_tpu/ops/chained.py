@@ -150,7 +150,8 @@ class ChainedTPU(Operator):
     def _step(self, batch: DeviceBatch) -> DeviceBatch:
         payload, valid = self._jit_step(batch.payload, batch.valid)
         size = None if self._has_filter else batch.known_size
-        return DeviceBatch(payload, batch.ts, valid, keys=batch.keys,
+        # keys lane not forwarded: edge-scoped metadata (see ops/tpu.py)
+        return DeviceBatch(payload, batch.ts, valid,
                            watermark=batch.watermark, size=size)
 
 

@@ -88,9 +88,11 @@ class MapTPU(Operator):
 
     def _step(self, batch: DeviceBatch) -> DeviceBatch:
         out_payload = self._jit_step(batch.payload, batch.valid)
+        # keys lane deliberately not forwarded: it is edge-scoped metadata
+        # (valid only for the extractor of the edge that attached it), and a
+        # map may rewrite the key field anyway.
         return DeviceBatch(out_payload, batch.ts, batch.valid,
-                           keys=batch.keys, watermark=batch.watermark,
-                           size=batch._size)
+                           watermark=batch.watermark, size=batch._size)
 
 
 class FilterTPUReplica(_TPUReplica):
@@ -123,7 +125,7 @@ class FilterTPU(Operator):
     def _step(self, batch: DeviceBatch) -> DeviceBatch:
         new_valid = self._jit_step(batch.payload, batch.valid)
         return DeviceBatch(batch.payload, batch.ts, new_valid,
-                           keys=batch.keys, watermark=batch.watermark,
+                           watermark=batch.watermark,
                            size=None)  # survivor count unknown until observed
 
 
@@ -239,5 +241,5 @@ class ReduceTPU(Operator):
         out_keys, out_payload, out_ts, out_valid = \
             self._get_step(batch.capacity)(batch.keys, batch.payload,
                                            batch.ts, batch.valid)
-        return DeviceBatch(out_payload, out_ts, out_valid, keys=out_keys,
+        return DeviceBatch(out_payload, out_ts, out_valid,
                            watermark=batch.watermark, size=None)

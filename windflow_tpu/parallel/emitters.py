@@ -312,6 +312,125 @@ class DeviceStageEmitter(Emitter):
         self._ob = _OpenBatch()
 
 
+class KeyedDeviceStageEmitter(Emitter):
+    """Host→TPU boundary with KEYBY routing (reference CPU→GPU
+    ``KeyBy_Emitter_GPU``, ``keyby_emitter_gpu.hpp:400-476``): tuples are
+    partitioned by ``hash(key) % num_dests`` into per-destination staged
+    batches, so every key's tuples flow through exactly one replica in
+    arrival order — the invariant that makes shared per-key device state
+    (ops/tpu_stateful.py) correct at parallelism > 1, exactly as the
+    reference's keyby routing does for its stateful GPU operators."""
+
+    def __init__(self, dests, output_batch_size, key_extractor):
+        super().__init__(dests, output_batch_size)
+        self.key_extractor = key_extractor
+        # one single-destination staging emitter per partition
+        self._inner = [DeviceStageEmitter([d], output_batch_size)
+                       for d in dests]
+
+    @staticmethod
+    def _key32(k) -> int:
+        """Truncate a numeric key to the int32 key space the device operator
+        interns (its extractor output is cast to int32 on device) — routing
+        must collapse exactly the keys the state table collapses, or one
+        logical key would straddle replicas."""
+        i = int(k) & 0xFFFFFFFF
+        return i - (1 << 32) if i >= (1 << 31) else i
+
+    def emit(self, item, ts, wm):
+        d = self._key32(self.key_extractor(item)) % len(self.dests)
+        self._inner[d].emit(item, ts, wm)
+
+    def emit_columns(self, cols, tss, wm):
+        n = len(self.dests)
+        dest = None
+        try:
+            # Vectorized: per-record key fns are elementwise field math, so
+            # they usually apply directly to the SoA columns.
+            keys = np.asarray(self.key_extractor(cols))
+            if keys.shape == (len(tss),):
+                # int64→int32→int64: the device's int32 truncation, then a
+                # non-negative floor-mod for the partition index
+                dest = keys.astype(np.int64).astype(
+                    np.int32).astype(np.int64) % n
+        except Exception:
+            pass
+        if dest is None:
+            # Non-elementwise or scalar-returning extractor: per-row path.
+            dest = np.array(
+                [self._key32(self.key_extractor(
+                    {k: v[i].item() for k, v in cols.items()})) % n
+                 for i in range(len(tss))])
+        for d in range(n):
+            idx = np.nonzero(dest == d)[0]
+            if len(idx):
+                self._inner[d].emit_columns(
+                    {k: v[idx] for k, v in cols.items()}, tss[idx], wm)
+
+    def emit_device_batch(self, batch):
+        raise WindFlowError(
+            "keyed staging emitter received a device batch; TPU→TPU keyed "
+            "edges use DeviceKeyByEmitter")
+
+    def flush(self, wm):
+        for e in self._inner:
+            e.flush(wm)
+
+    def propagate_punctuation(self, wm):
+        for e in self._inner:
+            e.propagate_punctuation(wm)
+
+
+class DeviceKeyByEmitter(Emitter):
+    """TPU→TPU KEYBY edge (reference GPU→GPU ``KeyBy_Emitter_GPU``,
+    ``keyby_emitter_gpu.hpp:519-583``): one compiled program splits the batch
+    into ``num_dests`` order-preserving compactions by ``key % num_dests``.
+    The reference builds per-key index chains with sort kernels; the XLA
+    expression is a stable argsort per partition.  Empty partitions still
+    ship (a masked all-invalid batch) — skipping them would force a host
+    sync on the partition counts."""
+
+    def __init__(self, dests, key_extractor):
+        super().__init__(dests, output_batch_size=0)
+        self.key_extractor = key_extractor
+        self._splits = {}
+
+    def _get_split(self, capacity: int):
+        import jax
+        import jax.numpy as jnp
+        split = self._splits.get(capacity)
+        if split is None:
+            n = len(self.dests)
+            key_fn = self.key_extractor
+
+            @jax.jit
+            def split(payload, ts, valid, keys):
+                if keys is None:
+                    keys = jax.vmap(key_fn)(payload).astype(jnp.int32)
+                dest = jnp.where(valid, keys % n, jnp.int32(n))
+                outs = []
+                for d in range(n):
+                    mask = dest == d
+                    order = jnp.argsort(~mask, stable=True)
+                    pay_d = jax.tree.map(lambda a: a[order], payload)
+                    outs.append((pay_d, ts[order], keys[order],
+                                 jnp.arange(capacity) < jnp.sum(mask)))
+                return outs
+
+            self._splits[capacity] = split
+        return split
+
+    def emit_device_batch(self, batch):
+        if len(self.dests) == 1:
+            self._send(0, batch)
+            return
+        outs = self._get_split(batch.capacity)(
+            batch.payload, batch.ts, batch.valid, batch.keys)
+        for d, (pay, ts, keys, valid) in enumerate(outs):
+            self._send(d, DeviceBatch(pay, ts, valid, keys=keys,
+                                      watermark=batch.watermark, size=None))
+
+
 class DevicePassEmitter(Emitter):
     """TPU→TPU edge: device batches move by handle (no copies, no transfers).
 
@@ -371,6 +490,16 @@ def create_emitter(routing: RoutingMode,
     """Pick the emitter for an edge from (routing, src-on-TPU, dst-on-TPU),
     mirroring the reference's dispatch (``multipipe.hpp:236-350``)."""
     if dst_is_tpu:
+        if routing == RoutingMode.KEYBY and len(dests) > 1 \
+                and key_extractor is not None:
+            # Key-partitioned delivery: each key's tuples always reach the
+            # same replica, preserving per-key arrival order for shared
+            # device state (reference: keyby routing is what makes stateful
+            # Map_GPU/Filter_GPU correct across replicas).
+            if src_is_tpu:
+                return DeviceKeyByEmitter(dests, key_extractor)
+            return KeyedDeviceStageEmitter(dests, output_batch_size,
+                                           key_extractor)
         if src_is_tpu:
             return DevicePassEmitter(dests, routing)
         return DeviceStageEmitter(dests, output_batch_size)

@@ -50,6 +50,12 @@ from windflow_tpu.windows.ffat_kernels import (_masked_reduce_last,
 
 class FfatTPUReplica(_TPUReplica):
     def on_eos(self):
+        # State is operator-level; only the LAST replica to terminate may
+        # flush it — earlier-terminating siblings' peers might still hold
+        # queued data batches whose tuples belong in the open windows.
+        self.op._eos_replicas += 1
+        if self.op._eos_replicas < self.op.parallelism:
+            return
         out = self.op._flush()
         if out is not None:
             self.stats.device_programs_launched += 1
@@ -84,6 +90,7 @@ class FfatWindowsTPU(Operator):
         self._jit_flush = None
         self._capacity = None
         self._flushed = False
+        self._eos_replicas = 0
 
     # -- state layout --------------------------------------------------------
     def _init_state(self, agg_spec):
@@ -111,22 +118,21 @@ class FfatWindowsTPU(Operator):
         self._ensure(batch)
         self._state, out, fired, out_ts = self._jit_step(
             self._state, batch.payload, batch.ts, batch.valid)
-        return DeviceBatch(out, out_ts, fired, keys=out["key"],
+        return DeviceBatch(out, out_ts, fired,
                            watermark=batch.watermark, size=None)
 
     def _flush(self) -> Optional[DeviceBatch]:
         """EOS: fire remaining partial windows (reference EOS flush of open
         windows).  Runs a dedicated flush program over the carried state.
         State is operator-level (one logical device table regardless of
-        replica count), so only the first replica to reach EOS flushes."""
+        replica count), so the last replica to terminate flushes it once."""
         if self._state is None or self._flushed:
             return None
         self._flushed = True
         if self._jit_flush is None:
             self._jit_flush = self._build_flush()
         out, fired, ts = self._jit_flush(self._state)
-        return DeviceBatch(out, ts, fired, keys=out["key"], watermark=0,
-                           size=None)
+        return DeviceBatch(out, ts, fired, watermark=0, size=None)
 
     def _build_flush(self):
         K, P, R, D = self.max_keys, self.P, self.R, self.D
