@@ -1,0 +1,285 @@
+"""Persistent operator suite tests (reference ``tests/rocksdb_tests/``):
+the same metamorphic-oracle style as the graph tests, plus KV-store
+durability — state must survive a close/reopen, and persistent windows must
+produce identical results to in-memory windows while actually spilling
+fragments."""
+
+import pickle
+import random
+
+import pytest
+
+import windflow_tpu as wf
+from windflow_tpu.persistent import (DBHandle, LogKV, PKeyedWindows,
+                                     P_Keyed_Windows_Builder, P_Map_Builder,
+                                     P_Reduce_Builder, P_Sink_Builder,
+                                     SpillingArchive)
+from windflow_tpu.persistent.kv import _PyKV
+from windflow_tpu.windows.engine import WindowSpec
+
+
+# ---------------------------------------------------------------------------
+# KV store
+# ---------------------------------------------------------------------------
+
+def test_kv_roundtrip_and_reopen(tmp_path):
+    path = str(tmp_path / "store")
+    kv = LogKV(path)
+    kv.put(b"a", b"1")
+    kv.put(b"b", b"x" * 10_000)
+    kv.put(b"a", b"2")          # overwrite
+    kv.delete(b"missing")
+    assert kv.get(b"a") == b"2"
+    assert kv.get(b"b") == b"x" * 10_000
+    assert kv.get(b"nope") is None
+    assert len(kv) == 2
+    kv.put(b"c", b"3")
+    kv.delete(b"b")
+    assert sorted(kv.keys()) == [b"a", b"c"]
+    kv.flush()
+    kv.close()
+    # reopen: index rebuilt from the log, tombstone honored
+    kv2 = LogKV(path)
+    assert kv2.get(b"a") == b"2"
+    assert kv2.get(b"b") is None
+    assert kv2.get(b"c") == b"3"
+    kv2.close(delete_db=True)
+    kv3 = LogKV(path)           # deleted: fresh store
+    assert len(kv3) == 0
+    kv3.close(delete_db=True)
+
+
+def test_kv_compaction_reclaims_space(tmp_path):
+    path = str(tmp_path / "store")
+    kv = LogKV(path)
+    for i in range(200):
+        kv.put(b"hot", b"v%d" % i)   # 199 dead versions
+    before = kv.log_bytes()
+    kv.compact()
+    assert kv.log_bytes() < before
+    assert kv.get(b"hot") == b"v199"
+    assert len(kv) == 1
+    kv.close(delete_db=True)
+
+
+def test_kv_python_fallback_reads_native_format(tmp_path):
+    """The pure-Python backend speaks the same on-disk format as the native
+    store, so a DB written by one opens under the other."""
+    path = str(tmp_path / "store")
+    kv = LogKV(path)             # native backend when the toolchain is up
+    kv.put(b"k1", b"v1")
+    kv.put(b"k2", bytes(range(256)))
+    kv.delete(b"k1")
+    kv.flush()
+    kv.close()
+    py = _PyKV(path)
+    assert py.get(b"k1") is None
+    assert py.get(b"k2") == bytes(range(256))
+    py.put(b"k3", b"from_python")
+    py.close()
+    back = LogKV(path)
+    assert back.get(b"k3") == b"from_python"
+    back.close(delete_db=True)
+
+
+def test_db_handle_typed_keys_and_initial_state(tmp_path):
+    db = DBHandle(str(tmp_path / "db"), initial_state=lambda: {"n": 0},
+                  delete_db=False)
+    assert db.get(42) == {"n": 0}          # unseen key: fresh initial state
+    s = db.get("alpha")
+    s["n"] = 7
+    db.put("alpha", s)
+    db.put((1, "compound"), {"n": 3})
+    assert db.get("alpha") == {"n": 7}
+    assert db.lookup("beta") is None
+    assert sorted(map(str, db.keys())) == sorted(
+        map(str, ["alpha", (1, "compound")]))
+    db.close()
+    # initial_state factories must produce independent states
+    db2 = DBHandle(str(tmp_path / "db2"), initial_state={"n": 0})
+    a, b = db2.get(1), db2.get(2)
+    a["n"] = 99
+    assert b["n"] == 0
+    db2.close()
+
+
+# ---------------------------------------------------------------------------
+# Persistent operators in graphs
+# ---------------------------------------------------------------------------
+
+def _stream(n_keys, length):
+    return [{"key": i % n_keys, "value": i} for i in range(length)]
+
+
+class Acc:
+    def __init__(self):
+        self.total = 0
+        self.count = 0
+
+    def __call__(self, item, ctx=None):
+        if item is not None:
+            self.total += int(item["value"])
+            self.count += 1
+
+
+def run_pmap_pipeline(tmp_path, par, run_id, length=400, n_keys=6):
+    """P_Map counts per-key occurrences in its persistent state and stamps
+    the running count onto each tuple."""
+    acc = Acc()
+
+    def stamp(t, state):
+        state["seen"] = state.get("seen", 0) + 1
+        return {"key": t["key"], "value": t["value"] + state["seen"]}
+
+    src = (wf.Source_Builder(lambda: iter(_stream(n_keys, length)))
+           .withName("src").build())
+    pm = (P_Map_Builder(stamp).withName("pmap").withParallelism(par)
+          .withKeyBy(lambda t: t["key"])
+          .withDBPath(str(tmp_path / f"pmap_db_{run_id}"))
+          .withInitialState(dict).build())
+    snk = wf.Sink_Builder(acc).withName("sink").build()
+    g = wf.PipeGraph(f"p_map_{run_id}", wf.ExecutionMode.DEFAULT)
+    g.add_source(src).add(pm).add_sink(snk)
+    g.run()
+    return acc
+
+
+def test_p_map_metamorphic(tmp_path):
+    reference = None
+    rnd = random.Random(3)
+    for run in range(4):
+        par = rnd.randint(1, 4)
+        acc = run_pmap_pipeline(tmp_path, par, run)
+        if reference is None:
+            reference = (acc.total, acc.count)
+        else:
+            assert (acc.total, acc.count) == reference, f"par={par} diverged"
+    # oracle: per key, counts stamp 1..occurrences
+    length, n_keys = 400, 6
+    occ = length // n_keys
+    extra = length % n_keys
+    expected = sum(range(length))
+    for k in range(n_keys):
+        n = occ + (1 if k < extra else 0)
+        expected += n * (n + 1) // 2
+    assert reference[0] == expected
+
+
+def test_p_reduce_state_survives_restart(tmp_path):
+    """withKeepDb: a second run resumes from the first run's keyed state —
+    the durability the reference gets from keeping the RocksDB path."""
+    db_path = str(tmp_path / "counts")
+    results = {}
+
+    def count(t, state):
+        state["n"] = state.get("n", 0) + 1
+
+    def grab(item, ctx=None):
+        if item is not None:
+            results[item.get("key", None) if isinstance(item, dict)
+                    else None] = item
+
+    def run_once():
+        src = (wf.Source_Builder(lambda: iter(_stream(4, 100)))
+               .withName("src").build())
+        red = (P_Reduce_Builder(count).withName("preduce")
+               .withKeyBy(lambda t: t["key"])
+               .withDBPath(db_path).withInitialState(dict)
+               .withKeepDb().build())
+        snk = wf.Sink_Builder(lambda t, ctx=None: None).withName("s").build()
+        g = wf.PipeGraph("p_reduce", wf.ExecutionMode.DEFAULT)
+        g.add_source(src).add(red).add_sink(snk)
+        g.run()
+
+    run_once()
+    run_once()  # second run: counts continue from the first
+    db = DBHandle(db_path, initial_state=dict, delete_db=False, whoami=0)
+    total = sum(db.get(k)["n"] for k in db.keys())
+    db.close()
+    assert total == 200  # 100 tuples per run, resumed not reset
+
+
+def test_p_sink_eos_and_state(tmp_path):
+    calls = {"eos": 0, "items": 0}
+
+    def sink_fn(item, state):
+        if item is None:
+            calls["eos"] += 1
+        else:
+            calls["items"] += 1
+            state["n"] = state.get("n", 0) + 1
+
+    src = wf.Source_Builder(lambda: iter(_stream(3, 30))).withName("s").build()
+    snk = (P_Sink_Builder(sink_fn).withName("psink")
+           .withKeyBy(lambda t: t["key"]).withParallelism(2)
+           .withDBPath(str(tmp_path / "sink_db"))
+           .withInitialState(dict).build())
+    g = wf.PipeGraph("p_sink", wf.ExecutionMode.DEFAULT)
+    g.add_source(src).add_sink(snk)
+    g.run()
+    assert calls["items"] == 30
+    assert calls["eos"] == 2  # one per replica
+
+
+# ---------------------------------------------------------------------------
+# Persistent keyed windows
+# ---------------------------------------------------------------------------
+
+def _window_results(op_builder, length=300, n_keys=4, win=20, slide=10):
+    got = []
+
+    def grab(r, ctx=None):
+        if r is not None:
+            got.append((r.key, r.wid, r.value))
+
+    src = (wf.Source_Builder(
+        lambda: iter(_stream(n_keys, length)))
+        .withName("src").build())
+    win_op = (op_builder(lambda items: sum(t["value"] for t in items))
+              .withName("win").withCBWindows(win, slide)
+              .withKeyBy(lambda t: t["key"]).withParallelism(2).build())
+    snk = wf.Sink_Builder(grab).withName("sink").build()
+    g = wf.PipeGraph("pwin", wf.ExecutionMode.DEFAULT)
+    g.add_source(src).add(win_op).add_sink(snk)
+    g.run()
+    return sorted(got)
+
+
+def test_p_keyed_windows_match_in_memory(tmp_path):
+    """Spilling windows (tiny in-memory buffer forces fragments) produce
+    exactly the in-memory KeyedWindows results."""
+    expected = _window_results(wf.Keyed_Windows_Builder)
+    actual = _window_results(
+        lambda fn: (P_Keyed_Windows_Builder(fn)
+                    .withDBPath(str(tmp_path / "win_db"))
+                    .withMaxInMemoryElements(8)))
+    assert actual == expected
+    assert len(actual) > 0
+
+
+def test_spilling_archive_spills_and_reloads(tmp_path):
+    db = DBHandle(str(tmp_path / "arch"), delete_db=True)
+    arch = SpillingArchive(db, key=7, n_max=4)
+    for i in range(19):
+        arch.insert((i, i, {"v": i}, i))
+    assert arch.spilled_fragments >= 3       # 19 entries, buffers of 4
+    assert len(arch) == 19
+    got = arch.range(5, 15)
+    assert [e[0] for e in got] == list(range(5, 15))
+    arch.purge_below(8)                      # fragments fully below 8 die
+    assert [e[0] for e in arch.range(0, 100)] == list(range(8, 19))
+    arch.clear()
+    assert len(arch) == 0
+    assert len(db) == 0                      # all fragments deleted
+    db.close()
+
+
+def test_spilling_archive_out_of_order(tmp_path):
+    db = DBHandle(str(tmp_path / "arch2"), delete_db=True)
+    arch = SpillingArchive(db, key=0, n_max=3)
+    order = [5, 1, 9, 2, 8, 0, 7, 3, 6, 4]
+    for aid, d in enumerate(order):
+        arch.insert((d, aid, d, d))
+    got = arch.range(0, 10)
+    assert [e[0] for e in got] == sorted(order)
+    db.close()

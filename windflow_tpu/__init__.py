@@ -55,6 +55,11 @@ from windflow_tpu.windows.flatfat import FlatFAT
 from windflow_tpu.windows.ops import (KeyedWindows, MapReduceWindows,
                                       PanedWindows, ParallelWindows,
                                       WindowResult)
+from windflow_tpu.persistent import (DBHandle, LogKV, PFilter, PFlatMap,
+                                     PKeyedWindows, PMap, PReduce, PSink,
+                                     P_Filter_Builder, P_FlatMap_Builder,
+                                     P_Keyed_Windows_Builder, P_Map_Builder,
+                                     P_Reduce_Builder, P_Sink_Builder)
 
 __version__ = "0.1.0"
 
@@ -69,4 +74,13 @@ __all__ = [
     "Source_Builder", "Map_Builder", "Filter_Builder", "FlatMap_Builder",
     "Reduce_Builder", "Sink_Builder", "MapTPU_Builder", "FilterTPU_Builder",
     "ReduceTPU_Builder",
+    "WindowSpec", "WindowResult", "KeyedWindows", "ParallelWindows",
+    "PanedWindows", "MapReduceWindows", "FfatWindows", "FfatWindowsTPU",
+    "FlatFAT", "Keyed_Windows_Builder", "Parallel_Windows_Builder",
+    "Paned_Windows_Builder", "MapReduce_Windows_Builder",
+    "Ffat_Windows_Builder", "Ffat_WindowsTPU_Builder",
+    "DBHandle", "LogKV", "PMap", "PFilter", "PFlatMap", "PReduce", "PSink",
+    "PKeyedWindows", "P_Map_Builder", "P_Filter_Builder",
+    "P_FlatMap_Builder", "P_Reduce_Builder", "P_Sink_Builder",
+    "P_Keyed_Windows_Builder",
 ]

@@ -153,8 +153,7 @@ class PipeGraph:
         # sanity: every non-sink replica must have an emitter
         for op in self._operators:
             for rep in op.replicas:
-                from windflow_tpu.ops.sink import Sink
-                if rep.emitter is None and not isinstance(op, Sink):
+                if rep.emitter is None and not op.is_terminal:
                     raise WindFlowError(
                         f"operator '{op.name}' has no downstream consumer — "
                         "every MultiPipe must end in a Sink")

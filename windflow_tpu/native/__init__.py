@@ -26,9 +26,12 @@ _lib = None
 _load_attempted = False
 
 
+_SOURCES = ("wf_host.cpp", "wf_kv.cpp")
+
+
 def _build() -> bool:
-    src = os.path.join(_NATIVE_DIR, "wf_host.cpp")
-    if not os.path.exists(src):
+    if not all(os.path.exists(os.path.join(_NATIVE_DIR, s))
+               for s in _SOURCES):
         return False
     try:
         subprocess.run(["make", "-C", _NATIVE_DIR], check=True,
@@ -47,10 +50,11 @@ def lib() -> Optional[ctypes.CDLL]:
     _load_attempted = True
     if os.environ.get("WF_TPU_NO_NATIVE"):
         return None
-    src = os.path.join(_NATIVE_DIR, "wf_host.cpp")
+    srcs = [os.path.join(_NATIVE_DIR, s) for s in _SOURCES]
     stale = (not os.path.exists(_SO_PATH)
-             or (os.path.exists(src)
-                 and os.path.getmtime(src) > os.path.getmtime(_SO_PATH)))
+             or any(os.path.exists(s)
+                    and os.path.getmtime(s) > os.path.getmtime(_SO_PATH)
+                    for s in srcs))
     if stale and not _build():
         return None
     try:
@@ -90,6 +94,33 @@ def lib() -> Optional[ctypes.CDLL]:
     L.wf_ring_size.argtypes = [p]
     L.wf_min_watermark.restype = i8
     L.wf_min_watermark.argtypes = [p, i4, i8]
+    c = ctypes.c_char_p
+    L.wf_kv_open.restype = p
+    L.wf_kv_open.argtypes = [c, i4]
+    L.wf_kv_put.restype = i4
+    L.wf_kv_put.argtypes = [p, c, i4, c, i8]
+    L.wf_kv_get.restype = i8
+    L.wf_kv_get.argtypes = [p, c, i4, p, i8]
+    L.wf_kv_del.restype = i4
+    L.wf_kv_del.argtypes = [p, c, i4]
+    L.wf_kv_count.restype = i8
+    L.wf_kv_count.argtypes = [p]
+    L.wf_kv_log_bytes.restype = i8
+    L.wf_kv_log_bytes.argtypes = [p]
+    L.wf_kv_live_bytes.restype = i8
+    L.wf_kv_live_bytes.argtypes = [p]
+    L.wf_kv_compact.restype = i4
+    L.wf_kv_compact.argtypes = [p]
+    L.wf_kv_flush.restype = i4
+    L.wf_kv_flush.argtypes = [p]
+    L.wf_kv_close.restype = None
+    L.wf_kv_close.argtypes = [p, i4]
+    L.wf_kv_iter_new.restype = p
+    L.wf_kv_iter_new.argtypes = [p]
+    L.wf_kv_iter_next.restype = i4
+    L.wf_kv_iter_next.argtypes = [p, p, i4]
+    L.wf_kv_iter_destroy.restype = None
+    L.wf_kv_iter_destroy.argtypes = [p]
     _lib = L
     return _lib
 

@@ -153,6 +153,8 @@ class Operator:
 
     #: subclasses set this to their replica class
     replica_class = Replica
+    #: terminal operators (sinks) have no emitter / downstream consumer
+    is_terminal = False
 
     def __init__(self, name: str, parallelism: int,
                  routing: RoutingMode = RoutingMode.FORWARD,

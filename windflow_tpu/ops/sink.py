@@ -41,6 +41,7 @@ def _leaves(tree):
 
 class Sink(Operator):
     replica_class = SinkReplica
+    is_terminal = True
 
     def __init__(self, fn: Callable[[Optional[Any]], None], name: str = "sink",
                  parallelism: int = 1,

@@ -421,9 +421,6 @@ class DeviceKeyByEmitter(Emitter):
         return split
 
     def emit_device_batch(self, batch):
-        if len(self.dests) == 1:
-            self._send(0, batch)
-            return
         outs = self._get_split(batch.capacity)(
             batch.payload, batch.ts, batch.valid, batch.keys)
         for d, (pay, ts, keys, valid) in enumerate(outs):

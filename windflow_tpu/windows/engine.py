@@ -54,6 +54,44 @@ class WindowSpec:
         return w * self.slide + self.win_len
 
 
+class Archive:
+    """Ordered store of ``(domain, arrival_id, item, ts)`` entries for
+    non-incremental window logic (reference ``StreamArchive``,
+    ``stream_archive.hpp:48-146``).  The default keeps everything in memory;
+    the persistent suite substitutes a spilling variant
+    (windflow_tpu/persistent/p_windows.py) whose overflow lives in the KV
+    store, mirroring the reference's RocksDB window fragments
+    (``p_window_replica.hpp:90-176``)."""
+
+    __slots__ = ("_entries",)
+
+    def __init__(self) -> None:
+        self._entries: List = []
+
+    def insert(self, entry) -> None:
+        if self._entries and self._entries[-1][:2] > entry[:2]:
+            bisect.insort(self._entries, entry)
+        else:
+            self._entries.append(entry)
+
+    def range(self, start: int, end: int) -> List:
+        """Entries with ``start <= domain < end``, in (domain, aid) order."""
+        lo = bisect.bisect_left(self._entries, (start, -1))
+        hi = bisect.bisect_left(self._entries, (end, -1))
+        return self._entries[lo:hi]
+
+    def purge_below(self, d: int) -> None:
+        lo = bisect.bisect_left(self._entries, (d, -1))
+        if lo > 0:
+            del self._entries[:lo]
+
+    def clear(self) -> None:
+        self._entries.clear()
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+
 class _OpenWindow:
     __slots__ = ("gwid", "acc", "count", "max_ts")
 
@@ -70,9 +108,9 @@ class _KeyDescriptor:
     __slots__ = ("next_id", "archive", "open", "next_unfired", "frontier",
                  "fired_ahead")
 
-    def __init__(self):
+    def __init__(self, archive: Archive):
         self.next_id = 0                    # per-key arrival counter
-        self.archive: List = []             # sorted [(domain, aid, item, ts)]
+        self.archive = archive              # (domain, aid, item, ts) entries
         self.open: Dict[int, _OpenWindow] = {}
         self.next_unfired = 0               # lowest gwid not yet fired
         self.frontier = WM_NONE             # max domain value seen
@@ -91,7 +129,8 @@ class WindowEngine:
                  domain_fn: Optional[Callable] = None,
                  wm_to_domain: Optional[Callable[[int], int]] = None,
                  count_complete: bool = False,
-                 stats=None) -> None:
+                 stats=None,
+                 archive_factory: Callable[[Any], Archive] = None) -> None:
         self.spec = spec
         self.fn = fn
         self.incremental = incremental
@@ -109,6 +148,7 @@ class WindowEngine:
         # upstream pane replicas)
         self.count_complete = count_complete
         self.stats = stats
+        self.archive_factory = archive_factory or (lambda key: Archive())
         self.keys: Dict[Any, _KeyDescriptor] = {}
         self._eager = ((spec.win_type == WinType.CB
                         or mode != ExecutionMode.DEFAULT)
@@ -118,7 +158,7 @@ class WindowEngine:
     def on_tuple(self, key: Any, item: Any, ts: int, wm: int) -> None:
         kd = self.keys.get(key)
         if kd is None:
-            kd = self.keys[key] = _KeyDescriptor()
+            kd = self.keys[key] = _KeyDescriptor(self.archive_factory(key))
         aid = kd.next_id
         kd.next_id += 1
         d = self._domain_of(aid, item, ts)
@@ -133,11 +173,7 @@ class WindowEngine:
         if not self.incremental:
             # archive ordered by (domain, arrival id) — reference
             # StreamArchive binary-search insert (stream_archive.hpp:48-146)
-            entry = (d, aid, item, ts)
-            if kd.archive and kd.archive[-1][:2] > (d, aid):
-                bisect.insort(kd.archive, entry)
-            else:
-                kd.archive.append(entry)
+            kd.archive.insert((d, aid, item, ts))
         keep = self._keeps_tuple(aid)
         for w in range(lo, hi + 1):
             if not self._owns_window(w) or w in kd.fired_ahead:
@@ -224,9 +260,7 @@ class WindowEngine:
         if self.incremental:
             value = ow.acc
         else:
-            lo = bisect.bisect_left(kd.archive, (start, -1))
-            hi = bisect.bisect_left(kd.archive, (end, -1))
-            items = [e[2] for e in kd.archive[lo:hi]
+            items = [e[2] for e in kd.archive.range(start, end)
                      if self._keeps_tuple(e[1])]
             value = self.fn(items)
         # advance the fired frontier, tolerating out-of-order completions
@@ -243,9 +277,6 @@ class WindowEngine:
     def _purge(self, kd: _KeyDescriptor) -> None:
         """Drop archived tuples no longer covered by any unfired window
         (reference ``StreamArchive::purge``)."""
-        if self.incremental or not kd.archive:
+        if self.incremental or not len(kd.archive):
             return
-        min_needed = kd.next_unfired * self.spec.slide
-        lo = bisect.bisect_left(kd.archive, (min_needed, -1))
-        if lo > 0:
-            del kd.archive[:lo]
+        kd.archive.purge_below(kd.next_unfired * self.spec.slide)
