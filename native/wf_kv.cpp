@@ -46,6 +46,7 @@ struct WfKv {
 };
 
 constexpr int64_t kHeader = 12;  // u32 klen + i64 vlen
+constexpr uint32_t kMaxKey = 1u << 20;  // writer cap == scanner sanity bound
 
 int64_t record_size(int64_t klen, int64_t vlen) {
     return kHeader + klen + (vlen > 0 ? vlen : 0);
@@ -88,7 +89,7 @@ int64_t scan(WfKv* kv) {
         int64_t vlen;
         std::memcpy(&klen, hdr, 4);
         std::memcpy(&vlen, hdr + 4, 8);
-        if (vlen < -1 || klen > (1u << 20)) break;  // corrupt header
+        if (vlen < -1 || klen > kMaxKey) break;  // corrupt header
         const int64_t rec = record_size(klen, vlen);
         if (off + rec > size) break;  // truncated tail
         key.resize(klen);
@@ -146,6 +147,7 @@ void* wf_kv_open(const char* path, int32_t create) {
 int32_t wf_kv_put(void* h, const uint8_t* k, int32_t klen, const uint8_t* v,
                   int64_t vlen) {
     auto* kv = static_cast<WfKv*>(h);
+    if ((uint32_t)klen > kMaxKey) return -1;  // scan() rejects larger keys
     std::lock_guard<std::mutex> g(kv->mu);
     int64_t off = kv->end;
     if (!append(kv, k, (uint32_t)klen, v, vlen)) return -1;
@@ -183,9 +185,14 @@ int32_t wf_kv_del(void* h, const uint8_t* k, int32_t klen) {
     std::string key(reinterpret_cast<const char*>(k), (size_t)klen);
     auto it = kv->index.find(key);
     if (it == kv->index.end()) return 0;
+    if (!append(kv, k, (uint32_t)klen, nullptr, -1)) {
+        // Tombstone write failed (e.g. ENOSPC): without it, the old record
+        // would resurrect on reopen — keep the index entry consistent with
+        // the log and report the failure instead.
+        return -1;
+    }
     kv->live -= record_size(klen, it->second.val_len);
     kv->index.erase(it);
-    append(kv, k, (uint32_t)klen, nullptr, -1);  // tombstone
     return 1;
 }
 

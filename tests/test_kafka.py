@@ -1,0 +1,170 @@
+"""Kafka integration tests (reference ``tests/kafka_tests/`` runs against a
+live local broker; here the in-process broker plays that role, exercising
+the same operator surface: per-replica consumers in one group, partition
+assignment + rebalance, deserializer/serializer contracts, idle callbacks)."""
+
+import pytest
+
+import windflow_tpu as wf
+from windflow_tpu.kafka import (InMemoryBroker, KafkaMessage, KafkaSink_Builder,
+                                KafkaSinkMessage, KafkaSource_Builder)
+
+
+def fill_topic(broker, topic, n, partitions=4):
+    broker.create_topic(topic, partitions)
+    prod = broker.producer()
+    for i in range(n):
+        prod.produce(topic, {"key": i % 8, "value": i},
+                     key=str(i % 8).encode())
+    prod.flush()
+    return prod
+
+
+# ---------------------------------------------------------------------------
+# Broker semantics
+# ---------------------------------------------------------------------------
+
+def test_consumer_group_partitions_disjoint_and_complete():
+    broker = InMemoryBroker()
+    fill_topic(broker, "t", 100, partitions=6)
+    c1, c2, c3 = (broker.consumer() for _ in range(3))
+    for c in (c1, c2, c3):
+        c.subscribe(["t"], "g1")
+    parts = [set(c.assignment()) for c in (c1, c2, c3)]
+    assert set.union(*parts) == {("t", p) for p in range(6)}
+    assert sum(len(p) for p in parts) == 6  # disjoint
+    got = []
+    for c in (c1, c2, c3):
+        got.extend(m.value["value"] for m in c.poll(1000))
+    assert sorted(got) == list(range(100))
+
+
+def test_rebalance_resumes_positions():
+    """A partition handed to another member resumes at the group position —
+    cooperative-rebalance semantics."""
+    broker = InMemoryBroker()
+    fill_topic(broker, "t", 60, partitions=2)
+    c1 = broker.consumer()
+    c1.subscribe(["t"], "g")
+    first = c1.poll(30)          # reads some of both partitions
+    assert len(first) == 30
+    c2 = broker.consumer()
+    c2.subscribe(["t"], "g")     # rebalance: one partition moves to c2
+    assert len(c1.assignment()) == 1 and len(c2.assignment()) == 1
+    rest = [m.value["value"] for c in (c1, c2) for m in c.poll(1000)]
+    assert sorted([m.value["value"] for m in first] + rest) == list(range(60))
+    c1.close()                   # leave: partitions return to c2
+    assert len(c2.assignment()) == 2
+
+
+def test_explicit_offsets():
+    broker = InMemoryBroker()
+    fill_topic(broker, "t", 20, partitions=1)
+    c = broker.consumer()
+    c.subscribe(["t"], "g_off", offsets=[15])
+    vals = [m.value["value"] for m in c.poll(100)]
+    assert vals == list(range(15, 20))
+
+
+# ---------------------------------------------------------------------------
+# Operators in graphs
+# ---------------------------------------------------------------------------
+
+def run_kafka_graph(par, n=200):
+    broker = InMemoryBroker()
+    fill_topic(broker, "in", n, partitions=4)
+    broker.create_topic("out", 2)
+    seen = {"eos_idle": 0}
+
+    def deser(msg, shipper, ctx):
+        # stop on first idle callback after the topic drains (reference:
+        # deserializer returns false to end the stream)
+        if msg is None:
+            seen["eos_idle"] += 1
+            return False
+        assert isinstance(msg, KafkaMessage)
+        shipper.pushWithTimestamp(msg.value, msg.timestamp_usec)
+        return True
+
+    def ser(item, ctx):
+        if item["value"] % 2:
+            return None  # drop odd values: serializer may skip
+        return KafkaSinkMessage(topic="out", payload=item["value"],
+                                key=str(item["key"]).encode())
+
+    src = (KafkaSource_Builder(deser).withBrokers(broker)
+           .withTopics("in").withGroupID("g").withIdleness(0)
+           .withParallelism(par[0]).build())
+    mp_op = (wf.Map_Builder(lambda t: {"key": t["key"],
+                                       "value": t["value"] * 3})
+             .withParallelism(par[1]).build())
+    snk = (KafkaSink_Builder(ser).withBrokers(broker)
+           .withParallelism(par[2]).build())
+    g = wf.PipeGraph("kafka_graph", wf.ExecutionMode.DEFAULT)
+    g.add_source(src).add(mp_op).add_sink(snk)
+    g.run()
+    # collect everything that landed in "out"
+    c = broker.consumer()
+    c.subscribe(["out"], "check")
+    vals = [m.value for m in c.poll(10_000)]
+    return sorted(vals), seen
+
+
+@pytest.mark.parametrize("par", [(1, 1, 1), (3, 2, 2), (4, 1, 3)])
+def test_kafka_source_to_sink(par):
+    n = 200
+    vals, seen = run_kafka_graph(par, n)
+    expected = sorted(v * 3 for v in range(n) if (v * 3) % 2 == 0)
+    assert vals == expected
+    assert seen["eos_idle"] == par[0]  # one idle stop per source replica
+
+
+def test_kafka_source_parallel_replicas_cover_all_partitions():
+    broker = InMemoryBroker()
+    fill_topic(broker, "in", 120, partitions=5)
+    got = []
+
+    def deser(msg, shipper):
+        if msg is None:
+            return False
+        shipper.push(msg.value["value"])
+        return True
+
+    src = (KafkaSource_Builder(deser).withBrokers(broker)
+           .withTopics("in").withGroupID("g2").withIdleness(0)
+           .withParallelism(3).build())
+    snk = (wf.Sink_Builder(lambda t, ctx=None: got.append(t)
+                           if t is not None else None).build())
+    g = wf.PipeGraph("kafka_par", wf.ExecutionMode.DEFAULT)
+    g.add_source(src).add_sink(snk)
+    g.run()
+    assert sorted(got) == list(range(120))
+
+
+def test_kafka_context_exposes_clients():
+    broker = InMemoryBroker()
+    fill_topic(broker, "in", 10, partitions=1)
+    seen = {}
+
+    def deser(msg, shipper, ctx):
+        seen["consumer"] = ctx.consumer is not None
+        seen["assignment"] = ctx.consumer.assignment()
+        if msg is None:
+            return False
+        shipper.push(msg.value)
+        return True
+
+    src = (KafkaSource_Builder(deser).withBrokers(broker)
+           .withTopics("in").withIdleness(0).build())
+    snk = wf.Sink_Builder(lambda t, ctx=None: None).build()
+    g = wf.PipeGraph("kafka_ctx", wf.ExecutionMode.DEFAULT)
+    g.add_source(src).add_sink(snk)
+    g.run()
+    assert seen["consumer"] is True
+    assert seen["assignment"] == [("in", 0)]
+
+
+def test_real_broker_requires_client_library():
+    from windflow_tpu.kafka.client import make_consumer
+    with pytest.raises(wf.WindFlowError, match="confluent_kafka"):
+        make_consumer("localhost:9092").subscribe(["t"], "g")

@@ -18,6 +18,7 @@ from typing import Dict, Iterator, List, Optional, Tuple
 from windflow_tpu import native
 
 _HDR = struct.Struct("<Iq")  # u32 klen, i64 vlen (-1 = tombstone)
+_MAX_KEY = 1 << 20           # writer cap == scanner sanity bound
 
 
 class _PyKV:
@@ -42,7 +43,7 @@ class _PyKV:
             if len(hdr) < _HDR.size:
                 break
             klen, vlen = _HDR.unpack(hdr)
-            if vlen < -1 or klen > (1 << 20):
+            if vlen < -1 or klen > _MAX_KEY:
                 break
             rec = _HDR.size + klen + max(vlen, 0)
             if off + rec > size:
@@ -58,6 +59,10 @@ class _PyKV:
         return off
 
     def _append(self, key: bytes, val: Optional[bytes]) -> None:
+        if len(key) > _MAX_KEY:
+            raise ValueError(
+                f"key of {len(key)} bytes exceeds the {_MAX_KEY}-byte cap "
+                "(the open-time log scan would treat it as corruption)")
         vlen = -1 if val is None else len(val)
         self._f.seek(self._end)
         self._f.write(_HDR.pack(len(key), vlen) + key + (val or b""))
@@ -140,6 +145,9 @@ class _NativeKV:
             raise OSError(f"wf_kv_open failed for {path!r}")
 
     def put(self, key: bytes, val: bytes) -> None:
+        if len(key) > _MAX_KEY:
+            raise ValueError(
+                f"key of {len(key)} bytes exceeds the {_MAX_KEY}-byte cap")
         if self._L.wf_kv_put(self._h, key, len(key), val, len(val)) != 0:
             raise OSError(f"wf_kv_put failed for {self.path!r}")
 

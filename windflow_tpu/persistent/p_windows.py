@@ -76,12 +76,24 @@ class SpillingArchive(Archive):
                 keep.append(frag)
         self._frags = keep
         self._mem = [e for e in self._mem if e[0] >= d]
+        self._recompute_mm()
 
     def clear(self) -> None:
         for frag in self._frags:
             self._db.delete(self._frag_key(frag[2]))
         self._frags = []
         self._mem = []
+        self._min = self._max = None
+
+    def _recompute_mm(self) -> None:
+        # keep the buffer's min/max tight after purges, or the next spilled
+        # fragment's metadata would cover phantom domains (making range()
+        # load it needlessly and purge_below() never reclaim it)
+        if self._mem:
+            ds = [e[0] for e in self._mem]
+            self._min, self._max = min(ds), max(ds)
+        else:
+            self._min = self._max = None
 
     def __len__(self) -> int:
         return len(self._mem) + sum(f[3] for f in self._frags)
