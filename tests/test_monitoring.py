@@ -1,0 +1,144 @@
+"""Observability tests: diagram generation, stats JSON schema/dump, and the
+dashboard TCP protocol against a stub server (the reference tests the
+protocol with ``dashboard/Stub_Client``; here the stub is the server side)."""
+
+import json
+import socket
+import struct
+import threading
+
+import windflow_tpu as wf
+from windflow_tpu.monitoring import to_dot, to_svg
+
+
+def build_graph(tracing=False, port=None):
+    cfg = None
+    if tracing:
+        import dataclasses
+        from windflow_tpu.basic import default_config
+        cfg = dataclasses.replace(default_config, tracing_enabled=True,
+                                  dashboard_host="127.0.0.1",
+                                  dashboard_port=port)
+    src = (wf.Source_Builder(
+        lambda: iter({"key": i % 4, "value": i} for i in range(5000)))
+        .withName("src").build())
+    mp = (wf.Map_Builder(lambda t: {"key": t["key"], "value": t["value"] + 1})
+          .withName("mapper").withParallelism(2).build())
+    snk = wf.Sink_Builder(lambda t, ctx=None: None).withName("sink").build()
+    g = wf.PipeGraph("monitored_app", wf.ExecutionMode.DEFAULT, config=cfg)
+    g.add_source(src).add(mp).add_sink(snk)
+    return g
+
+
+def test_dot_and_svg_diagram():
+    g = build_graph()
+    g.start()
+    dot = to_dot(g)
+    assert 'digraph "monitored_app"' in dot
+    assert "src" in dot and "mapper" in dot and "sink" in dot
+    assert dot.count("->") == 2
+    svg = to_svg(g)
+    assert svg.lstrip().startswith("<")
+    assert "svg" in svg[:200]
+    while not g.is_done():
+        g.step()
+    g._finalize()
+
+
+def test_stats_schema_and_dump(tmp_path):
+    g = build_graph()
+    g.run()
+    st = g.stats()
+    for field in ("PipeGraph_name", "Mode", "Backpressure", "Dropped_tuples",
+                  "Operator_number", "Thread_number", "rss_size_kb",
+                  "Operators"):
+        assert field in st, field
+    assert st["Operator_number"] == 3
+    assert st["rss_size_kb"] > 0
+    mapper = next(o for o in st["Operators"]
+                  if o["Operator_name"] == "mapper")
+    assert len(mapper["Replicas"]) == 2
+    assert sum(r["Inputs_received"] for r in mapper["Replicas"]) == 5000
+    path = g.dump_stats(str(tmp_path))
+    with open(path) as f:
+        assert json.load(f)["PipeGraph_name"] == "monitored_app"
+
+
+class StubDashboard(threading.Thread):
+    """Speaks the server side of the reference protocol
+    (``monitoring.hpp:226-260``): ack every message with status 0, hand out
+    app identifier 77."""
+
+    def __init__(self):
+        super().__init__(daemon=True)
+        self.server = socket.socket()
+        self.server.bind(("127.0.0.1", 0))
+        self.server.listen(1)
+        self.port = self.server.getsockname()[1]
+        self.messages = []
+
+    def _recv(self, conn, n):
+        buf = b""
+        while len(buf) < n:
+            chunk = conn.recv(n - len(buf))
+            if not chunk:
+                raise ConnectionError
+            buf += chunk
+        return buf
+
+    def run(self):
+        conn, _ = self.server.accept()
+        try:
+            # NEW_APP: [type, len] + payload, ack [0, id]
+            mtype, length = struct.unpack(">ii", self._recv(conn, 8))
+            payload = self._recv(conn, length)
+            self.messages.append((mtype, payload))
+            conn.sendall(struct.pack(">ii", 0, 77))
+            # reports until the client closes
+            while True:
+                try:
+                    hdr = self._recv(conn, 12)
+                except ConnectionError:
+                    break
+                mtype, ident, length = struct.unpack(">iii", hdr)
+                payload = self._recv(conn, length)
+                self.messages.append((mtype, ident, payload))
+                conn.sendall(struct.pack(">ii", 0, 0))
+        finally:
+            conn.close()
+            self.server.close()
+
+
+def test_dashboard_protocol_roundtrip():
+    stub = StubDashboard()
+    stub.start()
+    g = build_graph(tracing=True, port=stub.port)
+    g.run()
+    stub.join(timeout=5)
+    assert stub.messages, "dashboard never contacted"
+    # registration: type 0, NUL-terminated SVG payload
+    mtype, payload = stub.messages[0]
+    assert mtype == 0
+    assert payload.endswith(b"\0")
+    assert b"svg" in payload[:200].lower() or b"<" in payload[:10]
+    # final message: END_APP (type 2) with the handed-out identifier and a
+    # parseable JSON stats report
+    mtype, ident, payload = stub.messages[-1]
+    assert mtype == 2
+    assert ident == 77
+    report = json.loads(payload.rstrip(b"\0"))
+    assert report["PipeGraph_name"] == "monitored_app"
+    assert report["Operator_number"] == 3
+
+
+def test_monitoring_switches_off_when_unreachable():
+    """Reference behavior (monitoring.hpp:197-200): no dashboard, no harm."""
+    # grab a port with nothing listening
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    dead_port = s.getsockname()[1]
+    s.close()
+    g = build_graph(tracing=True, port=dead_port)
+    g.run()  # must complete normally
+    assert g.is_done()
+    assert g._monitor is None  # stopped and cleared at finalize

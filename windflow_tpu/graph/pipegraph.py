@@ -31,6 +31,17 @@ from windflow_tpu.parallel.collectors import KSlackCollector, create_collector
 from windflow_tpu.parallel.emitters import SplittingEmitter, create_emitter
 
 
+def _rss_kb() -> float:
+    """Resident set size in KiB (reference ``get_MemUsage``,
+    ``monitoring.hpp:52-70``)."""
+    try:
+        with open("/proc/self/statm") as f:
+            resident_pages = int(f.read().split()[1])
+        return resident_pages * (os.sysconf("SC_PAGE_SIZE") / 1024.0)
+    except (OSError, ValueError, IndexError):
+        return 0.0
+
+
 class PipeGraph:
     def __init__(self, name: str = "app",
                  mode: ExecutionMode = ExecutionMode.DEFAULT,
@@ -48,6 +59,7 @@ class PipeGraph:
         self._all_replicas = []
         self._source_replicas: List[SourceReplica] = []
         self._operators: List[Operator] = []
+        self._monitor = None
 
     # -- construction --------------------------------------------------------
     def add_source(self, source: Source) -> MultiPipe:
@@ -177,6 +189,12 @@ class PipeGraph:
             raise WindFlowError("PipeGraph already started")
         self._started = True
         self._build()
+        if self.config.tracing_enabled:
+            # reference: tracing spawns a MonitoringThread at run()
+            # (pipegraph.hpp:676-678)
+            from windflow_tpu.monitoring.monitor import MonitoringThread
+            self._monitor = MonitoringThread(self)
+            self._monitor.start()
         for sr in self._source_replicas:
             sr.start()
 
@@ -199,6 +217,9 @@ class PipeGraph:
         return all(r.done for r in self._all_replicas)
 
     def _finalize(self) -> None:
+        if self._monitor is not None:
+            self._monitor.stop()
+            self._monitor = None
         if self.config.tracing_enabled:
             self.dump_stats()
 
@@ -206,12 +227,26 @@ class PipeGraph:
     def get_num_dropped_tuples(self) -> int:
         return sum(c.num_dropped for c in self._collectors)
 
+    def to_dot(self) -> str:
+        """Graphviz DOT diagram of the graph (reference
+        ``pipegraph.hpp:560-576``)."""
+        from windflow_tpu.monitoring.diagram import to_dot
+        return to_dot(self)
+
     def stats(self) -> dict:
+        """Stats report; schema follows the reference's dashboard JSON
+        (``pipegraph.hpp:468-526``).  The fixed reference fields describe the
+        FastFlow runtime; here they describe the host driver equivalents."""
         return {
             "PipeGraph_name": self.name,
             "Mode": self.mode.value,
-            "Operator_number": len(self._operators),
+            "Backpressure": "ON",     # in-transit batch throttling
+            "Non_blocking": "ON",     # async XLA dispatch
+            "Thread_pinning": "OFF",  # single dispatch loop, no pinning
             "Dropped_tuples": self.get_num_dropped_tuples(),
+            "Operator_number": len(self._operators),
+            "Thread_number": 1 + (1 if self._monitor is not None else 0),
+            "rss_size_kb": _rss_kb(),
             "Operators": [op.dump_stats() for op in self._operators],
         }
 

@@ -103,6 +103,15 @@ class InMemoryBroker:
                 return
             self._topics[name] = [_Partition()
                                   for _ in range(num_partitions)]
+            self._rebalance_subscribers(name)
+
+    def _rebalance_subscribers(self, topic: str) -> None:
+        """New topic (explicit or auto-created by produce): groups already
+        subscribed to it must pick up its partitions, like a metadata
+        refresh on a real broker.  Caller holds the lock."""
+        for g in self._groups.values():
+            if any(topic in m._topics for m in g.members):
+                g.rebalance(self)
 
     def partitions(self, topic: str) -> int:
         with self._lock:
@@ -118,7 +127,10 @@ class InMemoryBroker:
     def _append(self, topic: str, value: Any, key: Optional[bytes],
                 partition: Optional[int], ts: Optional[int]) -> None:
         with self._lock:
-            parts = self._topics.setdefault(topic, [_Partition()])
+            parts = self._topics.get(topic)
+            if parts is None:
+                parts = self._topics[topic] = [_Partition()]
+                self._rebalance_subscribers(topic)
             if partition is None:
                 if key is not None:
                     partition = hash(key) % len(parts)
@@ -322,7 +334,14 @@ class ConfluentProducer(ProducerClient):
             kwargs["partition"] = partition
         if timestamp_usec is not None:
             kwargs["timestamp"] = timestamp_usec // 1000
-        self._producer.produce(topic, value=value, key=key, **kwargs)
+        try:
+            self._producer.produce(topic, value=value, key=key, **kwargs)
+        except BufferError:
+            # librdkafka's delivery queue is full: service it, then retry
+            # once (blocking until there is room)
+            self._producer.poll(1.0)
+            self._producer.produce(topic, value=value, key=key, **kwargs)
+        self._producer.poll(0)  # service delivery callbacks as we go
 
     def flush(self):
         self._producer.flush()

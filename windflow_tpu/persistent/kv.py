@@ -85,11 +85,14 @@ class _PyKV:
         return self._f.read(e[1])
 
     def delete(self, key: bytes) -> bool:
-        e = self._index.pop(key, None)
+        e = self._index.get(key)
         if e is None:
             return False
-        self._live -= _HDR.size + len(key) + max(e[1], 0)
+        # tombstone first: if the append fails (ENOSPC), the index must keep
+        # matching the log or the record would resurrect on reopen
         self._append(key, None)
+        del self._index[key]
+        self._live -= _HDR.size + len(key) + max(e[1], 0)
         return True
 
     def keys(self) -> List[bytes]:
@@ -162,7 +165,11 @@ class _NativeKV:
         return buf.raw[:n]
 
     def delete(self, key: bytes) -> bool:
-        return bool(self._L.wf_kv_del(self._h, key, len(key)))
+        ret = self._L.wf_kv_del(self._h, key, len(key))
+        if ret < 0:
+            raise OSError(f"wf_kv_del failed for {self.path!r} "
+                          "(tombstone write error)")
+        return bool(ret)
 
     def keys(self) -> List[bytes]:
         it = self._L.wf_kv_iter_new(self._h)
