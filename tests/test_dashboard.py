@@ -1,0 +1,79 @@
+"""Dashboard server tests: a traced graph registers over TCP and its
+reports become visible over HTTP (end-to-end counterpart of the reference's
+dashboard protocol + REST surface)."""
+
+import dataclasses
+import json
+import urllib.request
+
+import windflow_tpu as wf
+from windflow_tpu.basic import default_config
+from windflow_tpu.monitoring import DashboardServer
+
+
+def _get(port, path):
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}{path}", timeout=5) as r:
+            return r.status, r.read()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read()
+
+
+def test_dashboard_end_to_end():
+    server = DashboardServer(tcp_port=0, http_port=0).start()
+    try:
+        cfg = dataclasses.replace(default_config, tracing_enabled=True,
+                                  dashboard_host="127.0.0.1",
+                                  dashboard_port=server.tcp_port)
+        src = (wf.Source_Builder(
+            lambda: iter({"k": i % 3, "v": i} for i in range(2000)))
+            .withName("src").build())
+        snk = wf.Sink_Builder(lambda t, ctx=None: None).withName("snk").build()
+        g = wf.PipeGraph("dash_app", wf.ExecutionMode.DEFAULT, config=cfg)
+        g.add_source(src).add_sink(snk)
+        g.run()
+
+        status, body = _get(server.http_port, "/apps")
+        assert status == 200
+        apps = json.loads(body)
+        assert len(apps) == 1
+        app = apps[0]
+        assert app["name"] == "dash_app"
+        assert app["alive"] is False        # END_APP received
+        assert app["num_reports"] >= 1
+
+        status, body = _get(server.http_port, f"/apps/{app['id']}/latest")
+        report = json.loads(body)
+        assert report["PipeGraph_name"] == "dash_app"
+        assert report["Operator_number"] == 2
+
+        status, body = _get(server.http_port, f"/apps/{app['id']}/diagram")
+        assert status == 200
+        assert b"svg" in body[:200].lower() or body[:1] == b"<"
+
+        status, _ = _get(server.http_port, "/apps/999")
+        assert status == 404
+    finally:
+        server.stop()
+
+
+def test_dashboard_multiple_apps():
+    server = DashboardServer(tcp_port=0, http_port=0).start()
+    try:
+        for name in ("app_a", "app_b"):
+            cfg = dataclasses.replace(default_config, tracing_enabled=True,
+                                      dashboard_host="127.0.0.1",
+                                      dashboard_port=server.tcp_port)
+            src = (wf.Source_Builder(lambda: iter(range(100)))
+                   .withName("s").build())
+            snk = wf.Sink_Builder(lambda t, ctx=None: None).build()
+            g = wf.PipeGraph(name, wf.ExecutionMode.DEFAULT, config=cfg)
+            g.add_source(src).add_sink(snk)
+            g.run()
+        _, body = _get(server.http_port, "/apps")
+        apps = json.loads(body)
+        assert sorted(a["name"] for a in apps) == ["app_a", "app_b"]
+        assert [a["id"] for a in apps] == sorted(a["id"] for a in apps)
+    finally:
+        server.stop()

@@ -135,3 +135,36 @@ def test_probabilistic_drops_counted():
     g.run()
     assert len(got) + g.get_num_dropped_tuples() == length
     assert len(got) > 0
+
+
+def test_rebalancing_after_keyby():
+    """REBALANCING routing (reference basic.hpp:87): round-robin even after
+    a keyed stage, spreading a skewed key across replicas."""
+    length = 400
+    seen_replicas = set()
+
+    def spy(t, ctx):
+        seen_replicas.add(ctx.replica_index)
+        return t
+
+    src = (wf.Source_Builder(
+        lambda: iter({"key": 0, "value": i} for i in range(length)))
+        .withName("src").build())
+    red = (wf.Reduce_Builder(lambda t, s: {**t, "n": s.get("n", 0) + 1}, dict)
+           .withKeyBy(lambda t: t["key"]).withParallelism(3).build())
+    reb = (wf.Map_Builder(spy).withName("rebalanced")
+           .withParallelism(4).withRebalancing().build())
+    acc = Acc()
+    snk = wf.Sink_Builder(acc).build()
+    g = wf.PipeGraph("rebalance", wf.ExecutionMode.DEFAULT)
+    g.add_source(src).add(red).add(reb).add_sink(snk)
+    g.run()
+    assert acc.count == length
+    # single hot key, but rebalancing spread work over every replica
+    assert seen_replicas == {0, 1, 2, 3}
+
+
+def test_rebalancing_conflicts_with_keyby():
+    with pytest.raises(wf.WindFlowError):
+        (wf.Map_Builder(lambda t: t).withKeyBy(lambda t: t)
+         .withRebalancing()._routing())

@@ -5,6 +5,7 @@ protocol with ``dashboard/Stub_Client``; here the stub is the server side)."""
 import json
 import socket
 import struct
+import sys
 import threading
 
 import windflow_tpu as wf
@@ -54,7 +55,8 @@ def test_stats_schema_and_dump(tmp_path):
                   "Operators"):
         assert field in st, field
     assert st["Operator_number"] == 3
-    assert st["rss_size_kb"] > 0
+    if sys.platform == "linux":  # _rss_kb reads /proc/self/statm
+        assert st["rss_size_kb"] > 0
     mapper = next(o for o in st["Operators"]
                   if o["Operator_name"] == "mapper")
     assert len(mapper["Replicas"]) == 2

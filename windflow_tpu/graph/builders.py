@@ -46,7 +46,19 @@ class _BuilderBase:
         self._key_extractor = key_extractor
         return self
 
+    def withRebalancing(self):
+        """Round-robin input distribution even after an upstream KEYBY
+        (reference REBALANCING routing, ``basic.hpp:87`` / builders
+        ``withRebalancing``).  Mutually exclusive with withKeyBy."""
+        self._rebalancing = True
+        return self
+
     def _routing(self) -> RoutingMode:
+        if getattr(self, "_rebalancing", False):
+            if self._key_extractor is not None:
+                raise WindFlowError(
+                    "withRebalancing and withKeyBy are mutually exclusive")
+            return RoutingMode.REBALANCING
         return (RoutingMode.KEYBY if self._key_extractor is not None
                 else RoutingMode.FORWARD)
 
@@ -67,6 +79,9 @@ class Source_Builder(_BuilderBase):
 
     def withKeyBy(self, *_):
         raise WindFlowError("a Source has no input to key by")
+
+    def withRebalancing(self):
+        raise WindFlowError("a Source has no input to rebalance")
 
     def build(self) -> Source:
         return Source(self._gen_fn, name=self._name,
@@ -126,6 +141,11 @@ class Reduce_Builder(_BuilderBase):
         super().__init__()
         self._fn = fn
         self._initial_state = initial_state
+
+    def withRebalancing(self):
+        raise WindFlowError(
+            "Reduce routes by key (or runs non-replicated); REBALANCING "
+            "does not apply")
 
     def build(self) -> Reduce:
         return Reduce(self._fn, self._initial_state, name=self._name,
@@ -188,6 +208,10 @@ class MapTPU_Builder(_StatefulTPUMixin, _BuilderBase):
                     "batch_fn is not supported for stateful MapTPU: the "
                     "stateful function operates per record as "
                     "fn(record, state) -> (record, state)")
+            if getattr(self, "_rebalancing", False):
+                raise WindFlowError(
+                    "stateful TPU operators route by key; REBALANCING "
+                    "does not apply")
             return StatefulMapTPU(self._fn, self._initial_state,
                                   name=self._name,
                                   parallelism=self._parallelism,
@@ -208,6 +232,10 @@ class FilterTPU_Builder(_StatefulTPUMixin, _BuilderBase):
 
     def build(self):
         if self._initial_state is not None:
+            if getattr(self, "_rebalancing", False):
+                raise WindFlowError(
+                    "stateful TPU operators route by key; REBALANCING "
+                    "does not apply")
             return StatefulFilterTPU(self._fn, self._initial_state,
                                      name=self._name,
                                      parallelism=self._parallelism,
@@ -225,6 +253,11 @@ class ReduceTPU_Builder(_BuilderBase):
     def __init__(self, comb: Callable) -> None:
         super().__init__()
         self._comb = comb
+
+    def withRebalancing(self):
+        raise WindFlowError(
+            "ReduceTPU routes by key (or reduces globally); REBALANCING "
+            "does not apply")
 
     def build(self) -> ReduceTPU:
         return ReduceTPU(self._comb, name=self._name,
@@ -248,6 +281,11 @@ from windflow_tpu.windows.ffat_tpu import FfatWindowsTPU  # noqa: E402
 
 
 class _WindowBuilderBase(_BuilderBase):
+    def withRebalancing(self):
+        raise WindFlowError(
+            "window operators route by key / broadcast; REBALANCING does "
+            "not apply")
+
     def __init__(self):
         super().__init__()
         self._win_type = None

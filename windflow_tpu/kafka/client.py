@@ -334,13 +334,15 @@ class ConfluentProducer(ProducerClient):
             kwargs["partition"] = partition
         if timestamp_usec is not None:
             kwargs["timestamp"] = timestamp_usec // 1000
-        try:
-            self._producer.produce(topic, value=value, key=key, **kwargs)
-        except BufferError:
-            # librdkafka's delivery queue is full: service it, then retry
-            # once (blocking until there is room)
-            self._producer.poll(1.0)
-            self._producer.produce(topic, value=value, key=key, **kwargs)
+        while True:
+            try:
+                self._producer.produce(topic, value=value, key=key, **kwargs)
+                break
+            except BufferError:
+                # librdkafka's delivery queue is full: service callbacks
+                # until there is room (sustained backpressure can take
+                # several poll rounds)
+                self._producer.poll(1.0)
         self._producer.poll(0)  # service delivery callbacks as we go
 
     def flush(self):

@@ -35,16 +35,20 @@ def _graph_nodes_edges(graph) -> Tuple[List, List]:
     return ops, edges
 
 
+def _dot_escape(s: str) -> str:
+    return s.replace("\\", "\\\\").replace('"', '\\"')
+
+
 def _label(op) -> str:
     kind = type(op).__name__
     extra = " [TPU]" if getattr(op, "is_tpu", False) else ""
-    return f"{op.name}\\n{kind}{extra} ({op.parallelism})"
+    return f"{_dot_escape(op.name)}\\n{kind}{extra} ({op.parallelism})"
 
 
 def to_dot(graph) -> str:
     """Graphviz DOT text for a built PipeGraph."""
     ops, edges = _graph_nodes_edges(graph)
-    lines = [f'digraph "{graph.name}" {{',
+    lines = [f'digraph "{_dot_escape(graph.name)}" {{',
              "  rankdir=LR;",
              '  node [shape=box, style="rounded,filled", '
              'fillcolor=lightblue, fontname=Helvetica];']

@@ -30,16 +30,13 @@ TYPE_NEW_REPORT = 1
 TYPE_END_APP = 2
 
 
-def _send_all(sock: socket.socket, data: bytes) -> None:
-    sock.sendall(data)
-
-
-def _recv_exact(sock: socket.socket, n: int) -> bytes:
+def recv_exact(sock: socket.socket, n: int) -> bytes:
+    """Read exactly ``n`` bytes (shared by both protocol ends)."""
     buf = b""
     while len(buf) < n:
         chunk = sock.recv(n - len(buf))
         if not chunk:
-            raise ConnectionError("dashboard closed the connection")
+            raise ConnectionError("peer closed the connection")
         buf += chunk
     return buf
 
@@ -57,19 +54,19 @@ class MonitoringThread:
     def _register_app(self) -> None:
         from windflow_tpu.monitoring.diagram import to_svg
         payload = to_svg(self.graph).encode() + b"\0"
-        _send_all(self._sock, struct.pack(">ii", TYPE_NEW_APP, len(payload)))
-        _send_all(self._sock, payload)
-        status, ident = struct.unpack(">ii", _recv_exact(self._sock, 8))
+        self._sock.sendall(struct.pack(">ii", TYPE_NEW_APP, len(payload)))
+        self._sock.sendall(payload)
+        status, ident = struct.unpack(">ii", recv_exact(self._sock, 8))
         if status != 0:
             raise ConnectionError(f"dashboard rejected NEW_APP: {status}")
         self.identifier = ident
 
     def _send_report(self, msg_type: int) -> None:
         payload = json.dumps(self.graph.stats()).encode() + b"\0"
-        _send_all(self._sock, struct.pack(">iii", msg_type, self.identifier,
+        self._sock.sendall(struct.pack(">iii", msg_type, self.identifier,
                                           len(payload)))
-        _send_all(self._sock, payload)
-        status, _ = struct.unpack(">ii", _recv_exact(self._sock, 8))
+        self._sock.sendall(payload)
+        status, _ = struct.unpack(">ii", recv_exact(self._sock, 8))
         if status != 0:
             raise ConnectionError(f"dashboard rejected report: {status}")
 

@@ -81,6 +81,12 @@ class _PersistentOpBuilder(_PersistentBuilderMixin, _BuilderBase):
         _PersistentBuilderMixin.__init__(self)
         self._fn = fn
 
+    def withRebalancing(self):
+        from windflow_tpu.basic import WindFlowError
+        raise WindFlowError(
+            "persistent operators route by key (their state is keyed); "
+            "REBALANCING does not apply")
+
     def build(self):
         return self._op_class(
             self._fn, name=self._name, parallelism=self._parallelism,
