@@ -24,11 +24,12 @@ import numpy as np
 
 from windflow_tpu.windows.ffat_kernels import make_ffat_state, make_ffat_step
 
-CAP = 32768          # tuples per staged batch
+CAP = 262144         # tuples per staged batch (sweet spot on v5e: the
+                     # sliding-reduce kernel is dispatch-bound below ~128k)
 K = 1024             # distinct keys
 WIN, SLIDE = 1024, 128
-WARMUP = 3
-STEPS = 30
+WARMUP = 6
+STEPS = 40
 LAT_STEPS = 20
 
 

@@ -34,14 +34,10 @@ def _masked_reduce_last(comb, flags, values, axis):
     """Reduce ``values`` along ``axis`` with ``comb``, skipping entries whose
     flag is False; returns (any_flag, reduction).  Flag-aware monoid:
     associative, no identity needed."""
+    fc = _flag_comb(comb)
+
     def op(a, b):
-        fa, va = a
-        fb, vb = b
-        both = comb(va, vb)
-        v = jax.tree.map(
-            lambda c, xa, xb: jnp.where(_b(fb, c), jnp.where(_b(fa, c), c, xb),
-                                        xa), both, va, vb)
-        return (fa | fb, v)
+        return fc(*a, *b)
 
     f, v = jax.lax.associative_scan(op, (flags, values), axis=axis)
     take = lambda x: jax.lax.index_in_dim(x, x.shape[axis] - 1, axis,
@@ -52,6 +48,66 @@ def _masked_reduce_last(comb, flags, values, axis):
 def _b(mask, ref):
     """Broadcast a bool mask against a leaf with trailing dims."""
     return mask.reshape(mask.shape + (1,) * (ref.ndim - mask.ndim))
+
+
+def _shift_right(flags, values, k: int, axis: int):
+    """Shift along ``axis`` by ``k`` positions (toward higher indices),
+    filling vacated slots with invalid entries."""
+    if k == 0:
+        return flags, values
+
+    def shift_leaf(a):
+        pad = [(0, 0)] * a.ndim
+        pad[axis] = (k, 0)
+        s = [slice(None)] * a.ndim
+        s[axis] = slice(0, a.shape[axis])
+        return jnp.pad(a, pad)[tuple(s)]  # bool pads False = invalid fill
+
+    return shift_leaf(flags), jax.tree.map(shift_leaf, values)
+
+
+def _flag_comb(comb):
+    """Flag-aware combine: invalid operands are skipped (associative monoid
+    without needing an identity element)."""
+    def op(fa, va, fb, vb):
+        both = comb(va, vb)
+        v = jax.tree.map(
+            lambda c, xa, xb: jnp.where(_b(fb, c),
+                                        jnp.where(_b(fa, c), c, xb), xa),
+            both, va, vb)
+        return fa | fb, v
+    return op
+
+
+def _sliding_reduce(comb, flags, values, R: int, axis: int):
+    """``out[i] = fold(comb)`` over the valid entries among positions
+    ``[i-R+1, i]`` along ``axis``.  Dilated doubling: ``log2(R)`` combines
+    build power-of-two window aggregates, then the binary decomposition of
+    ``R`` stitches them — the log-depth trick of the reference's FlatFAT
+    levels (``flatfat_gpu.hpp:60-139``) expressed as shifts instead of a
+    tree, so nothing larger than the pane sequence is ever materialized."""
+    op = _flag_comb(comb)
+    # pow2[j] aggregates windows of width 2^j ending at each position
+    pow2 = [(flags, values)]
+    width = 1
+    while width * 2 <= R:
+        f, v = pow2[-1]
+        fs, vs = _shift_right(f, v, width, axis)
+        pow2.append(op(fs, vs, f, v))
+        width *= 2
+    # stitch R = sum of powers, walking from the window's newest end
+    # backward; each added chunk sits *before* the accumulated suffix, so
+    # it is the left operand of comb (order matters for non-commutative
+    # combiners)
+    res = None
+    offset = 0
+    for j in range(len(pow2) - 1, -1, -1):
+        w = 1 << j
+        if R & w:
+            f, v = _shift_right(*pow2[j], offset, axis)
+            res = (f, v) if res is None else op(f, v, *res)
+            offset += w
+    return res
 
 
 def make_ffat_step(capacity: int, K: int, P: int, R: int, D: int,
@@ -143,20 +199,17 @@ def make_ffat_step(capacity: int, K: int, P: int, R: int, D: int,
         fired = e <= done[:, None]
         local_end = (e - state["pane_base"][:, None]
                      + (R - 1)).astype(jnp.int32)              # exclusive
-        gidx = jnp.clip(local_end[:, :, None] - R
-                        + jnp.arange(R)[None, None, :],
-                        0, R - 1 + NP1 - 1)                    # [K,MW,R]
+        # sliding fold of R consecutive panes (log2(R) dilated combines over
+        # the [K, R-1+NP1] pane sequence), then one [K, MW] gather of the
+        # fired window ends — never materializes a [K, MW, R] panes tensor
+        _, swin = _sliding_reduce(comb, full_valid, full, R, axis=1)
+        widx = jnp.clip(local_end - 1, 0, R - 1 + NP1 - 1)     # [K, MW]
 
-        def gather_leaf(a):
-            # a: [K, R-1+NP1, ...] -> [K, MW, R, ...]
-            expanded = jnp.broadcast_to(
-                a[:, None], (K, MW) + a.shape[1:])
-            idx = gidx.reshape(K, MW, R, *([1] * (a.ndim - 2)))
-            idx = jnp.broadcast_to(idx, (K, MW, R) + a.shape[2:])
-            return jnp.take_along_axis(expanded, idx, axis=2)
-        wpanes = jax.tree.map(gather_leaf, full)
-        _, wvals = _masked_reduce_last(
-            comb, jnp.ones((K, MW, R), bool), wpanes, axis=2)
+        def pick_leaf(a):
+            idx = widx.reshape(K, MW, *([1] * (a.ndim - 2)))
+            idx = jnp.broadcast_to(idx, (K, MW) + a.shape[2:])
+            return jnp.take_along_axis(a, idx, axis=1)
+        wvals = jax.tree.map(pick_leaf, swin)
 
         n_fired = jnp.where(
             fired[:, 0],
