@@ -311,3 +311,135 @@ def test_ffat_tpu_parallelism_no_duplicate_flush():
     g.add_source(src).add(op).add_sink(snk)
     g.run()
     assert (acc.count, acc.total) == exp
+
+
+def test_ffat_tpu_tb():
+    """Time-based FfatWindowsTPU (quantum panes + watermark firing) vs the
+    host oracle (reference win_tests_gpu are TB-only:
+    ``test_win_fat_gpu_tb.cpp``)."""
+    exp = oracle_tb(TWIN, TSLIDE)
+    for batch in (16, 64):
+        acc = WinAcc()
+        src = (wf.Source_Builder(lambda: iter(stream()))
+               .withTimestampExtractor(lambda t: t["ts"])
+               .withOutputBatchSize(batch).build())
+        op = (wf.Ffat_WindowsTPU_Builder(
+                lambda t: t["value"], lambda a, b: a + b)
+              .withTBWindows(TWIN, TSLIDE)
+              .withKeyBy(lambda t: t["key"])
+              .withMaxKeys(N_KEYS).build())
+        snk = wf.Sink_Builder(
+            lambda r: acc(_as_result(r)) if r is not None else None).build()
+        g = wf.PipeGraph("ffat_tpu_tb", wf.ExecutionMode.DEFAULT,
+                         wf.TimePolicy.EVENT)
+        g.add_source(src).add(op).add_sink(snk)
+        g.run()
+        assert (acc.count, acc.total) == exp, f"batch={batch}"
+
+
+def test_ffat_tpu_tb_small_ring_and_lateness():
+    """A tight pane ring still produces exact results when batches arrive in
+    order (ring >= window span + batch time spread), and lateness delays
+    firing without changing totals."""
+    exp = oracle_tb(TWIN, TSLIDE)
+    # 32-tuple batches span 8 panes (1 ms tuples, 4 ms panes); R = 4
+    for pane_cap, lateness in ((13, 0), (16, 2_000)):
+        acc = WinAcc()
+        src = (wf.Source_Builder(lambda: iter(stream()))
+               .withTimestampExtractor(lambda t: t["ts"])
+               .withOutputBatchSize(32).build())
+        b = (wf.Ffat_WindowsTPU_Builder(
+                lambda t: t["value"], lambda a, b: a + b)
+             .withTBWindows(TWIN, TSLIDE)
+             .withKeyBy(lambda t: t["key"])
+             .withMaxKeys(N_KEYS).withPaneCapacity(pane_cap))
+        if lateness:
+            b = b.withLateness(lateness)
+        op = b.build()
+        snk = wf.Sink_Builder(
+            lambda r: acc(_as_result(r)) if r is not None else None).build()
+        g = wf.PipeGraph("ffat_tpu_tb2", wf.ExecutionMode.DEFAULT,
+                         wf.TimePolicy.EVENT)
+        g.add_source(src).add(op).add_sink(snk)
+        g.run()
+        assert (acc.count, acc.total) == exp, (pane_cap, lateness)
+
+
+def _jittered_stream(jitter_us, seed=21):
+    rnd = random.Random(seed)
+    out = []
+    for i in range(LENGTH):
+        ts = max(0, i * 1000 + rnd.randint(-jitter_us, jitter_us))
+        out.append({"key": i % N_KEYS, "value": i, "ts": ts})
+    return out
+
+
+def _oracle_tb_items(items, win_us, slide_us):
+    per_key = {}
+    for t in items:
+        per_key.setdefault(t["key"], []).append((t["ts"], t["value"]))
+    exp = {}
+    for k, pts in per_key.items():
+        wids = set()
+        for ts, _ in pts:
+            last = ts // slide_us
+            first = max(0, -(-(ts - win_us + 1) // slide_us))
+            wids.update(range(first, last + 1))
+        for w in wids:
+            vals = [v for ts, v in pts
+                    if w * slide_us <= ts < w * slide_us + win_us]
+            if vals:
+                exp[(k, w)] = sum(vals)
+    return exp
+
+
+def _run_ffat_tpu_tb(items, lateness):
+    got = {}
+    src = (wf.Source_Builder(lambda: iter(items))
+           .withTimestampExtractor(lambda t: t["ts"])
+           .withOutputBatchSize(32).build())
+    op = (wf.Ffat_WindowsTPU_Builder(lambda t: t["value"],
+                                     lambda a, b: a + b)
+          .withTBWindows(TWIN, TSLIDE).withKeyBy(lambda t: t["key"])
+          .withMaxKeys(N_KEYS).withLateness(lateness).build())
+    snk = wf.Sink_Builder(
+        lambda r: got.__setitem__((r["key"], r["wid"]), r["value"])
+        if r is not None else None).build()
+    g = wf.PipeGraph("ffat_tpu_ooo", wf.ExecutionMode.DEFAULT,
+                     wf.TimePolicy.EVENT)
+    g.add_source(src).add(op).add_sink(snk)
+    g.run()
+    return got, op
+
+
+def test_ffat_tpu_tb_out_of_order():
+    """Disorder within the lateness bound: exact results, nothing dropped.
+    The host Ffat_Windows under the same feed is the reference result
+    (reference win_tests_gpu oracle style)."""
+    items = _jittered_stream(2000)
+    got, op = _run_ffat_tpu_tb(items, lateness=2500)
+    exp = _oracle_tb_items(items, TWIN, TSLIDE)
+    assert got == exp
+    st = op.dump_stats()
+    assert st["Late_tuples_dropped"] == 0
+
+
+def test_ffat_tpu_tb_late_drops_counted():
+    """Disorder beyond the lateness bound: late tuples (panes already
+    rolled out by firing) are dropped AND surfaced in the stats."""
+    rnd = random.Random(33)
+    items = []
+    for i in range(LENGTH):
+        ts = i * 1000
+        if i % 40 == 39:
+            ts = max(0, ts - 60_000)   # very late stragglers
+        items.append({"key": i % N_KEYS, "value": i, "ts": ts})
+    got, op = _run_ffat_tpu_tb(items, lateness=0)
+    st = op.dump_stats()
+    assert st["Late_tuples_dropped"] > 0
+    # on-time data is still exact for windows without stragglers
+    exp_on_time = _oracle_tb_items(
+        [t for t in items if t["value"] % 40 != 39], TWIN, TSLIDE)
+    on_time_ok = sum(1 for kk, v in exp_on_time.items()
+                     if got.get(kk) == v)
+    assert on_time_ok > 0.8 * len(exp_on_time)

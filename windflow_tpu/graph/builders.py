@@ -426,7 +426,9 @@ class Ffat_Windows_Builder(_WindowBuilderBase):
 class Ffat_WindowsTPU_Builder(_WindowBuilderBase):
     """Reference ``Ffat_WindowsGPU_Builder`` (builders_gpu.hpp:576); the
     ``withNumWinPerBatch`` knob is unnecessary here — every window a batch
-    completes is computed in the one fused program."""
+    completes is computed in the one fused program.  Supports both CB
+    windows (rank panes) and TB windows (time-quantum panes + watermark
+    firing; lateness applies)."""
 
     _default_name = "ffat_windows_tpu"
 
@@ -435,20 +437,24 @@ class Ffat_WindowsTPU_Builder(_WindowBuilderBase):
         self._lift = lift_fn
         self._comb = comb_fn
         self._max_keys = 1
+        self._pane_capacity = None
 
     def withMaxKeys(self, n: int):
         """Size of the dense device key space [0, n)."""
         self._max_keys = int(n)
         return self
 
-    def withLateness(self, lateness_usec: int):
-        raise WindFlowError(
-            "FfatWindowsTPU does not support lateness yet (time-based TPU "
-            "windows are planned); use the host Ffat_Windows for lateness")
+    def withPaneCapacity(self, n: int):
+        """TB only: length of the on-device pane ring (window span panes
+        plus slack for the time spread of in-flight batches; default
+        ``max(2*R, R+64)``)."""
+        self._pane_capacity = int(n)
+        return self
 
     def build(self) -> FfatWindowsTPU:
         return FfatWindowsTPU(
             self._lift, self._comb, self._spec(), max_keys=self._max_keys,
             name=self._name,
             parallelism=self._parallelism,
-            key_extractor=self._key_extractor)
+            key_extractor=self._key_extractor,
+            pane_capacity=self._pane_capacity)

@@ -140,9 +140,13 @@ class _OpenBatch:
     def add(self, item, ts, wm):
         self.items.append(item)
         self.tss.append(ts)
-        # Fold the minimum watermark over the batch's inputs (reference
-        # Batch_CPU_t::addTuple, batch_cpu_t.hpp:51-205).
-        self.wm = wm if self.wm == WM_NONE else min(self.wm, wm)
+        # Keep the NEWEST frontier (per-emitter watermarks are monotone).
+        # The reference folds the minimum (Batch_CPU_t::addTuple,
+        # batch_cpu_t.hpp:51-205); here the stronger stamp is safe because
+        # every consumer places a batch's tuples before acting on its
+        # watermark (Replica._dispatch, the TB FFAT place-then-fire step),
+        # and it saves downstream time windows one batch of firing lag.
+        self.wm = wm if self.wm == WM_NONE else max(self.wm, wm)
 
 
 class ForwardEmitter(Emitter):
@@ -263,7 +267,8 @@ class DeviceStageEmitter(Emitter):
         per-tuple fill loop, ``forward_emitter_gpu.hpp:254-300``)."""
         self._col_chunks.append((cols, tss))
         self._col_rows += len(tss)
-        self._col_wm = wm if self._col_wm == WM_NONE else min(self._col_wm,
+        # newest frontier, as in _OpenBatch.add
+        self._col_wm = wm if self._col_wm == WM_NONE else max(self._col_wm,
                                                               wm)
         cap = self.output_batch_size
         if self._col_rows >= cap:

@@ -261,6 +261,162 @@ def make_ffat_step(capacity: int, K: int, P: int, R: int, D: int,
     return step
 
 
+def make_ffat_tb_state(agg_spec, K: int, NP: int):
+    """Dense pane-ring state for time-based FFAT: column ``i`` of ``cells``
+    holds the aggregate of time pane ``base + i`` (pane = ts // P_usec) for
+    each key.  All keys share the pane clock, so ``base``/``win_next`` are
+    scalars — unlike the count-based state, no per-key fill tracking is
+    needed (the TPU re-design of the reference's TB quantum panes,
+    ``ffat_replica_gpu.hpp:92-216``)."""
+    zeros = lambda shape: jax.tree.map(
+        lambda s: jnp.zeros(shape + s.shape, s.dtype), agg_spec)
+    return {
+        "cells": zeros((K, NP)),
+        "cell_valid": jnp.zeros((K, NP), bool),
+        "base": jnp.zeros((), jnp.int64),      # pane index of column 0
+        "win_next": jnp.zeros((), jnp.int64),  # next unfired window id
+        "n_late": jnp.zeros((), jnp.int64),    # dropped late tuples
+        "n_evicted": jnp.zeros((), jnp.int64),  # pane cells lost to overflow
+    }
+
+
+def make_ffat_tb_step(capacity: int, K: int, P_usec: int, R: int, D: int,
+                      NP: int, lift: Callable, comb: Callable,
+                      key_fn: Optional[Callable],
+                      key_base_fn: Optional[Callable[[], Any]] = None):
+    """Time-based FFAT per-batch program.
+
+    Window ``w`` covers panes ``[w*D, w*D + R)`` — times
+    ``[w*slide, w*slide + win)`` — and fires once the (lateness-adjusted)
+    watermark passes the window end; the host passes ``wm_adj`` per batch.
+    The ring holds ``NP`` panes: older panes are rolled out once their
+    windows fire (or, under overload, to make room — affected windows then
+    fire over their surviving panes only).
+    """
+    MW = NP // D + 2
+
+    def roll_left(flags, values, k):
+        # advance the ring by k panes (k is traced); vacated tail = invalid
+        idx = jnp.arange(NP, dtype=jnp.int64) + k
+        inb = idx < NP
+        idxc = jnp.clip(idx, 0, NP - 1).astype(jnp.int32)
+        f = jnp.take(flags, idxc, axis=1) & inb[None, :]
+        v = jax.tree.map(lambda a: jnp.take(a, idxc, axis=1), values)
+        return f, v
+
+    def step(state, payload, ts, valid, wm_pane):
+        B = capacity
+        kb = key_base_fn() if key_base_fn is not None else None
+        keys = jax.vmap(key_fn)(payload).astype(jnp.int32) \
+            if key_fn is not None else jnp.zeros(B, jnp.int32)
+        if kb is not None:
+            keys = keys - jnp.int32(kb)
+        ok = valid & (keys >= 0) & (keys < K)
+        pane = ts.astype(jnp.int64) // P_usec
+
+        # 1. capacity roll: make room for this batch's newest pane.  Panes
+        # evicted here belong to windows not yet fired — data loss under an
+        # undersized ring (pane_capacity < window span + batch time spread),
+        # surfaced via the n_evicted counter.
+        max_pane = jnp.max(jnp.where(ok, pane, state["base"]))
+        shift_cap = jnp.maximum(
+            jnp.int64(0), max_pane - state["base"] - (NP - 1))
+        evicted = jnp.sum(
+            (state["cell_valid"]
+             & (jnp.arange(NP, dtype=jnp.int64)[None, :] < shift_cap))
+            .astype(jnp.int64))
+        cell_valid, cells = roll_left(state["cell_valid"], state["cells"],
+                                      shift_cap)
+        base = state["base"] + shift_cap
+
+        # 2. place the batch: sort by (key, pane), fold runs, merge cells
+        rel = pane - base
+        late = ok & (rel < 0)
+        ok = ok & (rel >= 0)
+        rel_c = jnp.clip(rel, 0, NP - 1).astype(jnp.int32)
+        sid = jnp.where(ok, keys.astype(jnp.int64) * NP + rel_c,
+                        jnp.int64(K) * NP)
+        order = jnp.argsort(sid, stable=True)
+        ssid = sid[order]
+        slift = jax.tree.map(lambda a: a[order], jax.vmap(lift)(payload))
+        starts = jnp.concatenate([jnp.array([True]), ssid[1:] != ssid[:-1]])
+        scanned = _seg_scan(comb, starts, slift)
+        ends = jnp.concatenate([ssid[1:] != ssid[:-1], jnp.array([True])])
+        row = jnp.where(ends, ssid // NP, K).astype(jnp.int32)
+        col = jnp.where(ends, ssid % NP, 0).astype(jnp.int32)
+
+        def scat(leaf):
+            buf = jnp.zeros((K + 1, NP) + leaf.shape[1:], leaf.dtype)
+            return buf.at[row, col].set(
+                jnp.where(_b(ends, leaf), leaf, 0))[:K]
+        partial = jax.tree.map(scat, scanned)
+        partial_has = jnp.zeros((K + 1, NP), bool).at[row, col].set(ends)[:K]
+
+        def merge(old_leaf, new_leaf):
+            both = comb(old_leaf, new_leaf)
+            return jnp.where(_b(cell_valid & partial_has, both), both,
+                             jnp.where(_b(partial_has, both), new_leaf,
+                                       old_leaf))
+        cells = jax.tree.map(merge, cells, partial)
+        cell_valid = cell_valid | partial_has
+
+        # 3. fire windows complete under the watermark frontier.  Firing is
+        # additionally capped to windows whose end pane is inside the ring:
+        # if the watermark jumps past the newest data, later windows wait
+        # for the next step (the roll below brings their ends in range) —
+        # this keeps every fired fold exactly over its own panes.
+        j = jnp.arange(MW, dtype=jnp.int64)
+        w = state["win_next"] + j
+        sflag, swin = _sliding_reduce(comb, cell_valid, cells, R, axis=1)
+        end_local = (w * D + R - 1 - base)                     # [MW]
+        fire = ((w * D + R) <= wm_pane) & (end_local < NP)     # [MW] prefix
+        # end_local < 0 happens only when a capacity roll evicted the whole
+        # window (overload); such windows must not fire with pane-0 data
+        emitable = fire & (end_local >= 0)
+        eidx = jnp.clip(end_local, 0, NP - 1).astype(jnp.int32)
+
+        def pick_leaf(a):
+            idx = eidx.reshape(1, MW, *([1] * (a.ndim - 2)))
+            idx = jnp.broadcast_to(idx, (K, MW) + a.shape[2:])
+            return jnp.take_along_axis(a, idx, axis=1)
+        wvals = jax.tree.map(pick_leaf, swin)
+        any_data = jnp.take_along_axis(
+            sflag, jnp.broadcast_to(eidx[None, :], (K, MW)), axis=1)
+        # advance past fully-evicted windows (fire) but never emit them
+        # (emitable): their eidx clips to pane 0, which they do not cover
+        fired = emitable[None, :] & any_data                   # [K, MW]
+
+        n_fired = jnp.sum(fire.astype(jnp.int64))
+        win_next = state["win_next"] + n_fired
+
+        # 4. roll fired windows' dead panes out of the ring
+        shift_fire = jnp.clip(win_next * D - base, 0, NP)
+        cell_valid, cells = roll_left(cell_valid, cells, shift_fire)
+        base = base + shift_fire
+
+        new_state = {
+            "cells": cells,
+            "cell_valid": cell_valid,
+            "base": base,
+            "win_next": win_next,
+            "n_late": state["n_late"] + jnp.sum(late.astype(jnp.int64)),
+            "n_evicted": state["n_evicted"] + evicted,
+        }
+        out_ts = (w * D + R) * P_usec - 1                      # end-1 (TB)
+        out = {
+            "key": (jnp.broadcast_to(
+                jnp.arange(K, dtype=jnp.int32)[:, None], (K, MW))
+                + (jnp.int32(kb) if kb is not None else 0)).reshape(-1),
+            "wid": jnp.broadcast_to(w[None, :], (K, MW)).reshape(-1),
+            "value": jax.tree.map(
+                lambda a: a.reshape((K * MW,) + a.shape[2:]), wvals),
+        }
+        return new_state, out, fired.reshape(-1), \
+            jnp.broadcast_to(out_ts[None, :], (K, MW)).reshape(-1)
+
+    return step
+
+
 def make_ffat_state(agg_spec, K: int, R: int):
     """Dense per-key FFAT device state over a static key space ``[0, K)``
     (see :class:`FfatWindowsTPU` for the layout)."""

@@ -39,13 +39,15 @@ import jax
 import jax.numpy as jnp
 
 from windflow_tpu.basic import RoutingMode, WindFlowError, WinType
-from windflow_tpu.batch import DeviceBatch
+from windflow_tpu.batch import WM_NONE, DeviceBatch
 from windflow_tpu.ops.base import Operator
 from windflow_tpu.ops.tpu import _TPUReplica
 from windflow_tpu.windows.engine import WindowSpec
 from windflow_tpu.windows.ffat_kernels import (_masked_reduce_last,
                                                agg_spec_for, make_ffat_state,
-                                               make_ffat_step)
+                                               make_ffat_step,
+                                               make_ffat_tb_state,
+                                               make_ffat_tb_step)
 
 
 class FfatTPUReplica(_TPUReplica):
@@ -56,24 +58,25 @@ class FfatTPUReplica(_TPUReplica):
         self.op._eos_replicas += 1
         if self.op._eos_replicas < self.op.parallelism:
             return
-        out = self.op._flush()
-        if out is not None:
+        for out in self.op._flush():
             self.stats.device_programs_launched += 1
             self.emitter.emit_device_batch(out)
 
 
 class FfatWindowsTPU(Operator):
+    """Count-based windows use the rank/pane decomposition
+    (``make_ffat_step``); time-based windows use quantum panes — pane =
+    ``ts // gcd(win, slide)`` — over a rolling per-key pane ring with
+    watermark-driven firing (``make_ffat_tb_step``; reference TB lift
+    kernels, ``ffat_replica_gpu.hpp:92-216``)."""
+
     replica_class = FfatTPUReplica
 
     def __init__(self, lift: Callable, comb: Callable, spec: WindowSpec, *,
                  max_keys: int, name: str = "ffat_windows_tpu",
                  parallelism: int = 1,
-                 key_extractor: Optional[Callable] = None) -> None:
-        if spec.win_type != WinType.CB:
-            raise WindFlowError(
-                "FfatWindowsTPU currently supports count-based windows "
-                "(time-based via quantum panes is planned; use the host "
-                "Ffat_Windows for TB)")
+                 key_extractor: Optional[Callable] = None,
+                 pane_capacity: Optional[int] = None) -> None:
         routing = (RoutingMode.KEYBY if key_extractor is not None
                    else RoutingMode.FORWARD)
         super().__init__(name, parallelism, routing=routing, is_tpu=True,
@@ -85,21 +88,37 @@ class FfatWindowsTPU(Operator):
         self.P = math.gcd(spec.win_len, spec.slide)
         self.R = spec.win_len // self.P
         self.D = spec.slide // self.P
+        self.is_tb = spec.win_type == WinType.TB
+        # TB pane ring length: window span plus slack for the time spread of
+        # in-flight batches (tunable via the builder's withPaneCapacity)
+        self.NP = pane_capacity or max(2 * self.R, self.R + 64)
+        if self.is_tb and self.NP < self.R + 1:
+            raise WindFlowError("pane_capacity must exceed win/gcd panes")
         self._state = None          # device state, created on first batch
         self._jit_step = None
         self._jit_flush = None
         self._capacity = None
+        self._payload_zero = None   # all-invalid batch for TB EOS flush
         self._flushed = False
         self._eos_replicas = 0
 
     # -- state layout --------------------------------------------------------
     def _init_state(self, agg_spec):
+        if self.is_tb:
+            return make_ffat_tb_state(agg_spec, self.max_keys, self.NP)
         return make_ffat_state(agg_spec, self.max_keys, self.R)
 
     # -- per-batch program ---------------------------------------------------
     def _build_step(self, capacity: int):
-        step = make_ffat_step(capacity, self.max_keys, self.P, self.R, self.D,
-                              self.lift, self.comb, self.key_extractor)
+        if self.is_tb:
+            step = make_ffat_tb_step(capacity, self.max_keys, self.P,
+                                     self.R, self.D, self.NP,
+                                     self.lift, self.comb,
+                                     self.key_extractor)
+        else:
+            step = make_ffat_step(capacity, self.max_keys, self.P, self.R,
+                                  self.D, self.lift, self.comb,
+                                  self.key_extractor)
         return jax.jit(step, donate_argnums=(0,))
 
     # -- operator plumbing ---------------------------------------------------
@@ -109,30 +128,77 @@ class FfatWindowsTPU(Operator):
                 agg_spec_for(self.lift, batch.payload))
             self._capacity = batch.capacity
             self._jit_step = self._build_step(batch.capacity)
+            if self.is_tb:
+                self._payload_zero = jax.tree.map(jnp.zeros_like,
+                                                  batch.payload)
         elif batch.capacity != self._capacity:
             raise WindFlowError(
                 "FfatWindowsTPU requires a fixed upstream batch capacity "
                 f"({self._capacity}), got {batch.capacity}")
 
+    def _wm_pane(self, wm: int) -> int:
+        """Lateness-adjusted watermark in pane units (the host-side firing
+        frontier the device program compares window ends against)."""
+        if wm == WM_NONE:
+            return -(1 << 60)
+        return (wm - self.spec.lateness) // self.P
+
     def _step(self, batch: DeviceBatch) -> DeviceBatch:
         self._ensure(batch)
-        self._state, out, fired, out_ts = self._jit_step(
-            self._state, batch.payload, batch.ts, batch.valid)
+        if self.is_tb:
+            self._state, out, fired, out_ts = self._jit_step(
+                self._state, batch.payload, batch.ts, batch.valid,
+                jnp.int64(self._wm_pane(batch.watermark)))
+        else:
+            self._state, out, fired, out_ts = self._jit_step(
+                self._state, batch.payload, batch.ts, batch.valid)
         return DeviceBatch(out, out_ts, fired,
                            watermark=batch.watermark, size=None)
 
-    def _flush(self) -> Optional[DeviceBatch]:
+    def _flush(self) -> list:
         """EOS: fire remaining partial windows (reference EOS flush of open
-        windows).  Runs a dedicated flush program over the carried state.
-        State is operator-level (one logical device table regardless of
-        replica count), so the last replica to terminate flushes it once."""
+        windows).  State is operator-level (one logical device table
+        regardless of replica count), so the last replica to terminate
+        flushes it once.  CB runs a dedicated flush program; TB iterates
+        the normal step with an empty batch and an infinite watermark —
+        each pass fires the windows whose ends the ring roll has brought
+        into range, until nothing fires."""
         if self._state is None or self._flushed:
-            return None
+            return []
         self._flushed = True
+        if self.is_tb:
+            import numpy as np
+            cap = self._capacity
+            ts0 = jnp.zeros(cap, jnp.int64)
+            invalid = jnp.zeros(cap, bool)
+            outs = []
+            while True:
+                self._state, out, fired, out_ts = self._jit_step(
+                    self._state, self._payload_zero, ts0, invalid,
+                    jnp.int64(1 << 60))
+                if not bool(np.asarray(fired).any()):
+                    break
+                outs.append(DeviceBatch(out, out_ts, fired, watermark=0,
+                                        size=None))
+            return outs
         if self._jit_flush is None:
             self._jit_flush = self._build_flush()
         out, fired, ts = self._jit_flush(self._state)
-        return DeviceBatch(out, ts, fired, watermark=0, size=None)
+        return [DeviceBatch(out, ts, fired, watermark=0, size=None)]
+
+    def dump_stats(self) -> dict:
+        n_late = n_evicted = None
+        if self.is_tb and self._state is not None:
+            # one device sync at dump time, never on the step path
+            n_late = int(self._state["n_late"])
+            n_evicted = int(self._state["n_evicted"])
+            if self.replicas:
+                self.replicas[0].stats.inputs_ignored = n_late
+        st = super().dump_stats()
+        if n_late is not None:
+            st["Late_tuples_dropped"] = n_late
+            st["Pane_cells_evicted"] = n_evicted
+        return st
 
     def _build_flush(self):
         K, P, R, D = self.max_keys, self.P, self.R, self.D
