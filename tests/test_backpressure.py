@@ -1,0 +1,68 @@
+"""Backpressure: a fast source against a slow consumer must not grow inboxes
+without bound (reference: in-transit GPU batch throttling,
+``recycling_gpu.hpp:88-126``, and FF_BOUNDED_BUFFER bounded queues,
+``README.md:36-39``)."""
+
+import dataclasses
+
+import jax.numpy as jnp
+
+from windflow_tpu.basic import Config, RoutingMode
+from windflow_tpu.graph.pipegraph import PipeGraph
+from windflow_tpu.ops.map_op import Map
+from windflow_tpu.ops.sink import Sink
+from windflow_tpu.ops.source import Source
+from windflow_tpu.ops.tpu import MapTPU
+
+
+def _run_bounded(cfg, ops, n_items):
+    g = PipeGraph("bp", config=cfg)
+    src = Source(lambda: iter(range(n_items)))  # tick chunk 256, batches of 1
+    mp = g.add_source(src)
+    for op in ops:
+        mp.add(op)
+    got = []
+    mp.add_sink(Sink(lambda x: got.append(x) if x is not None else None))
+    g.run()
+    return g, got
+
+
+def test_host_inbox_bounded():
+    cfg = dataclasses.replace(Config(), max_inbox_messages=32,
+                              sweep_drain_limit=8)
+    g, got = _run_bounded(cfg, [Map(lambda x: x + 1)], 5000)
+    assert sorted(got) == list(range(1, 5001))
+    # One source tick (256 emits) can overshoot the cap before the next
+    # sweep's throttle check; the bound is cap + one tick.
+    assert g._max_inbox_seen <= 32 + 256
+    assert g._throttle_events > 0
+
+
+def test_device_inflight_bounded():
+    # source stages 4 device batches per tick (chunk 256 / capacity 64), the
+    # consumer drains at most 1 per sweep: without throttling inflight device
+    # batches would grow to n/64 = 64
+    cfg = dataclasses.replace(Config(), max_inflight_batches=2,
+                              sweep_drain_limit=1, source_tick_chunk=256)
+    g = PipeGraph("bp_dev", config=cfg)
+    n = 4096
+    src = Source(lambda: iter(range(n)), output_batch_size=64)
+    got = []
+    g.add_source(src) \
+        .add(MapTPU(lambda x: x * jnp.int32(2))) \
+        .add_sink(Sink(lambda x: got.append(x) if x is not None else None))
+    g.run()
+    assert sorted(got) == [2 * i for i in range(n)]
+    # cap + one tick's overshoot (4 staged batches)
+    assert g._max_inflight_device_seen <= 2 + 4
+    assert g._throttle_events > 0
+
+
+def test_stats_report_backpressure_reality():
+    cfg = dataclasses.replace(Config(), max_inbox_messages=16,
+                              sweep_drain_limit=4)
+    g, _ = _run_bounded(cfg, [Map(lambda x: x)], 2000)
+    s = g.stats()
+    assert "max_inbox_messages=16" in s["Backpressure"]
+    assert s["Backpressure_throttle_events"] == g._throttle_events > 0
+    assert s["Max_inbox_depth_seen"] == g._max_inbox_seen

@@ -95,12 +95,27 @@ class Config:
     # Punctuation (watermark flush) cadence for idle emitters, microseconds
     # (reference default 100 ms, basic.hpp:195).
     punctuation_interval_usec: int = 100_000
-    # Punctuation cadence in number of inputs (reference default 1000).
-    punctuation_amount: int = 1000
+    # Punctuation cadence in number of inputs (reference default 1000,
+    # basic.hpp:195).  0 disables the count trigger: a punctuation flushes
+    # open/staged batches (the watermark must never overtake buffered data),
+    # and unlike the reference — whose batches are at most a few hundred
+    # tuples — TPU staging batches run to 10^5+ lanes, where a count cadence
+    # below the batch capacity would chronically ship padded batches.  The
+    # interval cadence above is what keeps idle streams firing.
+    punctuation_amount: int = 0
     # Cap on outstanding device batches per operator before the host driver
-    # throttles (reference: in-transit counter + WF_GPU_FREE_MEMORY_LIMIT,
-    # recycling_gpu.hpp:88-126).
+    # throttles source ticks (reference: in-transit counter +
+    # WF_GPU_FREE_MEMORY_LIMIT, recycling_gpu.hpp:88-126).  Each queued
+    # DeviceBatch pins ~capacity x payload-width bytes of HBM, so this bounds
+    # device memory the way the reference's FullGPUMemoryException retry does.
     max_inflight_batches: int = 8
+    # Cap on total queued messages per replica inbox (host batches included)
+    # before source throttling — the runtime analogue of the reference's
+    # FF_BOUNDED_BUFFER bounded queues (README.md:36-39).
+    max_inbox_messages: int = 8192
+    # Tuples pulled from each live source per scheduler sweep; 0 means
+    # "one staged batch worth" (the source's output_batch_size, or 256).
+    source_tick_chunk: int = 0
     # Messages one replica may process per scheduler sweep; bounding this
     # interleaves sibling replicas fairly (the cooperative-loop analogue of
     # the reference's thread-parallel arrival order, which matters for the

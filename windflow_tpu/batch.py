@@ -67,6 +67,11 @@ class HostBatch:
     items: list
     tss: list
     watermark: int = WM_NONE
+    #: True when this batch object is multicast to several inboxes
+    #: (BROADCAST edges); in-place-capable consumers must copy before
+    #: mutating (reference ``copyOnWrite`` + ``delete_counter`` multicast,
+    #: ``map.hpp:57-215``, ``single_t.hpp:54``).
+    shared: bool = False
 
     def __len__(self) -> int:
         return len(self.items)

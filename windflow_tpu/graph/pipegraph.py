@@ -60,6 +60,10 @@ class PipeGraph:
         self._source_replicas: List[SourceReplica] = []
         self._operators: List[Operator] = []
         self._monitor = None
+        # backpressure telemetry (high-water marks + throttle count)
+        self._throttle_events = 0
+        self._max_inbox_seen = 0
+        self._max_inflight_device_seen = 0
 
     # -- construction --------------------------------------------------------
     def add_source(self, source: Source) -> MultiPipe:
@@ -123,6 +127,8 @@ class PipeGraph:
             self._all_replicas.extend(op.replicas)
             if isinstance(op, Source):
                 self._source_replicas.extend(op.replicas)
+        for rep in self._all_replicas:
+            rep.config = self.config
 
         # 2. wire edges: emitters on sources of the edge, collectors +
         #    channels on destinations
@@ -199,19 +205,57 @@ class PipeGraph:
             sr.start()
 
     def step(self) -> bool:
-        """One scheduler sweep: pull a chunk from each live source, then drain
-        every replica in topological order.  Returns True on any progress."""
+        """One scheduler sweep: pull a chunk from each live source (unless
+        backpressured), then drain every replica in topological order.
+        Returns True on any progress."""
         progress = False
+        throttled = self._backpressured()
+        if throttled:
+            # Source ticks are deferred this sweep: downstream inboxes are at
+            # the in-transit cap (reference: allocateBatch_GPU_t blocks on
+            # FullGPUMemoryException, recycling_gpu.hpp:88-126).  Draining
+            # below continues, so the graph keeps moving.
+            self._throttle_events += 1
         for sr in self._source_replicas:
             if not sr.exhausted:
-                chunk = sr.op.output_batch_size or 256
-                sr.tick(chunk)
-                progress = True
+                if not throttled and sr.tick(self._tick_chunk(sr)):
+                    progress = True
+                # Cadence punctuation keeps watermarks advancing on idle
+                # streams (runs even when throttled: a punctuation is one
+                # control message, not a data batch).
+                sr.maybe_punctuate()
         limit = self.config.sweep_drain_limit
         for rep in self._all_replicas:
             if rep.drain(limit):
                 progress = True
+        if not progress:
+            # Sources were deferred but nothing drained (e.g. limit=0 edge
+            # cases): force one tick so the graph cannot deadlock on its own
+            # throttle.
+            for sr in self._source_replicas:
+                if not sr.exhausted and sr.tick(self._tick_chunk(sr)):
+                    progress = True
         return progress
+
+    def _tick_chunk(self, sr) -> int:
+        return self.config.source_tick_chunk \
+            or sr.op.output_batch_size or 256
+
+    def _backpressured(self) -> bool:
+        """True when any replica inbox is at the in-transit cap.  Also folds
+        the high-water marks reported by :meth:`stats`."""
+        cfg = self.config
+        hit = False
+        for rep in self._all_replicas:
+            depth = len(rep.inbox)
+            if depth > self._max_inbox_seen:
+                self._max_inbox_seen = depth
+            if rep.inflight_device > self._max_inflight_device_seen:
+                self._max_inflight_device_seen = rep.inflight_device
+            if rep.inflight_device >= cfg.max_inflight_batches \
+                    or depth >= cfg.max_inbox_messages:
+                hit = True
+        return hit
 
     def is_done(self) -> bool:
         return all(r.done for r in self._all_replicas)
@@ -240,7 +284,16 @@ class PipeGraph:
         return {
             "PipeGraph_name": self.name,
             "Mode": self.mode.value,
-            "Backpressure": "ON",     # in-transit batch throttling
+            # in-transit batch throttling (see _backpressured): source ticks
+            # are deferred while any inbox is at the cap
+            "Backpressure": f"ON (max_inflight_batches="
+                            f"{self.config.max_inflight_batches}, "
+                            f"max_inbox_messages="
+                            f"{self.config.max_inbox_messages})",
+            "Backpressure_throttle_events": self._throttle_events,
+            "Max_inbox_depth_seen": self._max_inbox_seen,
+            "Max_inflight_device_batches_seen":
+                self._max_inflight_device_seen,
             "Non_blocking": "ON",     # async XLA dispatch
             "Thread_pinning": "OFF",  # single dispatch loop, no pinning
             "Dropped_tuples": self.get_num_dropped_tuples(),

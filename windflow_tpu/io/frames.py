@@ -25,16 +25,14 @@ from windflow_tpu.basic import RoutingMode, TimePolicy, WindFlowError, \
 from windflow_tpu.batch import WM_NONE
 from windflow_tpu.meta import adapt
 from windflow_tpu.ops.base import Operator, Replica
-from windflow_tpu.ops.source import Source
+from windflow_tpu.ops.source import BaseSourceReplica, Source
 
 
-class FrameSourceReplica(Replica):
+class FrameSourceReplica(BaseSourceReplica):
     def __init__(self, op: "FrameSource", index: int) -> None:
         super().__init__(op, index)
         self._chunks = None
         self._carry = b""
-        self._exhausted = False
-        self._last_ts = WM_NONE
 
     def start(self) -> None:
         self._chunks = iter(self.op.chunks_fn(self.context))
@@ -48,7 +46,7 @@ class FrameSourceReplica(Replica):
             self._flush_carry()
             self._exhausted = True
             self._terminate()
-            return False
+            return True  # termination (EOS cascade) is progress
         self._ingest(self._carry + chunk)
         return True
 
@@ -80,10 +78,7 @@ class FrameSourceReplica(Replica):
         for i, name in enumerate(self.op.fields):
             cols[name] = np.ascontiguousarray(vals[:, i])
         self.emitter.emit_columns(cols, tss, self.current_wm)
-
-    @property
-    def exhausted(self) -> bool:
-        return self._exhausted
+        self._count_toward_punctuation(n)
 
 
 class FrameSource(Source):

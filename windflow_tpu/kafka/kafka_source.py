@@ -49,6 +49,7 @@ class KafkaShipper:
         r._advance_wm(r._last_ts)
         r.stats.outputs_sent += 1
         r.emitter.emit(item, int(ts), r.current_wm)
+        r._count_toward_punctuation(1)
 
 
 class KafkaSourceReplica(SourceReplica):
@@ -94,7 +95,7 @@ class KafkaSourceReplica(SourceReplica):
             self._exhausted = True
             self._consumer.close()
             self._terminate()
-            return False
+            return True  # termination (EOS cascade) is progress
         return True
 
 

@@ -17,11 +17,12 @@ its emitter, forwards EOS, and terminates.
 
 from __future__ import annotations
 
+import copy
 from collections import deque
 from typing import Any, Callable, List, Optional
 
 from windflow_tpu.basic import (ExecutionMode, RoutingMode, TimePolicy,
-                                WindFlowError)
+                                WindFlowError, default_config)
 from windflow_tpu.batch import DeviceBatch, HostBatch, Punctuation, WM_MAX, WM_NONE
 from windflow_tpu.context import RuntimeContext
 from windflow_tpu.monitoring.stats import StatsRecord
@@ -32,13 +33,23 @@ from windflow_tpu.parallel.emitters import Emitter
 class Replica:
     """One logical replica of an operator (reference ``Basic_Replica``)."""
 
+    #: replicas whose user function may mutate its input copy shared
+    #: (multicast) tuples before processing (reference ``copyOnWrite``,
+    #: ``map.hpp:57-215``)
+    copy_on_shared = False
+
     def __init__(self, op: "Operator", index: int) -> None:
         self.op = op
         self.index = index
         self.context = RuntimeContext(op.parallelism, index, op.name)
         self.inbox: deque = deque()
+        #: outstanding device batches in this inbox — the per-operator
+        #: in-transit count the host driver throttles against (reference
+        #: ``inTransit_counter``, ``recycling_gpu.hpp:88-126``)
+        self.inflight_device = 0
         self.collector: Optional[Collector] = None  # wired by the graph
         self.emitter: Optional[Emitter] = None      # wired by the graph
+        self.config = default_config                # PipeGraph overrides
         self.num_channels = 0
         self._eos_channels = set()
         self.done = False
@@ -58,6 +69,8 @@ class Replica:
     # -- runtime ------------------------------------------------------------
     def receive(self, channel: int, msg) -> None:
         self.inbox.append((channel, msg))
+        if isinstance(msg, DeviceBatch):
+            self.inflight_device += 1
 
     def drain(self, limit: int = 0) -> bool:
         """Process pending inbox messages (at most ``limit`` when > 0; the
@@ -71,6 +84,8 @@ class Replica:
                 break
             n += 1
             channel, msg = self.inbox.popleft()
+            if isinstance(msg, DeviceBatch):
+                self.inflight_device -= 1
             progressed = True
             if isinstance(msg, Punctuation) and msg.is_eos:
                 self._handle_channel_eos(channel)
@@ -113,7 +128,13 @@ class Replica:
             assert isinstance(msg, HostBatch)
             self._advance_wm(msg.watermark)
             self.stats.inputs_received += len(msg)
+            # Copy-on-write: a multicast batch is shared by sibling replicas;
+            # an in-place-capable operator must mutate a private copy
+            # (reference ``copyOnWrite``, ``map.hpp:57-215``).
+            cow = msg.shared and self.copy_on_shared
             for item, ts in zip(msg.items, msg.tss):
+                if cow:
+                    item = copy.deepcopy(item)
                 self.context._set_context(ts, msg.watermark)
                 self.process_single(item, ts, msg.watermark)
         self._maybe_hook_wm()

@@ -58,6 +58,8 @@ class _ChainShipper:
 
 
 class ChainedHostReplica(Replica):
+    copy_on_shared = True  # fused map/filter stages may mutate in place
+
     def __init__(self, op: "ChainedHost", index: int) -> None:
         super().__init__(op, index)
 

@@ -11,6 +11,8 @@ from windflow_tpu.ops.base import Operator, Replica
 
 
 class FilterReplica(Replica):
+    copy_on_shared = True  # user predicates may mutate the record
+
     def __init__(self, op: "Filter", index: int) -> None:
         super().__init__(op, index)
         self._fn = adapt(op.fn, 1)

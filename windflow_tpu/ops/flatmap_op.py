@@ -30,6 +30,8 @@ class Shipper:
 
 
 class FlatMapReplica(Replica):
+    copy_on_shared = True  # user fn may mutate the record before shipping
+
     def __init__(self, op: "FlatMap", index: int) -> None:
         super().__init__(op, index)
         self._fn = adapt(op.fn, 2)

@@ -15,6 +15,8 @@ from windflow_tpu.ops.base import Operator, Replica
 
 
 class MapReplica(Replica):
+    copy_on_shared = True  # the in-place variant mutates its input
+
     def __init__(self, op: "Map", index: int) -> None:
         super().__init__(op, index)
         self._fn = adapt(op.fn, 1)

@@ -22,12 +22,63 @@ from windflow_tpu.meta import adapt
 from windflow_tpu.ops.base import Operator, Replica
 
 
-class SourceReplica(Replica):
+class BaseSourceReplica(Replica):
+    """Shared source-replica mechanics: monotone timestamps and the
+    punctuation cadence (reference: emitters multicast watermark punctuations
+    every WF_DEFAULT_WM_INTERVAL_USEC / WM_AMOUNT inputs, basic.hpp:189-206,
+    forward_emitter.hpp:226-262)."""
+
+    def __init__(self, op: Operator, index: int) -> None:
+        super().__init__(op, index)
+        self._last_ts = WM_NONE
+        self._exhausted = False
+        self._since_punct = 0
+        self._last_punct_usec = current_time_usecs()
+
+    @property
+    def exhausted(self) -> bool:
+        return self._exhausted
+
+    def maybe_punctuate(self, now_usec: Optional[int] = None) -> None:
+        """Emit a watermark punctuation if the cadence interval elapsed — the
+        mechanism that keeps time windows firing on a live-but-idle stream
+        (reference ``forward_emitter.hpp:226-262``).  Called by the driver
+        every sweep."""
+        if self._exhausted:
+            return
+        now = now_usec if now_usec is not None else current_time_usecs()
+        if now - self._last_punct_usec >= self.config.punctuation_interval_usec:
+            self.punctuate(now)
+
+    def punctuate(self, now_usec: Optional[int] = None) -> None:
+        now = now_usec if now_usec is not None else current_time_usecs()
+        if self.time_policy == TimePolicy.INGRESS:
+            # Ingress watermarks may ride the wall clock: every future tuple
+            # is stamped >= now, so `now` is a valid frontier even mid-idle.
+            self._advance_wm(now)
+            # keep future tuple timestamps ahead of the advertised frontier
+            self._last_ts = max(self._last_ts, now)
+        # EVENT time: the frontier is the max event timestamp seen; idle
+        # cannot advance it (no oracle for future event times).
+        if self.current_wm == WM_NONE:
+            return
+        self._since_punct = 0
+        self._last_punct_usec = now
+        self.emitter.propagate_punctuation(self.current_wm)
+
+    def _count_toward_punctuation(self, n: int) -> None:
+        amount = self.config.punctuation_amount
+        if amount <= 0:
+            return  # count trigger disabled (interval cadence still runs)
+        self._since_punct += n
+        if self._since_punct >= amount:
+            self.punctuate()
+
+
+class SourceReplica(BaseSourceReplica):
     def __init__(self, op: "Source", index: int) -> None:
         super().__init__(op, index)
         self._iter = None
-        self._last_ts = WM_NONE
-        self._exhausted = False
         # A source has no input channels; the driver calls tick().
 
     def start(self) -> None:
@@ -39,7 +90,9 @@ class SourceReplica(Replica):
         self._iter = iter(iterable)
 
     def tick(self, max_items: int) -> bool:
-        """Pull up to ``max_items`` tuples; returns False once exhausted."""
+        """Pull up to ``max_items`` tuples; returns True if any progress was
+        made (tuples emitted, an idle yield consumed, or the stream
+        terminated this call)."""
         if self._exhausted:
             return False
         assert self._iter is not None, "source not started"
@@ -50,13 +103,21 @@ class SourceReplica(Replica):
             except StopIteration:
                 self._exhausted = True
                 self._terminate()
-                return False
+                return True
+            if item is None:
+                # Idle yield: the source is live but has nothing right now
+                # (e.g. waiting on an external feed).  Give the scheduler the
+                # sweep back; punctuation cadence keeps watermarks moving
+                # (reference: Source_Shipper emits periodic watermarks on a
+                # live-but-idle stream, forward_emitter.hpp:226-262).
+                return True
             ts = self._assign_ts(item)
             self._advance_wm(ts)
             self.stats.outputs_sent += 1
             self.emitter.emit(item, ts, self.current_wm)
             produced += 1
-        return True
+            self._count_toward_punctuation(1)
+        return produced > 0
 
     def _assign_ts(self, item: Any) -> int:
         if self.time_policy == TimePolicy.EVENT:
@@ -73,10 +134,6 @@ class SourceReplica(Replica):
                 ts = self._last_ts + 1
         self._last_ts = max(self._last_ts, ts)
         return ts
-
-    @property
-    def exhausted(self) -> bool:
-        return self._exhausted
 
 
 class Source(Operator):

@@ -16,11 +16,13 @@ on the replica's thread before the operator logic.
 
 from __future__ import annotations
 
+import dataclasses
 import heapq
+from collections import deque
 from typing import List
 
 from windflow_tpu.basic import ExecutionMode
-from windflow_tpu.batch import HostBatch, Punctuation, WM_NONE
+from windflow_tpu.batch import DeviceBatch, HostBatch, Punctuation, WM_NONE
 
 
 class Collector:
@@ -60,7 +62,21 @@ class WatermarkCollector(Collector):
         wm = msg.watermark
         if wm != WM_NONE and wm > self._wms[channel]:
             self._wms[channel] = wm
-        msg.watermark = self._frontier()
+        f = self._frontier()
+        if f != msg.watermark:
+            # Rewrite on a fresh wrapper, never in place: batches are
+            # multicast by handle (BROADCAST / device pass-through), so an
+            # in-place rewrite by one consumer would corrupt the frontier a
+            # sibling replica reads.
+            if isinstance(msg, HostBatch):
+                msg = dataclasses.replace(msg, watermark=f)
+            elif isinstance(msg, DeviceBatch):
+                msg = DeviceBatch(msg.payload, msg.ts, msg.valid,
+                                  keys=msg.keys, watermark=f,
+                                  size=msg.known_size)
+            else:
+                assert isinstance(msg, Punctuation)
+                msg = Punctuation(f)
         return [msg]
 
     def on_channel_eos(self, channel):
@@ -72,32 +88,51 @@ class OrderingCollector(Collector):
     """DETERMINISTIC mode: merge the (per-channel ordered) input streams into
     one globally timestamp-ordered stream, releasing a tuple only when every
     open channel has something buffered — so no earlier tuple can still arrive
-    (reference ``ordering_collector.hpp``; also used for id-ordering in WLQ /
-    REDUCE window stages).  Batches are unpacked: determinism is defined at
-    tuple granularity.  Ties break on (ts, channel, arrival seq)."""
+    (reference ``ordering_collector.hpp:51-`` uses priority queues; also used
+    for id-ordering in WLQ / REDUCE window stages).  The k-way merge keeps a
+    heap of channel heads over per-channel deques — O(log C) per released
+    tuple — and batches each release run into one HostBatch, so long
+    DETERMINISTIC streams stay linear instead of the naive per-tuple
+    quadratic.  Ties break on (ts, channel, arrival seq)."""
 
     def __init__(self, num_channels: int) -> None:
         super().__init__(num_channels)
-        self._queues: List[List] = [[] for _ in range(num_channels)]
+        self._queues: List[deque] = [deque() for _ in range(num_channels)]
         self._closed = [False] * num_channels
         self._seq = 0
+        #: channels currently gating release: open with an empty queue
+        self._empty_open = num_channels
+        #: heap of (sort_key, channel) for the head of each non-empty queue
+        self._heads: List = []
+
+    def _push_head(self, ch: int) -> None:
+        heapq.heappush(self._heads, (self._queues[ch][0][0], ch))
 
     def _drain_ready(self):
-        out = []
-        while True:
-            heads = []
-            for ch in range(self.num_channels):
-                if self._queues[ch]:
-                    heads.append((self._queues[ch][0], ch))
-                elif not self._closed[ch]:
-                    # An open, empty channel could still deliver the minimum.
-                    return out
-            if not heads:
-                return out
-            (key, item, ts, wm), ch = min(heads, key=lambda h: h[0][0])
-            self._queues[ch].pop(0)
-            out.append(HostBatch([item], [ts], wm))
-        return out
+        # release is gated while any open channel is empty — the minimum
+        # could still arrive there
+        if self._empty_open:
+            return []
+        items, tss, wms = [], [], []
+        shared = False
+        while self._heads and not self._empty_open:
+            _, ch = heapq.heappop(self._heads)
+            q = self._queues[ch]
+            _, item, ts, wm, sh = q.popleft()
+            items.append(item)
+            tss.append(ts)
+            wms.append(wm)
+            shared |= sh
+            if q:
+                self._push_head(ch)
+            elif not self._closed[ch]:
+                self._empty_open += 1
+        if not items:
+            return []
+        # one ordered batch per release run; the conservative min watermark
+        # (items from slower channels may carry older frontiers)
+        wm = min((w for w in wms if w != WM_NONE), default=WM_NONE)
+        return [HostBatch(items, tss, wm, shared=shared)]
 
     def on_message(self, channel, msg):
         if isinstance(msg, Punctuation):
@@ -106,14 +141,24 @@ class OrderingCollector(Collector):
             return []
         assert isinstance(msg, HostBatch), \
             "DETERMINISTIC mode supports host operators only (parity: GPU ops are DEFAULT-only)"
+        if not len(msg):
+            return []
+        q = self._queues[channel]
+        was_empty = not q
         for item, ts in zip(msg.items, msg.tss):
             self._seq += 1
-            self._queues[channel].append(
-                ((ts, channel, self._seq), item, ts, msg.watermark))
+            q.append(((ts, channel, self._seq), item, ts, msg.watermark,
+                      msg.shared))
+        if was_empty:
+            self._push_head(channel)
+            if not self._closed[channel]:
+                self._empty_open -= 1
         return self._drain_ready()
 
     def on_channel_eos(self, channel):
         self._closed[channel] = True
+        if not self._queues[channel]:
+            self._empty_open -= 1
         return self._drain_ready()
 
 
@@ -136,9 +181,9 @@ class KSlackCollector(Collector):
     def _release(self, limit: int) -> List[HostBatch]:
         out = []
         while self._heap and self._heap[0][0] <= limit:
-            ts, _, item, _ = heapq.heappop(self._heap)
+            ts, _, item, _, sh = heapq.heappop(self._heap)
             self._frontier = max(self._frontier, ts)
-            out.append(HostBatch([item], [ts], self._frontier))
+            out.append(HostBatch([item], [ts], self._frontier, shared=sh))
         return out
 
     def on_message(self, channel, msg):
@@ -153,7 +198,8 @@ class KSlackCollector(Collector):
             self._max_ts = max(self._max_ts, ts)
             self._k = max(self._k, self._max_ts - ts)
             self._seq += 1
-            heapq.heappush(self._heap, (ts, self._seq, item, msg.watermark))
+            heapq.heappush(self._heap,
+                           (ts, self._seq, item, msg.watermark, msg.shared))
         return self._release(self._max_ts - self._k)
 
     def on_channel_eos(self, channel):

@@ -90,7 +90,12 @@ class Emitter:
         self.output_batch_size = output_batch_size
 
     # -- host-tuple interface ----------------------------------------------
-    def emit(self, item: Any, ts: int, wm: int) -> None:
+    def emit(self, item: Any, ts: int, wm: int,
+             shared: bool = False) -> None:
+        """``shared=True`` marks an item whose object is (or may be) also
+        delivered elsewhere (split multicast); it taints the open batch so
+        in-place consumers copy before mutating rather than paying an eager
+        deepcopy per branch."""
         raise NotImplementedError
 
     # -- device-batch interface --------------------------------------------
@@ -130,16 +135,18 @@ def _concat(arrs):
 
 
 class _OpenBatch:
-    __slots__ = ("items", "tss", "wm")
+    __slots__ = ("items", "tss", "wm", "shared")
 
     def __init__(self):
         self.items: list = []
         self.tss: list = []
         self.wm: int = WM_NONE
+        self.shared: bool = False
 
-    def add(self, item, ts, wm):
+    def add(self, item, ts, wm, shared=False):
         self.items.append(item)
         self.tss.append(ts)
+        self.shared |= shared
         # Keep the NEWEST frontier (per-emitter watermarks are monotone).
         # The reference folds the minimum (Batch_CPU_t::addTuple,
         # batch_cpu_t.hpp:51-205); here the stronger stamp is safe because
@@ -159,18 +166,19 @@ class ForwardEmitter(Emitter):
         self._open = [_OpenBatch() for _ in dests]
         self._next = 0
 
-    def emit(self, item, ts, wm):
+    def emit(self, item, ts, wm, shared=False):
         d = self._next
         self._next = (self._next + 1) % len(self.dests)
         ob = self._open[d]
-        ob.add(item, ts, wm)
+        ob.add(item, ts, wm, shared)
         if len(ob.items) >= max(1, self.output_batch_size):
             self._flush_dest(d)
 
     def _flush_dest(self, d):
         ob = self._open[d]
         if ob.items:
-            self._send(d, HostBatch(ob.items, ob.tss, ob.wm))
+            self._send(d, HostBatch(ob.items, ob.tss, ob.wm,
+                                    shared=ob.shared))
             self._open[d] = _OpenBatch()
 
     def flush(self, wm):
@@ -188,17 +196,18 @@ class KeyByEmitter(Emitter):
         self.key_extractor = key_extractor
         self._open = [_OpenBatch() for _ in dests]
 
-    def emit(self, item, ts, wm):
+    def emit(self, item, ts, wm, shared=False):
         d = stable_hash(self.key_extractor(item)) % len(self.dests)
         ob = self._open[d]
-        ob.add(item, ts, wm)
+        ob.add(item, ts, wm, shared)
         if len(ob.items) >= max(1, self.output_batch_size):
             self._flush_dest(d)
 
     def _flush_dest(self, d):
         ob = self._open[d]
         if ob.items:
-            self._send(d, HostBatch(ob.items, ob.tss, ob.wm))
+            self._send(d, HostBatch(ob.items, ob.tss, ob.wm,
+                                    shared=ob.shared))
             self._open[d] = _OpenBatch()
 
     def flush(self, wm):
@@ -215,14 +224,19 @@ class BroadcastEmitter(Emitter):
         super().__init__(dests, output_batch_size)
         self._ob = _OpenBatch()
 
-    def emit(self, item, ts, wm):
-        self._ob.add(item, ts, wm)
+    def emit(self, item, ts, wm, shared=False):
+        self._ob.add(item, ts, wm, shared)
         if len(self._ob.items) >= max(1, self.output_batch_size):
             self.flush(wm)
 
     def flush(self, wm):
         if self._ob.items:
-            b = HostBatch(self._ob.items, self._ob.tss, self._ob.wm)
+            # one immutable batch object multicast by handle; `shared` makes
+            # in-place consumers copy before mutating (reference pairs the
+            # delete_counter multicast with Map's copyOnWrite,
+            # single_t.hpp:54, map.hpp:57-215)
+            b = HostBatch(self._ob.items, self._ob.tss, self._ob.wm,
+                          shared=len(self.dests) > 1 or self._ob.shared)
             for d in range(len(self.dests)):
                 self._send(d, b)
             self._ob = _OpenBatch()
@@ -256,7 +270,9 @@ class DeviceStageEmitter(Emitter):
         self._col_rows = 0
         self._col_wm = WM_NONE
 
-    def emit(self, item, ts, wm):
+    def emit(self, item, ts, wm, shared=False):
+        # `shared` is irrelevant here: staging materializes new device arrays
+        # from the record's values, never aliasing the host object.
         self._ob.add(item, ts, wm)
         if len(self._ob.items) >= self.output_batch_size:
             self.flush(wm)
@@ -342,7 +358,7 @@ class KeyedDeviceStageEmitter(Emitter):
         i = int(k) & 0xFFFFFFFF
         return i - (1 << 32) if i >= (1 << 31) else i
 
-    def emit(self, item, ts, wm):
+    def emit(self, item, ts, wm, shared=False):
         d = self._key32(self.key_extractor(item)) % len(self.dests)
         self._inner[d].emit(item, ts, wm)
 
@@ -467,8 +483,8 @@ class DeviceToHostEmitter(Emitter):
         super().__init__(inner.dests, inner.output_batch_size)
         self.inner = inner
 
-    def emit(self, item, ts, wm):
-        self.inner.emit(item, ts, wm)
+    def emit(self, item, ts, wm, shared=False):
+        self.inner.emit(item, ts, wm, shared)
 
     def emit_device_batch(self, batch: DeviceBatch):
         from windflow_tpu.batch import device_to_host
@@ -528,13 +544,19 @@ class SplittingEmitter(Emitter):
         self.split_fn = split_fn
         self.branches = list(branch_emitters)
 
-    def emit(self, item, ts, wm):
+    def emit(self, item, ts, wm, shared=False):
         dest = self.split_fn(item)
         if isinstance(dest, int):
-            self.branches[dest].emit(item, ts, wm)
+            self.branches[dest].emit(item, ts, wm, shared)
         else:
+            dest = list(dest)
+            # Multicast: every branch sees the same object; mark it shared so
+            # in-place consumers copy lazily before mutating — no eager
+            # per-branch deepcopy (reference pairs multicast with the
+            # consumer-side copyOnWrite, map.hpp:57-215).
+            multi = shared or len(dest) > 1
             for d in dest:
-                self.branches[d].emit(item, ts, wm)
+                self.branches[d].emit(item, ts, wm, multi)
 
     def emit_device_batch(self, batch: DeviceBatch):
         # Device batches are pulled to host and split per tuple (reference
