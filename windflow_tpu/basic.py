@@ -130,6 +130,15 @@ class Config:
     dashboard_port: int = int(os.environ.get("WF_TPU_DASHBOARD_PORT", "20207"))
     # Enable runtime tracing (reference compile-time -DWF_TRACING_ENABLED).
     tracing_enabled: bool = bool(int(os.environ.get("WF_TPU_TRACING", "0")))
+    # Multi-chip execution: a jax.sharding.Mesh with ("data", "key") axes
+    # (see windflow_tpu.parallel.mesh.make_mesh).  When set, staging emitters
+    # lay batches out data-sharded across the mesh and mesh-aware TPU
+    # operators (FfatWindowsTPU, ReduceTPU) compile their sharded variants —
+    # the mesh takes the role the reference fills with operator replication
+    # over threads (SURVEY.md §2.6 item 10).  Requires output_batch_size
+    # divisible by the data-axis extent and max_keys divisible by the
+    # key-axis extent.  Typed Any so importing this module never imports jax.
+    mesh: object = None
 
 
 #: Process-wide default configuration; graphs copy it at construction so later

@@ -253,16 +253,33 @@ class ReduceTPU_Builder(_BuilderBase):
     def __init__(self, comb: Callable) -> None:
         super().__init__()
         self._comb = comb
+        self._max_keys = None
+        self._sum_like = False
 
     def withRebalancing(self):
         raise WindFlowError(
             "ReduceTPU routes by key (or reduces globally); REBALANCING "
             "does not apply")
 
+    def withMaxKeys(self, n: int):
+        """Mesh execution only: bound of the dense key space [0, n) used by
+        the cross-chip partial tables (Config.mesh; single-chip reduces sort
+        arbitrary int32 keys and ignore this)."""
+        self._max_keys = int(n)
+        return self
+
+    def withSumCombiner(self):
+        """Declare the combiner sum-like (zero-absorbing on every leaf), so
+        the cross-chip combine can ride ``lax.psum`` instead of
+        all_gather + fold.  Mesh execution only."""
+        self._sum_like = True
+        return self
+
     def build(self) -> ReduceTPU:
         return ReduceTPU(self._comb, name=self._name,
                          parallelism=self._parallelism,
-                         key_extractor=self._key_extractor)
+                         key_extractor=self._key_extractor,
+                         max_keys=self._max_keys, sum_like=self._sum_like)
 
 
 # ---------------------------------------------------------------------------

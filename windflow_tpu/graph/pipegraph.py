@@ -122,6 +122,7 @@ class PipeGraph:
                 if id(op) not in seen:
                     seen.add(id(op))
                     self._operators.append(op)
+                    op.mesh = self.config.mesh
                     op.build_replicas(self.mode, self.time_policy)
         for op in self._operators:
             self._all_replicas.extend(op.replicas)
@@ -140,7 +141,8 @@ class PipeGraph:
                 em = create_emitter(
                     dst_op.routing, dests, src_op.output_batch_size,
                     src_is_tpu=src_op.is_tpu, dst_is_tpu=dst_op.is_tpu,
-                    key_extractor=dst_op.key_extractor)
+                    key_extractor=dst_op.key_extractor,
+                    mesh=self.config.mesh)
                 emitters.append(em)
             return emitters
 
@@ -217,12 +219,15 @@ class PipeGraph:
             # below continues, so the graph keeps moving.
             self._throttle_events += 1
         for sr in self._source_replicas:
-            if not sr.exhausted:
-                if not throttled and sr.tick(self._tick_chunk(sr)):
+            if not sr.exhausted and not throttled:
+                if sr.tick(self._tick_chunk(sr)):
                     progress = True
                 # Cadence punctuation keeps watermarks advancing on idle
-                # streams (runs even when throttled: a punctuation is one
-                # control message, not a data batch).
+                # streams.  Skipped while throttled: a punctuation flushes
+                # the emitter's open batch first (the watermark must never
+                # overtake buffered data), which would ship a data batch
+                # into inboxes already at the cap.  Under backpressure data
+                # is in flight anyway, so watermarks advance with it.
                 sr.maybe_punctuate()
         limit = self.config.sweep_drain_limit
         for rep in self._all_replicas:
@@ -269,7 +274,8 @@ class PipeGraph:
 
     # -- introspection (reference pipegraph.hpp:721-789) ---------------------
     def get_num_dropped_tuples(self) -> int:
-        return sum(c.num_dropped for c in self._collectors)
+        return sum(c.num_dropped for c in self._collectors) \
+            + sum(op.num_dropped_tuples() for op in self._operators)
 
     def to_dot(self) -> str:
         """Graphviz DOT diagram of the graph (reference

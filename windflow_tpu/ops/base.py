@@ -192,6 +192,10 @@ class Operator:
         self.is_tpu = is_tpu
         self.key_extractor = key_extractor
         self.replicas: List[Replica] = []
+        #: jax Mesh for multi-chip execution; set by PipeGraph._build from
+        #: Config.mesh.  Mesh-aware operators compile sharded programs when
+        #: this is not None (parallel/mesh.py).
+        self.mesh = None
 
     @property
     def is_keyed(self) -> bool:
@@ -210,6 +214,12 @@ class Operator:
             r.mode = mode
             r.time_policy = time_policy
         return self.replicas
+
+    def num_dropped_tuples(self) -> int:
+        """Tuples this operator dropped beyond collector-level drops (e.g.
+        out-of-range keys on the mesh reduce, late tuples on TB windows);
+        folded into PipeGraph.get_num_dropped_tuples."""
+        return 0
 
     def dump_stats(self) -> dict:
         return {

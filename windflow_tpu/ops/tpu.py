@@ -209,13 +209,24 @@ class ReduceTPU(Operator):
 
     def __init__(self, comb: Callable[[Any, Any], Any],
                  name: str = "reduce_tpu", parallelism: int = 1,
-                 key_extractor=None) -> None:
+                 key_extractor=None, max_keys: Optional[int] = None,
+                 sum_like: bool = False) -> None:
         routing = RoutingMode.KEYBY if key_extractor is not None \
             else RoutingMode.FORWARD
         super().__init__(name, parallelism, routing=routing, is_tpu=True,
                          key_extractor=key_extractor)
         self.comb = comb
+        # Mesh execution only: bound of the dense key space [0, max_keys)
+        # for the cross-chip partial tables (single-chip reduce needs no
+        # bound — it sorts arbitrary int32 keys).  ``sum_like=True`` lets the
+        # cross-chip combine ride lax.psum instead of all_gather + fold.
+        self.max_keys = max_keys
+        self.sum_like = sum_like
         self._jit_steps = {}
+        # device scalar accumulating mesh-path key drops (tuples whose key
+        # falls outside [0, max_keys) cannot live in the dense cross-chip
+        # tables); read lazily at stats time, never on the step path
+        self._mesh_dropped = None
 
     def _get_step(self, capacity: int):
         step = self._jit_steps.get(capacity)
@@ -237,7 +248,43 @@ class ReduceTPU(Operator):
             self._jit_steps[capacity] = step
         return step
 
+    def _get_sharded_step(self, capacity: int):
+        step = self._jit_steps.get(("mesh", capacity))
+        if step is None:
+            from windflow_tpu.parallel.mesh import make_sharded_reduce_step
+            K = self.max_keys if self.key_extractor is not None else 1
+            if K is None:
+                raise WindFlowError(
+                    "keyed ReduceTPU on a mesh needs max_keys (the dense "
+                    "cross-chip partial tables are [max_keys] wide); set "
+                    "ReduceTPU_Builder.withMaxKeys")
+            step = make_sharded_reduce_step(self.mesh, capacity, K,
+                                            self.comb, self.key_extractor,
+                                            use_psum=self.sum_like)
+            self._jit_steps[("mesh", capacity)] = step
+        return step
+
+    def num_dropped_tuples(self) -> int:
+        if self._mesh_dropped is None:
+            return 0
+        return int(self._mesh_dropped)  # one device sync, diagnostics only
+
+    def dump_stats(self) -> dict:
+        st = super().dump_stats()
+        if self._mesh_dropped is not None:
+            st["Out_of_range_keys_dropped"] = self.num_dropped_tuples()
+        return st
+
     def _step(self, batch: DeviceBatch) -> DeviceBatch:
+        if self.mesh is not None:
+            # Sharded variant: dense per-chip partials combined over ICI;
+            # output is a capacity-max_keys batch of distinct-key records.
+            table, ts_out, has, n_drop = self._get_sharded_step(
+                batch.capacity)(batch.payload, batch.ts, batch.valid)
+            self._mesh_dropped = n_drop if self._mesh_dropped is None \
+                else self._mesh_dropped + n_drop
+            return DeviceBatch(table, ts_out, has,
+                               watermark=batch.watermark, size=None)
         out_keys, out_payload, out_ts, out_valid = \
             self._get_step(batch.capacity)(batch.keys, batch.payload,
                                            batch.ts, batch.valid)

@@ -26,6 +26,7 @@ Re-design of the reference emitter family (``/root/reference/wf/basic_emitter.hp
 
 from __future__ import annotations
 
+import math
 import zlib
 from typing import Any, Callable, List, Optional, Sequence, Tuple
 
@@ -255,7 +256,7 @@ class DeviceStageEmitter(Emitter):
     destination's compiled program never re-traces.
     """
 
-    def __init__(self, dests, output_batch_size):
+    def __init__(self, dests, output_batch_size, mesh=None):
         if output_batch_size <= 0:
             # Parity: a device operator must be preceded by batching output
             # (reference multipipe.hpp:441-444).
@@ -269,6 +270,17 @@ class DeviceStageEmitter(Emitter):
         self._col_chunks = []
         self._col_rows = 0
         self._col_wm = WM_NONE
+        # Multi-chip: lay staged batch lanes out data-sharded over the mesh
+        # so downstream sharded programs consume them without a reshard
+        # (parallel/mesh.py batch_sharding).
+        self._stage_target = None
+        if mesh is not None:
+            from windflow_tpu.parallel.mesh import batch_sharding
+            if output_batch_size % math.prod(mesh.devices.shape):
+                raise WindFlowError(
+                    f"output batch size {output_batch_size} not divisible "
+                    f"by the mesh's {math.prod(mesh.devices.shape)} devices")
+            self._stage_target = batch_sharding(mesh)
 
     def emit(self, item, ts, wm, shared=False):
         # `shared` is irrelevant here: staging materializes new device arrays
@@ -307,7 +319,7 @@ class DeviceStageEmitter(Emitter):
 
     def _stage_columns(self, cols, tss, wm):
         db = columns_to_device(cols, tss, self.output_batch_size,
-                               watermark=wm)
+                               watermark=wm, device=self._stage_target)
         d = self._next
         self._next = (self._next + 1) % len(self.dests)
         self._send(d, db)
@@ -326,7 +338,8 @@ class DeviceStageEmitter(Emitter):
         if not self._ob.items:
             return
         hb = HostBatch(self._ob.items, self._ob.tss, self._ob.wm)
-        db = host_to_device(hb, capacity=self.output_batch_size)
+        db = host_to_device(hb, capacity=self.output_batch_size,
+                            device=self._stage_target)
         d = self._next
         self._next = (self._next + 1) % len(self.dests)
         self._send(d, db)
@@ -342,11 +355,11 @@ class KeyedDeviceStageEmitter(Emitter):
     (ops/tpu_stateful.py) correct at parallelism > 1, exactly as the
     reference's keyby routing does for its stateful GPU operators."""
 
-    def __init__(self, dests, output_batch_size, key_extractor):
+    def __init__(self, dests, output_batch_size, key_extractor, mesh=None):
         super().__init__(dests, output_batch_size)
         self.key_extractor = key_extractor
         # one single-destination staging emitter per partition
-        self._inner = [DeviceStageEmitter([d], output_batch_size)
+        self._inner = [DeviceStageEmitter([d], output_batch_size, mesh=mesh)
                        for d in dests]
 
     @staticmethod
@@ -504,7 +517,8 @@ def create_emitter(routing: RoutingMode,
                    output_batch_size: int,
                    src_is_tpu: bool,
                    dst_is_tpu: bool,
-                   key_extractor: Optional[Callable] = None) -> Emitter:
+                   key_extractor: Optional[Callable] = None,
+                   mesh=None) -> Emitter:
     """Pick the emitter for an edge from (routing, src-on-TPU, dst-on-TPU),
     mirroring the reference's dispatch (``multipipe.hpp:236-350``)."""
     if dst_is_tpu:
@@ -517,10 +531,10 @@ def create_emitter(routing: RoutingMode,
             if src_is_tpu:
                 return DeviceKeyByEmitter(dests, key_extractor)
             return KeyedDeviceStageEmitter(dests, output_batch_size,
-                                           key_extractor)
+                                           key_extractor, mesh=mesh)
         if src_is_tpu:
             return DevicePassEmitter(dests, routing)
-        return DeviceStageEmitter(dests, output_batch_size)
+        return DeviceStageEmitter(dests, output_batch_size, mesh=mesh)
     # host destination
     if routing == RoutingMode.KEYBY:
         inner = KeyByEmitter(dests, output_batch_size, key_extractor)

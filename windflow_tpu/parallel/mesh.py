@@ -123,40 +123,76 @@ def _dense_keyed_partial(keys, vals, valid, comb, K):
     return table, has
 
 
-def make_sharded_keyed_reduce(mesh: Mesh, capacity: int, K: int,
-                              comb: Callable, key_fn: Callable,
-                              use_psum: bool = False):
-    """Compile a keyed reduce over the whole mesh.
+def make_sharded_reduce_step(mesh: Mesh, capacity: int, K: int,
+                             comb: Callable, key_fn: Optional[Callable],
+                             use_psum: bool = False):
+    """Sharded ReduceTPU step with the operator's batch contract: returns
+    ``fn(payload, ts, valid) -> (table, ts_out, has, n_dropped)`` where
+    ``table`` is the dense ``[K]`` combined-record table, ``ts_out`` the
+    per-key max input timestamp, ``has`` the occupancy mask — i.e. a
+    DeviceBatch of capacity ``K`` whose valid lanes are the distinct keys —
+    and ``n_dropped`` the count of valid tuples whose key fell outside
+    ``[0, K)`` (the dense tables cannot hold them; the count surfaces in
+    stats rather than vanishing silently).  This is what ``ReduceTPU``
+    compiles when the graph runs on a mesh (Config.mesh): per-chip dense
+    partials over the flattened ``(data, key)`` axes combined with psum
+    (sum-like combiners) or all_gather + log-fold (reference: Reduce_GPU per
+    replica + cross-replica merge, ``reduce_gpu.hpp:227-283``).
 
-    Input batch lanes are sharded across *all* devices (both axes flattened);
-    each chip reduces its tuple shard into a dense ``[K]`` partial and the
-    partials combine across chips — ``lax.psum`` when the combiner is a sum
-    (``use_psum=True``), otherwise ``all_gather`` + log-fold of the generic
-    associative combiner.  Returns ``fn(payload, valid) -> (table, has)``
-    with both outputs replicated on every chip."""
+    Non-keyed reduces pass ``key_fn=None`` with ``K == 1`` (the
+    ``thrust::reduce`` global path)."""
     n_total = math.prod(mesh.devices.shape)
     if capacity % n_total:
         raise WindFlowError(
             f"capacity {capacity} not divisible by {n_total} devices")
     axes = (DATA_AXIS, KEY_AXIS)
 
-    def local(payload, valid):
-        keys = jax.vmap(key_fn)(payload).astype(jnp.int32)
-        table, has = _dense_keyed_partial(keys, payload, valid, comb, K)
+    def local(payload, ts, valid):
+        if key_fn is not None:
+            keys = jax.vmap(key_fn)(payload).astype(jnp.int32)
+        else:
+            keys = jnp.zeros(ts.shape[0], jnp.int32)
+        n_drop = jnp.sum(valid & ((keys < 0) | (keys >= K)),
+                         dtype=jnp.int64)
+        n_drop = jax.lax.psum(n_drop, axes)
+        # fold ts with the payload so the segment tails carry max-ts too
+        vals = (payload, ts)
+        comb2 = lambda a, b: (comb(a[0], b[0]), jnp.maximum(a[1], b[1]))
+        (table, ts_t), has = _dense_keyed_partial(keys, vals, valid, comb2, K)
         if use_psum:
             z = jax.tree.map(lambda a: jnp.where(_b(has, a), a, 0), table)
             out = jax.tree.map(lambda a: jax.lax.psum(a, axes), z)
+            ts_out = jax.lax.pmax(jnp.where(has, ts_t, jnp.int64(-1)), axes)
             any_has = jax.lax.psum(has.astype(jnp.int32), axes) > 0
-            return out, any_has
-        g_t = jax.tree.map(
-            lambda a: jax.lax.all_gather(a, axes), table)   # [n, K, ...]
-        g_h = jax.lax.all_gather(has, axes)                 # [n, K]
-        anyf, folded = _masked_reduce_last(comb, g_h, g_t, axis=0)
-        return folded, anyf
+            return out, ts_out, any_has, n_drop
+        g_t = jax.tree.map(lambda a: jax.lax.all_gather(a, axes),
+                           (table, ts_t))
+        g_h = jax.lax.all_gather(has, axes)
+        anyf, (folded, ts_f) = _masked_reduce_last(comb2, g_h, g_t, axis=0)
+        return folded, ts_f, anyf, n_drop
 
     fn = jax.shard_map(local, mesh=mesh,
-                       in_specs=(P(axes), P(axes)),
-                       out_specs=(P(), P()), check_vma=False)
+                       in_specs=(P(axes), P(axes), P(axes)),
+                       out_specs=(P(), P(), P(), P()), check_vma=False)
+    return jax.jit(fn)
+
+
+def make_sharded_keyed_reduce(mesh: Mesh, capacity: int, K: int,
+                              comb: Callable, key_fn: Callable,
+                              use_psum: bool = False):
+    """Compile a keyed reduce over the whole mesh; thin wrapper over
+    :func:`make_sharded_reduce_step` (one implementation of the collective
+    combine) that drops the timestamp/drop-count outputs.  Returns
+    ``fn(payload, valid) -> (table, has)`` with both outputs replicated on
+    every chip."""
+    step = make_sharded_reduce_step(mesh, capacity, K, comb, key_fn,
+                                    use_psum=use_psum)
+
+    def fn(payload, valid):
+        ts = jnp.zeros(valid.shape[0], jnp.int64)
+        table, _, has, _ = step(payload, ts, valid)
+        return table, has
+
     return jax.jit(fn)
 
 

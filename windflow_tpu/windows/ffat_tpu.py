@@ -104,12 +104,28 @@ class FfatWindowsTPU(Operator):
 
     # -- state layout --------------------------------------------------------
     def _init_state(self, agg_spec):
+        if self.mesh is not None:
+            from windflow_tpu.parallel.mesh import make_sharded_ffat_state
+            return make_sharded_ffat_state(agg_spec, self.max_keys, self.R,
+                                           self.mesh)
         if self.is_tb:
             return make_ffat_tb_state(agg_spec, self.max_keys, self.NP)
         return make_ffat_state(agg_spec, self.max_keys, self.R)
 
     # -- per-batch program ---------------------------------------------------
     def _build_step(self, capacity: int):
+        if self.mesh is not None:
+            # Multi-chip: key-sharded state, data-sharded batches riding an
+            # all_gather over ICI (parallel/mesh.py make_sharded_ffat_step).
+            # Config.mesh is how the graph API reaches the sharded kernels.
+            if self.is_tb:
+                raise WindFlowError(
+                    "FfatWindowsTPU: TB windows on a mesh are not supported "
+                    "yet; use CB windows or run single-chip")
+            from windflow_tpu.parallel.mesh import make_sharded_ffat_step
+            return make_sharded_ffat_step(
+                self.mesh, capacity, self.max_keys, self.P, self.R, self.D,
+                self.lift, self.comb, self.key_extractor)
         if self.is_tb:
             step = make_ffat_tb_step(capacity, self.max_keys, self.P,
                                      self.R, self.D, self.NP,
@@ -185,6 +201,11 @@ class FfatWindowsTPU(Operator):
             self._jit_flush = self._build_flush()
         out, fired, ts = self._jit_flush(self._state)
         return [DeviceBatch(out, ts, fired, watermark=0, size=None)]
+
+    def num_dropped_tuples(self) -> int:
+        if self.is_tb and self._state is not None:
+            return int(self._state["n_late"])  # device sync, stats only
+        return 0
 
     def dump_stats(self) -> dict:
         n_late = n_evicted = None
