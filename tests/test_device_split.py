@@ -1,0 +1,83 @@
+"""Device-native splitting (reference ``Splitting_Emitter_GPU`` /
+``split_gpu``, ``splitting_emitter_gpu.hpp:53``): a JAX-traceable split
+function compiles to one masked-compaction program per branch, so device
+batches are split without a host round-trip; Python/multicast split
+functions fall back to the host path."""
+
+import jax.numpy as jnp
+
+import windflow_tpu as wf
+from windflow_tpu.parallel.emitters import SplittingEmitter
+
+
+N = 512
+
+
+def _graph(split_fn):
+    evens, odds = [], []
+    g = wf.PipeGraph("dev_split")
+    src = (wf.Source_Builder(lambda: iter({"v": i} for i in range(N)))
+           .withOutputBatchSize(64).build())
+    mp = g.add_source(src).add(
+        wf.MapTPU_Builder(lambda t: {"v": t["v"] * 2}).build())
+    mp.split(split_fn, 2)
+    mp.select(0).add_sink(wf.Sink_Builder(
+        lambda t: evens.append(t["v"]) if t is not None else None).build())
+    mp.select(1).add_sink(wf.Sink_Builder(
+        lambda t: odds.append(t["v"]) if t is not None else None).build())
+    g.run()
+    src_rep = src.replicas[0]
+    # the splitting emitter sits on the TPU map's replicas
+    split_em = None
+    for op in g._operators:
+        for rep in op.replicas:
+            if isinstance(rep.emitter, SplittingEmitter):
+                split_em = rep.emitter
+    return evens, odds, split_em
+
+
+def test_device_native_split():
+    # traceable single-destination split: (v/2) % 2 routes by parity
+    evens, odds, em = _graph(lambda t: (t["v"] // 2) % 2)
+    assert sorted(evens) == [2 * i for i in range(N) if i % 2 == 0]
+    assert sorted(odds) == [2 * i for i in range(N) if i % 2 == 1]
+    # the compiled device split (not the host fallback) actually ran
+    assert em is not None and any(v is not None
+                                  for v in em._device_splits.values())
+
+
+def test_python_split_falls_back_to_host():
+    def split(t):  # data-dependent Python control flow: not traceable
+        if t["v"] % 4 == 0:
+            return 0
+        return 1
+
+    evens, odds, em = _graph(split)
+    assert sorted(evens) == [2 * i for i in range(N) if (2 * i) % 4 == 0]
+    assert sorted(odds) == [2 * i for i in range(N) if (2 * i) % 4 != 0]
+    assert em is not None and all(v is None
+                                  for v in em._device_splits.values())
+
+
+def test_multicast_split_falls_back_and_isolates():
+    # iterable-returning split fn: both branches get every tuple; in-place
+    # mutation on one branch must not leak (COW through the fallback path)
+    seen0, seen1 = [], []
+    g = wf.PipeGraph("dev_split_multi")
+    src = (wf.Source_Builder(lambda: iter({"v": i} for i in range(128)))
+           .withOutputBatchSize(32).build())
+    mp = g.add_source(src).add(
+        wf.MapTPU_Builder(lambda t: {"v": t["v"]}).build())
+    mp.split(lambda t: (0, 1), 2)
+
+    def bump(t):
+        t["v"] += 1000
+        return None
+
+    mp.select(0).add(wf.Map(bump)).add_sink(wf.Sink_Builder(
+        lambda t: seen0.append(t["v"]) if t is not None else None).build())
+    mp.select(1).add_sink(wf.Sink_Builder(
+        lambda t: seen1.append(t["v"]) if t is not None else None).build())
+    g.run()
+    assert sorted(seen0) == [i + 1000 for i in range(128)]
+    assert sorted(seen1) == list(range(128))

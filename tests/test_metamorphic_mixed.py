@@ -1,0 +1,103 @@
+"""Randomized mixed host+device DAG sweep (reference ``graph_tests_gpu``
+pattern, ``test_graph_1.cpp:84-206``): one DAG mixing TPU map/filter, host
+map/filter, split/merge, and a host time-window stage, swept over random
+per-operator parallelism and batch sizes.  Run 0 is the oracle; every other
+configuration must reproduce it exactly.  A pure-Python oracle pins the
+absolute values, and the two split branches run the same logic on host vs
+device, so the sweep also cross-checks backend equivalence."""
+
+import random
+
+import jax.numpy as jnp
+
+import windflow_tpu as wf
+
+N_KEYS = 4
+LENGTH = 600
+TWIN, TSLIDE = 16_000, 8_000  # µs
+
+
+def stream():
+    return [{"key": i % N_KEYS, "value": i, "ts": i * 1000}
+            for i in range(LENGTH)]
+
+
+def py_oracle():
+    """Both branches apply v*3 then drop v%5==0; branch is by parity of the
+    original value, but both branches do the same thing, so the merged
+    stream is just every surviving tuple; then per-key TB windows sum."""
+    per_key = {}
+    for t in stream():
+        v = t["value"] * 3
+        if v % 5 != 0:
+            per_key.setdefault(t["key"], []).append((t["ts"], v))
+    count = total = 0
+    for items in per_key.values():
+        max_ts = max(ts for ts, _ in items)
+        w = 0
+        while w * TSLIDE <= max_ts:
+            in_win = [v for ts, v in items
+                      if w * TSLIDE <= ts < w * TSLIDE + TWIN]
+            if in_win:
+                count += 1
+                total += sum(in_win)
+            w += 1
+    return count, total
+
+
+def run_config(rnd):
+    acc = {"count": 0, "total": 0}
+
+    def on_result(r):
+        if r is not None:
+            acc["count"] += 1
+            acc["total"] += int(r.value if hasattr(r, "value") else r)
+
+    batch = rnd.choice([16, 32, 64])
+    g = wf.PipeGraph("meta_mixed", wf.ExecutionMode.DEFAULT,
+                     wf.TimePolicy.EVENT)
+    src = (wf.Source_Builder(lambda: iter(stream()))
+           .withTimestampExtractor(lambda t: t["ts"])
+           .withOutputBatchSize(batch).build())
+    prep = (wf.Map_Builder(lambda t: dict(t))
+            .withParallelism(rnd.randint(1, 3))
+            .withOutputBatchSize(batch).build())
+    mp = g.add_source(src).add(prep)
+    mp.split(lambda t: t["value"] % 2, 2)
+
+    # branch 0 (even values): device map + filter
+    b0 = mp.select(0) \
+        .add(wf.MapTPU_Builder(
+            lambda t: {"key": t["key"], "value": t["value"] * 3,
+                       "ts": t["ts"]})
+             .withParallelism(rnd.randint(1, 2)).build()) \
+        .add(wf.FilterTPU_Builder(lambda t: (t["value"] % 5) != 0)
+             .withParallelism(rnd.randint(1, 2)).build())
+    # branch 1 (odd values): the same logic on host
+    b1 = mp.select(1) \
+        .add(wf.Map_Builder(
+            lambda t: {"key": t["key"], "value": t["value"] * 3,
+                       "ts": t["ts"]})
+             .withParallelism(rnd.randint(1, 3)).build()) \
+        .add(wf.Filter_Builder(lambda t: (t["value"] % 5) != 0)
+             .withParallelism(rnd.randint(1, 3)).build())
+
+    merged = b0.merge(b1)
+    win = (wf.Keyed_Windows_Builder(
+            lambda items: sum(t["value"] for t in items))
+           .withTBWindows(TWIN, TSLIDE)
+           .withKeyBy(lambda t: t["key"])
+           .withParallelism(rnd.randint(1, 3)).build())
+    merged.add(win).add_sink(wf.Sink_Builder(on_result).build())
+    g.run()
+    return acc["count"], acc["total"]
+
+
+def test_mixed_dag_metamorphic_sweep():
+    rnd = random.Random(42)
+    expected = py_oracle()
+    results = [run_config(rnd) for _ in range(5)]
+    # run 0 is the oracle for the sweep; the python oracle pins the values
+    assert results[0] == expected, (results[0], expected)
+    for i, r in enumerate(results[1:], 1):
+        assert r == results[0], f"config {i}: {r} != {results[0]}"

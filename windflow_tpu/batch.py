@@ -193,16 +193,28 @@ def columns_to_device(cols, tss, capacity: int, watermark: int = WM_NONE,
 
 def device_to_host(batch: DeviceBatch) -> HostBatch:
     """Transfer a DeviceBatch back to host records (reference
-    ``Batch_GPU_t::transfer2CPU``), dropping padding slots."""
+    ``Batch_GPU_t::transfer2CPU``), dropping padding slots.
+
+    The transfer itself is columnar — one bulk ``np.asarray`` per lane, like
+    the reference's single pinned D2H copy — and record construction uses
+    ``tolist()`` + ``dict(zip(...))`` on the common flat-dict payload shape
+    rather than per-tuple pytree calls."""
     valid = np.asarray(batch.valid)
     idx = np.nonzero(valid)[0]
+    tss = np.asarray(batch.ts)[idx].tolist()
+    if isinstance(batch.payload, dict):
+        cols = {n: np.asarray(a)[idx] for n, a in batch.payload.items()}
+        if all(c.ndim == 1 for c in cols.values()):
+            names = list(cols)
+            items = [dict(zip(names, vals))
+                     for vals in zip(*(cols[n].tolist() for n in names))]
+            return HostBatch(items=items, tss=tss,
+                             watermark=batch.watermark)
     treedef = jax.tree.structure(batch.payload)
     cols = [np.asarray(leaf)[idx] for leaf in jax.tree.leaves(batch.payload)]
-    tss = np.asarray(batch.ts)[idx]
     items = [jax.tree.unflatten(treedef, [c[i] for c in cols])
              for i in range(len(idx))]
     # Unwrap 0-d numpy scalars for ergonomic host-side records.
     items = [jax.tree.map(lambda v: v.item() if np.ndim(v) == 0 else v, it)
              for it in items]
-    return HostBatch(items=items, tss=[int(t) for t in tss],
-                     watermark=batch.watermark)
+    return HostBatch(items=items, tss=tss, watermark=batch.watermark)

@@ -180,6 +180,8 @@ class _StatefulTPUMixin:
 
     _initial_state = None
     _num_key_slots = 4096
+    _dense_keys = False
+    _assoc = None
 
     def withInitialState(self, state):
         """Per-key initial state prototype — switches the operator to the
@@ -190,6 +192,25 @@ class _StatefulTPUMixin:
     def withNumKeySlots(self, n: int):
         """Capacity of the dense device state table (max distinct keys)."""
         self._num_key_slots = n
+        return self
+
+    def withDenseKeys(self):
+        """Declare that the key extractor already returns dense slot ids in
+        [0, num_key_slots): host-side key interning is skipped, so every
+        batch is one fully-asynchronous device program (no per-batch D2H
+        sync).  Out-of-range keys are masked invalid, as in FfatWindowsTPU."""
+        self._dense_keys = True
+        return self
+
+    def withAssociativeUpdate(self, lift, comb, project):
+        """Declare the state update associative:
+        ``state' = comb(state, lift(record))`` and the output is
+        ``project(record, state_including_this_record)`` (for filters,
+        project returns the keep bool).  The operator then runs a log-depth
+        segmented scan instead of the rank wavefront, so a single hot key
+        costs the same as uniform keys.  The plain fn passed to the builder
+        is ignored."""
+        self._assoc = (lift, comb, project)
         return self
 
 
@@ -216,7 +237,9 @@ class MapTPU_Builder(_StatefulTPUMixin, _BuilderBase):
                                   name=self._name,
                                   parallelism=self._parallelism,
                                   key_extractor=self._key_extractor,
-                                  num_key_slots=self._num_key_slots)
+                                  num_key_slots=self._num_key_slots,
+                                  dense_keys=self._dense_keys,
+                                  assoc=self._assoc)
         return MapTPU(self._fn, name=self._name,
                       parallelism=self._parallelism,
                       batch_fn=self._batch_fn, routing=self._routing(),
@@ -240,7 +263,9 @@ class FilterTPU_Builder(_StatefulTPUMixin, _BuilderBase):
                                      name=self._name,
                                      parallelism=self._parallelism,
                                      key_extractor=self._key_extractor,
-                                     num_key_slots=self._num_key_slots)
+                                     num_key_slots=self._num_key_slots,
+                                     dense_keys=self._dense_keys,
+                                     assoc=self._assoc)
         return FilterTPU(self._fn, name=self._name,
                          parallelism=self._parallelism,
                          routing=self._routing(),

@@ -64,21 +64,16 @@ def _broadcast_state(proto, num_slots: int):
     return jax.tree.map(rep, proto)
 
 
-def _make_wavefront_step(fn: Callable, capacity: int,
-                         num_slots: int, is_filter: bool):
-    """Build the jitted per-batch program: slot lookup + rank-wavefront
-    stateful apply.  ``keys`` is the already-extracted key lane (the same
-    device array whose distinct values the host interned); ``uniq_keys`` is
-    the batch's sorted distinct keys padded with sentinels to ``capacity``;
-    ``uniq_slots`` the matching dense slot ids (host-interned)."""
+def _wavefront_body(fn: Callable, capacity: int,
+                    num_slots: int, is_filter: bool):
+    """Per-batch program body: rank-wavefront stateful apply over resolved
+    dense slot ids (``slots``; lanes with slot >= num_slots are ignored)."""
 
-    def step(state, payload, valid, keys, uniq_keys, uniq_slots):
-        pos = jnp.clip(jnp.searchsorted(uniq_keys, keys), 0, capacity - 1)
-        slots = uniq_slots[pos]
-
+    def body_fn(state, payload, valid, slots):
         # Stable sort by slot: arrival order is preserved within each key —
         # the ordering guarantee of the reference's per-key chain walk.
-        sort_key = jnp.where(valid, slots, jnp.int32(num_slots))
+        sort_key = jnp.where(valid & (slots < num_slots), slots,
+                             jnp.int32(num_slots))
         order = jnp.argsort(sort_key, stable=True)
         s_slots = sort_key[order]
         s_valid = valid[order]
@@ -137,7 +132,70 @@ def _make_wavefront_step(fn: Callable, capacity: int,
         out_payload = jax.tree.map(lambda a: a[inv], s_out)
         return state, out_payload, valid
 
-    return jax.jit(step, donate_argnums=(0,))
+    return body_fn
+
+
+def _assoc_body(lift: Callable, comb: Callable, project: Callable,
+                capacity: int, num_slots: int, is_filter: bool):
+    """Log-depth alternative to the wavefront for *associative* state
+    updates (``state' = comb(state, lift(record))``): a segmented inclusive
+    scan folds each key's contributions in arrival order, so a single-hot-key
+    batch costs the same as a uniform one — the wavefront's depth equals the
+    max per-key multiplicity, which degrades to ``capacity`` sequential
+    sweeps under skew (reference has no analogue: its per-key CUDA chain
+    walk is inherently sequential, ``map_gpu.hpp:78-102``).
+
+    ``project(record, state_incl)`` sees the state *including* the record's
+    own contribution (rolling-reduce semantics, like the reference's CPU
+    ``Reduce`` emitting the updated state per input, ``reduce.hpp:58-176``);
+    for filters it returns the keep bool."""
+
+    def body_fn(state, payload, valid, slots):
+        sort_key = jnp.where(valid & (slots < num_slots), slots,
+                             jnp.int32(num_slots))
+        order = jnp.argsort(sort_key, stable=True)
+        s_slots = sort_key[order]
+        s_valid = valid[order]
+        s_payload = jax.tree.map(lambda a: a[order], payload)
+
+        lifts = jax.vmap(lift)(s_payload)
+        starts = jnp.concatenate(
+            [jnp.ones(1, bool), s_slots[1:] != s_slots[:-1]])
+
+        # segmented inclusive scan of contributions (invalid lanes are all
+        # in the trailing sentinel segment, so no flags needed)
+        def op(a, b):
+            sa, va = a
+            sb, vb = b
+            combined = comb(va, vb)
+            v = jax.tree.map(
+                lambda c, x: jnp.where(_bshape(sb, c), x, c), combined, vb)
+            return sa | sb, v
+
+        _, prefix = jax.lax.associative_scan(op, (starts, lifts))
+
+        gather_slots = jnp.clip(s_slots, 0, num_slots - 1)
+        init = jax.tree.map(lambda a: a[gather_slots], state)
+        state_incl = comb(init, prefix)
+
+        s_out = jax.vmap(project)(s_payload, state_incl)
+
+        # persist each segment's final state (segment-end lanes of real
+        # slots; the sentinel segment is dropped by the OOB scatter)
+        ends = jnp.concatenate([s_slots[:-1] != s_slots[1:],
+                                jnp.ones(1, bool)])
+        scat = jnp.where(ends & (s_slots < num_slots), s_slots,
+                         jnp.int32(num_slots))
+        state = jax.tree.map(
+            lambda a, u: a.at[scat].set(u, mode="drop"), state, state_incl)
+
+        inv = jnp.argsort(order)
+        if is_filter:
+            return state, payload, valid & s_out[inv]
+        out_payload = jax.tree.map(lambda a: a[inv], s_out)
+        return state, out_payload, valid
+
+    return body_fn
 
 
 class _StatefulTPUBase(Operator):
@@ -148,7 +206,8 @@ class _StatefulTPUBase(Operator):
 
     def __init__(self, fn: Callable, initial_state: Any, name: str,
                  parallelism: int, key_extractor: Callable,
-                 num_key_slots: int = 4096) -> None:
+                 num_key_slots: int = 4096, dense_keys: bool = False,
+                 assoc: Optional[tuple] = None) -> None:
         if key_extractor is None:
             raise WindFlowError(
                 f"stateful TPU operator '{name}' requires a key extractor "
@@ -157,6 +216,15 @@ class _StatefulTPUBase(Operator):
                          is_tpu=True, key_extractor=key_extractor)
         self.fn = fn
         self.num_key_slots = num_key_slots
+        #: dense_keys: the extractor already returns slot ids in
+        #: [0, num_key_slots) — skip host interning entirely, so the step is
+        #: one fully-async device program with no per-batch D2H sync
+        #: (out-of-range keys are masked invalid, like FfatWindowsTPU)
+        self.dense_keys = dense_keys
+        #: assoc: (lift, comb, project) declares the state update
+        #: associative — the log-depth segmented-scan body replaces the
+        #: wavefront (skew-proof); ``fn`` is ignored when set
+        self.assoc = assoc
         self._state = _broadcast_state(initial_state, num_key_slots)
         self._interner = KeyInterner()
         self._extract = None
@@ -175,11 +243,34 @@ class _StatefulTPUBase(Operator):
                 "withNumKeySlots")
         return slots
 
+    def _body(self, capacity: int):
+        if self.assoc is not None:
+            lift, comb, project = self.assoc
+            return _assoc_body(lift, comb, project, capacity,
+                               self.num_key_slots, self._is_filter)
+        return _wavefront_body(self.fn, capacity, self.num_key_slots,
+                               self._is_filter)
+
     def _get_step(self, capacity: int):
         step = self._steps.get(capacity)
         if step is None:
-            step = _make_wavefront_step(self.fn, capacity,
-                                        self.num_key_slots, self._is_filter)
+            body = self._body(capacity)
+            key_fn = self.key_extractor
+            S = self.num_key_slots
+            if self.dense_keys:
+                # slot = key, resolved inside the one compiled program: the
+                # whole step is async device work, no host round-trip
+                def step(state, payload, valid, keys):
+                    if keys is None:
+                        keys = jax.vmap(key_fn)(payload).astype(jnp.int32)
+                    ok = valid & (keys >= 0) & (keys < S)
+                    return body(state, payload, ok, keys)
+            else:
+                def step(state, payload, valid, keys, uniq_keys, uniq_slots):
+                    pos = jnp.clip(jnp.searchsorted(uniq_keys, keys),
+                                   0, capacity - 1)
+                    return body(state, payload, valid, uniq_slots[pos])
+            step = jax.jit(step, donate_argnums=(0,))
             self._steps[capacity] = step
         return step
 
@@ -193,6 +284,10 @@ class _StatefulTPUBase(Operator):
                 return jax.vmap(key_fn)(payload).astype(jnp.int32)
 
             self._extract = extract
+        if self.dense_keys:
+            # no interning: dispatch stays fully asynchronous
+            return self._get_step(cap)(self._state, batch.payload,
+                                       batch.valid, batch.keys)
         # Keys are extracted once; the device array feeds the wavefront step
         # and its host copy drives interning (tiny D2H — parity with the
         # reference's dist_keys_cpu copy at the keyby boundary).
@@ -227,9 +322,10 @@ class StatefulMapTPU(_StatefulTPUBase):
 
     def __init__(self, fn, initial_state, name: str = "map_tpu",
                  parallelism: int = 1, key_extractor=None,
-                 num_key_slots: int = 4096) -> None:
+                 num_key_slots: int = 4096, dense_keys: bool = False,
+                 assoc=None) -> None:
         super().__init__(fn, initial_state, name, parallelism, key_extractor,
-                         num_key_slots)
+                         num_key_slots, dense_keys=dense_keys, assoc=assoc)
 
     def _step(self, batch: DeviceBatch) -> DeviceBatch:
         self._state, out_payload, valid = self._stateful_step(batch)
@@ -251,9 +347,10 @@ class StatefulFilterTPU(_StatefulTPUBase):
 
     def __init__(self, fn, initial_state, name: str = "filter_tpu",
                  parallelism: int = 1, key_extractor=None,
-                 num_key_slots: int = 4096) -> None:
+                 num_key_slots: int = 4096, dense_keys: bool = False,
+                 assoc=None) -> None:
         super().__init__(fn, initial_state, name, parallelism, key_extractor,
-                         num_key_slots)
+                         num_key_slots, dense_keys=dense_keys, assoc=assoc)
 
     def _step(self, batch: DeviceBatch) -> DeviceBatch:
         self._state, out_payload, valid = self._stateful_step(batch)

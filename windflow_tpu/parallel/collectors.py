@@ -52,11 +52,20 @@ class WatermarkCollector(Collector):
         self._closed = [False] * num_channels
 
     def _frontier(self) -> int:
-        seen = [w for w, c in zip(self._wms, self._closed)
-                if not c and w != WM_NONE]
-        if seen:
-            return min(seen)
-        return WM_NONE
+        """Min watermark over OPEN channels; a channel not yet heard from
+        holds the frontier down (reference initializes per-channel maxs to
+        zero and mins over all of them, ``watermark_collector.hpp:63-76``) —
+        otherwise a fast channel's watermark fires time windows before a
+        slow sibling's older tuples arrive, silently dropping them as late.
+        Punctuation cadence keeps genuinely idle channels advancing."""
+        lo = None
+        for w, c in zip(self._wms, self._closed):
+            if c:
+                continue
+            if w == WM_NONE:
+                return WM_NONE
+            lo = w if lo is None else min(lo, w)
+        return WM_NONE if lo is None else lo
 
     def on_message(self, channel, msg):
         wm = msg.watermark

@@ -103,6 +103,16 @@ class Emitter:
     def emit_device_batch(self, batch: DeviceBatch) -> None:
         raise NotImplementedError
 
+    # -- whole-host-batch interface (TPU→host boundary) ---------------------
+    def emit_host_batch(self, hb: HostBatch) -> None:
+        """Route a whole HostBatch (from a device transfer) downstream.
+        Forward/broadcast emitters route at batch granularity — the
+        reference GPU→CPU path also re-ships whole CPU batches
+        (``keyby_emitter_gpu.hpp:594-638``); the default falls back to
+        per-tuple emit for routings that need tuple granularity (keyby)."""
+        for item, ts in zip(hb.items, hb.tss):
+            self.emit(item, ts, hb.watermark, hb.shared)
+
     # -- columnar interface (bulk sources, windflow_tpu/io) -----------------
     def emit_columns(self, cols, tss, wm: int) -> None:
         """Emit a block of tuples given as SoA numpy columns.  The default
@@ -148,13 +158,14 @@ class _OpenBatch:
         self.items.append(item)
         self.tss.append(ts)
         self.shared |= shared
-        # Keep the NEWEST frontier (per-emitter watermarks are monotone).
-        # The reference folds the minimum (Batch_CPU_t::addTuple,
-        # batch_cpu_t.hpp:51-205); here the stronger stamp is safe because
-        # every consumer places a batch's tuples before acting on its
-        # watermark (Replica._dispatch, the TB FFAT place-then-fire step),
-        # and it saves downstream time windows one batch of firing lag.
-        self.wm = wm if self.wm == WM_NONE else max(self.wm, wm)
+        # Fold the MINIMUM frontier, as the reference does
+        # (Batch_CPU_t::addTuple, batch_cpu_t.hpp:51-205).  The "newest
+        # frontier" shortcut is only safe for one hop (tuples placed before
+        # the watermark acts); once an intermediate host operator unpacks
+        # the batch and re-emits singles, each single carries the batch
+        # stamp — a max-fold would let the first single's watermark fire
+        # windows ahead of its batch-siblings still in flight on the same
+        # channel, silently dropping them as late.
 
 
 class ForwardEmitter(Emitter):
@@ -181,6 +192,14 @@ class ForwardEmitter(Emitter):
             self._send(d, HostBatch(ob.items, ob.tss, ob.wm,
                                     shared=ob.shared))
             self._open[d] = _OpenBatch()
+
+    def emit_host_batch(self, hb):
+        # batch-granular round-robin; flush the destination's open batch
+        # first so per-destination arrival order is preserved
+        d = self._next
+        self._next = (self._next + 1) % len(self.dests)
+        self._flush_dest(d)
+        self._send(d, hb)
 
     def flush(self, wm):
         for d in range(len(self.dests)):
@@ -241,6 +260,13 @@ class BroadcastEmitter(Emitter):
             for d in range(len(self.dests)):
                 self._send(d, b)
             self._ob = _OpenBatch()
+
+    def emit_host_batch(self, hb):
+        self.flush(hb.watermark)
+        if len(self.dests) > 1:
+            hb = HostBatch(hb.items, hb.tss, hb.watermark, shared=True)
+        for d in range(len(self.dests)):
+            self._send(d, hb)
 
 
 class DeviceStageEmitter(Emitter):
@@ -488,9 +514,10 @@ class DevicePassEmitter(Emitter):
 
 class DeviceToHostEmitter(Emitter):
     """TPU→host boundary (reference GPU→CPU paths,
-    ``keyby_emitter_gpu.hpp:594-638``): transfers the batch back
-    (``device_to_host``) and re-routes through an inner host emitter so
-    FORWARD/KEYBY/BROADCAST semantics are identical to a host edge."""
+    ``keyby_emitter_gpu.hpp:594-638``): transfers the batch back columnar
+    (``device_to_host`` — one bulk copy per lane) and routes the whole
+    HostBatch through the inner host emitter; only keyby falls back to
+    per-tuple routing, as in the reference's per-dest re-split."""
 
     def __init__(self, inner: Emitter):
         super().__init__(inner.dests, inner.output_batch_size)
@@ -502,8 +529,11 @@ class DeviceToHostEmitter(Emitter):
     def emit_device_batch(self, batch: DeviceBatch):
         from windflow_tpu.batch import device_to_host
         hb = device_to_host(batch)
-        for item, ts in zip(hb.items, hb.tss):
-            self.inner.emit(item, ts, hb.watermark)
+        if hb.items:  # all-invalid batches (post-filter, empty split
+            self.inner.emit_host_batch(hb)  # partitions) carry no data
+
+    def emit_host_batch(self, hb):
+        self.inner.emit_host_batch(hb)
 
     def propagate_punctuation(self, wm):
         self.inner.propagate_punctuation(wm)
@@ -557,6 +587,7 @@ class SplittingEmitter(Emitter):
         super().__init__([], output_batch_size=0)
         self.split_fn = split_fn
         self.branches = list(branch_emitters)
+        self._device_splits = {}  # capacity -> compiled split or None
 
     def emit(self, item, ts, wm, shared=False):
         dest = self.split_fn(item)
@@ -572,10 +603,56 @@ class SplittingEmitter(Emitter):
             for d in dest:
                 self.branches[d].emit(item, ts, wm, multi)
 
+    def _get_device_split(self, capacity: int, payload):
+        """Compile one masked-compaction split program per capacity
+        (reference ``Splitting_Emitter_GPU`` / ``split_gpu``,
+        ``splitting_emitter_gpu.hpp:53``, ``multipipe.hpp:1244-1281``).
+        Requires a JAX-traceable single-destination split function; falls
+        back to the host per-tuple path (returns None) for Python-level or
+        multicast split functions."""
+        if capacity in self._device_splits:
+            return self._device_splits[capacity]
+        import jax
+        import jax.numpy as jnp
+        n = len(self.branches)
+        split_fn = self.split_fn
+        compiled = None
+        try:
+            shape = jax.eval_shape(lambda p: jax.vmap(split_fn)(p), payload)
+            ok = (getattr(shape, "shape", None) == (capacity,)
+                  and jnp.issubdtype(shape.dtype, jnp.integer))
+        except Exception:
+            ok = False
+        if ok:
+            @jax.jit
+            def compiled(payload, ts, valid):
+                idx = jax.vmap(split_fn)(payload).astype(jnp.int32)
+                dest = jnp.where(valid, idx, jnp.int32(n))
+                outs = []
+                for b in range(n):
+                    mask = dest == b
+                    order = jnp.argsort(~mask, stable=True)
+                    pay_b = jax.tree.map(lambda a: a[order], payload)
+                    outs.append((pay_b, ts[order],
+                                 jnp.arange(capacity) < jnp.sum(mask)))
+                return outs
+
+        self._device_splits[capacity] = compiled
+        return compiled
+
     def emit_device_batch(self, batch: DeviceBatch):
-        # Device batches are pulled to host and split per tuple (reference
-        # Splitting_Emitter_GPU splits device batches natively; a device-side
-        # masked split is a planned optimization).
+        split = self._get_device_split(batch.capacity, batch.payload)
+        if split is not None:
+            # Device-native split: one compiled masked compaction per
+            # branch; empty partitions still ship (all-invalid) — skipping
+            # them would force a host sync on the partition counts.
+            outs = split(batch.payload, batch.ts, batch.valid)
+            for b, (pay, ts, valid) in enumerate(outs):
+                self.branches[b].emit_device_batch(
+                    DeviceBatch(pay, ts, valid, watermark=batch.watermark,
+                                size=None))
+            return
+        # Fallback: host-side per-tuple split (Python or multicast split fn).
         from windflow_tpu.batch import device_to_host
         hb = device_to_host(batch)
         for item, ts in zip(hb.items, hb.tss):
