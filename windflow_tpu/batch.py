@@ -91,19 +91,36 @@ class DeviceBatch:
               keyby boundary (reference: ``dist_keys_cpu`` + per-key index
               chains built by ``keyby_emitter_gpu.hpp:519-583``; here key
               grouping is done with XLA sorts/segment ops at use sites).
-    watermark, size : host-side metadata.
+    watermark, size : host-side metadata.  ``watermark`` is the min-folded
+              stamp safe to propagate downstream (a host edge may re-split
+              the batch per tuple).  ``frontier`` is the NEWEST watermark
+              observed when the batch content was fixed at staging; it is
+              only valid for the consuming operator's own firing decision
+              *after* placing all the batch's tuples (place-then-fire), so
+              it never propagates past the consumer — it saves time windows
+              one batch of firing lag over the conservative stamp.
     """
 
-    __slots__ = ("payload", "ts", "valid", "keys", "watermark", "_size")
+    __slots__ = ("payload", "ts", "valid", "keys", "watermark", "_frontier",
+                 "_size")
 
     def __init__(self, payload, ts, valid, keys=None, watermark: int = WM_NONE,
-                 size: Optional[int] = None):
+                 size: Optional[int] = None, frontier: Optional[int] = None):
         self.payload = payload
         self.ts = ts
         self.valid = valid
         self.keys = keys
         self.watermark = watermark
+        self._frontier = frontier
         self._size = size
+
+    @property
+    def frontier(self) -> int:
+        """Newest known watermark at batch-content fix time; falls back to
+        the propagated stamp.  Never below ``watermark``."""
+        if self._frontier is None:
+            return self.watermark
+        return max(self._frontier, self.watermark)
 
     @property
     def size(self) -> int:
@@ -148,7 +165,7 @@ def _pad_leading(arr: np.ndarray, capacity: int) -> np.ndarray:
 
 
 def _stage_soa(soa, tss, n: int, capacity: int, watermark: int,
-               device) -> DeviceBatch:
+               device, frontier: Optional[int] = None) -> DeviceBatch:
     """Shared staging tail: pad an SoA numpy pytree + timestamps to
     ``capacity``, build the validity mask, optionally pin to a device."""
     payload = jax.tree.map(
@@ -161,11 +178,12 @@ def _stage_soa(soa, tss, n: int, capacity: int, watermark: int,
         payload = jax.device_put(payload, device)
         ts = jax.device_put(ts, device)
         valid = jax.device_put(valid, device)
-    return DeviceBatch(payload, ts, valid, watermark=watermark, size=n)
+    return DeviceBatch(payload, ts, valid, watermark=watermark, size=n,
+                       frontier=frontier)
 
 
 def host_to_device(batch: HostBatch, capacity: Optional[int] = None,
-                   device=None) -> DeviceBatch:
+                   device=None, frontier: Optional[int] = None) -> DeviceBatch:
     """Stage a HostBatch into device buffers, padding to ``capacity``."""
     n = len(batch)
     if n == 0:
@@ -174,11 +192,12 @@ def host_to_device(batch: HostBatch, capacity: Optional[int] = None,
     if n > cap:
         raise ValueError(f"batch of {n} items exceeds capacity {cap}")
     return _stage_soa(_stack_records(batch.items), batch.tss, n, cap,
-                      batch.watermark, device)
+                      batch.watermark, device, frontier)
 
 
 def columns_to_device(cols, tss, capacity: int, watermark: int = WM_NONE,
-                      device=None) -> DeviceBatch:
+                      device=None, frontier: Optional[int] = None
+                      ) -> DeviceBatch:
     """Stage columnar (SoA numpy) data directly into a DeviceBatch — the
     zero-per-tuple-Python path used by bulk sources (windflow_tpu/io) and the
     columnar staging emitter.  ``cols`` is a dict of [n]-leading numpy
@@ -188,7 +207,8 @@ def columns_to_device(cols, tss, capacity: int, watermark: int = WM_NONE,
         raise ValueError("cannot stage an empty column batch")
     if n > capacity:
         raise ValueError(f"column batch of {n} exceeds capacity {capacity}")
-    return _stage_soa(dict(cols), tss, n, capacity, watermark, device)
+    return _stage_soa(dict(cols), tss, n, capacity, watermark, device,
+                      frontier)
 
 
 def device_to_host(batch: DeviceBatch) -> HostBatch:

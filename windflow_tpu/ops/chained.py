@@ -154,7 +154,8 @@ class ChainedTPU(Operator):
         size = None if self._has_filter else batch.known_size
         # keys lane not forwarded: edge-scoped metadata (see ops/tpu.py)
         return DeviceBatch(payload, batch.ts, valid,
-                           watermark=batch.watermark, size=size)
+                           watermark=batch.watermark, size=size,
+                           frontier=batch.frontier)
 
 
 def fuse(a: Operator, b: Operator) -> Operator:

@@ -92,7 +92,8 @@ class MapTPU(Operator):
         # (valid only for the extractor of the edge that attached it), and a
         # map may rewrite the key field anyway.
         return DeviceBatch(out_payload, batch.ts, batch.valid,
-                           watermark=batch.watermark, size=batch._size)
+                           watermark=batch.watermark, size=batch._size,
+                           frontier=batch.frontier)
 
 
 class FilterTPUReplica(_TPUReplica):
@@ -125,7 +126,7 @@ class FilterTPU(Operator):
     def _step(self, batch: DeviceBatch) -> DeviceBatch:
         new_valid = self._jit_step(batch.payload, batch.valid)
         return DeviceBatch(batch.payload, batch.ts, new_valid,
-                           watermark=batch.watermark,
+                           watermark=batch.watermark, frontier=batch.frontier,
                            size=None)  # survivor count unknown until observed
 
 
@@ -284,9 +285,11 @@ class ReduceTPU(Operator):
             self._mesh_dropped = n_drop if self._mesh_dropped is None \
                 else self._mesh_dropped + n_drop
             return DeviceBatch(table, ts_out, has,
-                               watermark=batch.watermark, size=None)
+                               watermark=batch.watermark, size=None,
+                               frontier=batch.frontier)
         out_keys, out_payload, out_ts, out_valid = \
             self._get_step(batch.capacity)(batch.keys, batch.payload,
                                            batch.ts, batch.valid)
         return DeviceBatch(out_payload, out_ts, out_valid,
-                           watermark=batch.watermark, size=None)
+                           watermark=batch.watermark, size=None,
+                           frontier=batch.frontier)

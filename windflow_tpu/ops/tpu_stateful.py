@@ -330,7 +330,8 @@ class StatefulMapTPU(_StatefulTPUBase):
     def _step(self, batch: DeviceBatch) -> DeviceBatch:
         self._state, out_payload, valid = self._stateful_step(batch)
         return DeviceBatch(out_payload, batch.ts, valid,
-                           watermark=batch.watermark, size=batch._size)
+                           watermark=batch.watermark, size=batch._size,
+                           frontier=batch.frontier)
 
 
 class StatefulFilterTPUReplica(_TPUReplica):
@@ -355,4 +356,5 @@ class StatefulFilterTPU(_StatefulTPUBase):
     def _step(self, batch: DeviceBatch) -> DeviceBatch:
         self._state, out_payload, valid = self._stateful_step(batch)
         return DeviceBatch(out_payload, batch.ts, valid,
-                           watermark=batch.watermark, size=None)
+                           watermark=batch.watermark, size=None,
+                           frontier=batch.frontier)

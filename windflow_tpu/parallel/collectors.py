@@ -49,17 +49,21 @@ class WatermarkCollector(Collector):
     def __init__(self, num_channels: int) -> None:
         super().__init__(num_channels)
         self._wms = [WM_NONE] * num_channels
+        # Per-channel newest frontier (DeviceBatch.frontier stamps): always
+        # >= the propagated watermark, aligned the same way so a multi-input
+        # device operator never fires ahead of a lagging sibling channel.
+        self._fronts = [WM_NONE] * num_channels
         self._closed = [False] * num_channels
 
-    def _frontier(self) -> int:
-        """Min watermark over OPEN channels; a channel not yet heard from
-        holds the frontier down (reference initializes per-channel maxs to
-        zero and mins over all of them, ``watermark_collector.hpp:63-76``) —
+    def _fold(self, slots) -> int:
+        """Min over OPEN channels; a channel not yet heard from holds the
+        frontier down (reference initializes per-channel maxs to zero and
+        mins over all of them, ``watermark_collector.hpp:63-76``) —
         otherwise a fast channel's watermark fires time windows before a
         slow sibling's older tuples arrive, silently dropping them as late.
         Punctuation cadence keeps genuinely idle channels advancing."""
         lo = None
-        for w, c in zip(self._wms, self._closed):
+        for w, c in zip(slots, self._closed):
             if c:
                 continue
             if w == WM_NONE:
@@ -67,22 +71,32 @@ class WatermarkCollector(Collector):
             lo = w if lo is None else min(lo, w)
         return WM_NONE if lo is None else lo
 
+    def _frontier(self) -> int:
+        return self._fold(self._wms)
+
     def on_message(self, channel, msg):
         wm = msg.watermark
         if wm != WM_NONE and wm > self._wms[channel]:
             self._wms[channel] = wm
+        # Punctuations/host batches advance the channel frontier by their
+        # watermark; device batches by their (tighter) staging frontier.
+        fr = msg.frontier if isinstance(msg, DeviceBatch) else wm
+        if fr != WM_NONE and fr > self._fronts[channel]:
+            self._fronts[channel] = fr
         f = self._frontier()
-        if f != msg.watermark:
-            # Rewrite on a fresh wrapper, never in place: batches are
-            # multicast by handle (BROADCAST / device pass-through), so an
-            # in-place rewrite by one consumer would corrupt the frontier a
-            # sibling replica reads.
-            if isinstance(msg, HostBatch):
-                msg = dataclasses.replace(msg, watermark=f)
-            elif isinstance(msg, DeviceBatch):
+        if isinstance(msg, DeviceBatch):
+            ff = self._fold(self._fronts)
+            if f != msg.watermark or ff != msg.frontier:
+                # Rewrite on a fresh wrapper, never in place: batches are
+                # multicast by handle (BROADCAST / device pass-through), so
+                # an in-place rewrite by one consumer would corrupt the
+                # frontier a sibling replica reads.
                 msg = DeviceBatch(msg.payload, msg.ts, msg.valid,
                                   keys=msg.keys, watermark=f,
-                                  size=msg.known_size)
+                                  size=msg.known_size, frontier=ff)
+        elif f != msg.watermark:
+            if isinstance(msg, HostBatch):
+                msg = dataclasses.replace(msg, watermark=f)
             else:
                 assert isinstance(msg, Punctuation)
                 msg = Punctuation(f)

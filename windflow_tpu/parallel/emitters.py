@@ -146,6 +146,17 @@ def _concat(arrs):
 
 
 class _OpenBatch:
+    """Accumulates tuples for one destination.
+
+    The watermark folds the MINIMUM frontier, as the reference does
+    (``Batch_CPU_t::addTuple``, ``batch_cpu_t.hpp:51-205``): a downstream
+    host operator may unpack the batch and re-emit singles each carrying the
+    batch stamp, and a max-fold would let the first single's watermark fire
+    windows ahead of its batch-siblings still in flight on the same channel,
+    silently dropping them as late.  The tighter newest frontier travels
+    separately as ``DeviceBatch.frontier`` (see batch.py), valid only for
+    the consuming operator's own place-then-fire step."""
+
     __slots__ = ("items", "tss", "wm", "shared")
 
     def __init__(self):
@@ -158,14 +169,8 @@ class _OpenBatch:
         self.items.append(item)
         self.tss.append(ts)
         self.shared |= shared
-        # Fold the MINIMUM frontier, as the reference does
-        # (Batch_CPU_t::addTuple, batch_cpu_t.hpp:51-205).  The "newest
-        # frontier" shortcut is only safe for one hop (tuples placed before
-        # the watermark acts); once an intermediate host operator unpacks
-        # the batch and re-emits singles, each single carries the batch
-        # stamp — a max-fold would let the first single's watermark fire
-        # windows ahead of its batch-siblings still in flight on the same
-        # channel, silently dropping them as late.
+        if wm != WM_NONE:
+            self.wm = wm if self.wm == WM_NONE else min(self.wm, wm)
 
 
 class ForwardEmitter(Emitter):
@@ -292,6 +297,12 @@ class DeviceStageEmitter(Emitter):
         super().__init__(dests, output_batch_size)
         self._ob = _OpenBatch()
         self._next = 0
+        # Newest watermark seen by this emitter (monotone): staged batches
+        # carry it as DeviceBatch.frontier so the consuming device operator
+        # can fire time windows without the min-fold's one-batch lag — see
+        # _OpenBatch and DeviceBatch.frontier for why the propagated
+        # watermark stays min-folded.
+        self._frontier = WM_NONE
         # columnar accumulation: list of (cols dict, tss) chunks + row count
         self._col_chunks = []
         self._col_rows = 0
@@ -308,9 +319,14 @@ class DeviceStageEmitter(Emitter):
                     f"by the mesh's {math.prod(mesh.devices.shape)} devices")
             self._stage_target = batch_sharding(mesh)
 
+    def _advance_frontier(self, wm):
+        if wm != WM_NONE and wm > self._frontier:
+            self._frontier = wm
+
     def emit(self, item, ts, wm, shared=False):
         # `shared` is irrelevant here: staging materializes new device arrays
         # from the record's values, never aliasing the host object.
+        self._advance_frontier(wm)
         self._ob.add(item, ts, wm)
         if len(self._ob.items) >= self.output_batch_size:
             self.flush(wm)
@@ -319,11 +335,13 @@ class DeviceStageEmitter(Emitter):
         """Columnar fast path: accumulate SoA chunks, stage full batches with
         one concatenate + one transfer (reference pinned staging without the
         per-tuple fill loop, ``forward_emitter_gpu.hpp:254-300``)."""
+        self._advance_frontier(wm)
         self._col_chunks.append((cols, tss))
         self._col_rows += len(tss)
-        # newest frontier, as in _OpenBatch.add
-        self._col_wm = wm if self._col_wm == WM_NONE else max(self._col_wm,
-                                                              wm)
+        # min-fold, as _OpenBatch.add (each chunk's wm covers its rows)
+        if wm != WM_NONE:
+            self._col_wm = (wm if self._col_wm == WM_NONE
+                            else min(self._col_wm, wm))
         cap = self.output_batch_size
         if self._col_rows >= cap:
             names = list(self._col_chunks[0][0])
@@ -340,17 +358,23 @@ class DeviceStageEmitter(Emitter):
                 ({n: a[total - rem:] for n, a in cat.items()},
                  tcat[total - rem:])]
             self._col_rows = rem
-            # remaining rows are the tail of the newest chunk
-            self._col_wm = wm if rem else WM_NONE
+            # Remaining rows are the tail of the newest chunk: re-stamp with
+            # its wm, but never discard a known frontier for WM_NONE.
+            if rem == 0:
+                self._col_wm = WM_NONE
+            elif wm != WM_NONE:
+                self._col_wm = wm
 
     def _stage_columns(self, cols, tss, wm):
         db = columns_to_device(cols, tss, self.output_batch_size,
-                               watermark=wm, device=self._stage_target)
+                               watermark=wm, device=self._stage_target,
+                               frontier=self._frontier)
         d = self._next
         self._next = (self._next + 1) % len(self.dests)
         self._send(d, db)
 
     def flush(self, wm):
+        self._advance_frontier(wm)
         if self._col_chunks:
             names = list(self._col_chunks[0][0])
             cat = {n: _concat([c[0][n] for c in self._col_chunks])
@@ -365,7 +389,8 @@ class DeviceStageEmitter(Emitter):
             return
         hb = HostBatch(self._ob.items, self._ob.tss, self._ob.wm)
         db = host_to_device(hb, capacity=self.output_batch_size,
-                            device=self._stage_target)
+                            device=self._stage_target,
+                            frontier=self._frontier)
         d = self._next
         self._next = (self._next + 1) % len(self.dests)
         self._send(d, db)
@@ -485,7 +510,8 @@ class DeviceKeyByEmitter(Emitter):
             batch.payload, batch.ts, batch.valid, batch.keys)
         for d, (pay, ts, keys, valid) in enumerate(outs):
             self._send(d, DeviceBatch(pay, ts, valid, keys=keys,
-                                      watermark=batch.watermark, size=None))
+                                      watermark=batch.watermark, size=None,
+                                      frontier=batch.frontier))
 
 
 class DevicePassEmitter(Emitter):
@@ -650,7 +676,7 @@ class SplittingEmitter(Emitter):
             for b, (pay, ts, valid) in enumerate(outs):
                 self.branches[b].emit_device_batch(
                     DeviceBatch(pay, ts, valid, watermark=batch.watermark,
-                                size=None))
+                                size=None, frontier=batch.frontier))
             return
         # Fallback: host-side per-tuple split (Python or multicast split fn).
         from windflow_tpu.batch import device_to_host
