@@ -42,7 +42,9 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from windflow_tpu.basic import WindFlowError
 from windflow_tpu.batch import DeviceBatch, HostBatch, host_to_device
 from windflow_tpu.windows.ffat_kernels import (_b, _masked_reduce_last, _seg_scan,
-                                           make_ffat_state, make_ffat_step)
+                                           make_ffat_state, make_ffat_step,
+                                           make_ffat_tb_state,
+                                           make_ffat_tb_step)
 
 DATA_AXIS = "data"
 KEY_AXIS = "key"
@@ -201,6 +203,30 @@ def make_sharded_keyed_reduce(mesh: Mesh, capacity: int, K: int,
 # key subset; here shards of one dense state table own key ranges).
 # ---------------------------------------------------------------------------
 
+def _ffat_shard_layout(mesh: Mesh, capacity: int, K: int):
+    """Shared guards + layout for key-sharded FFAT variants: returns
+    ``(K_local, key_base_fn, gather)`` where ``gather`` replicates the
+    data-sharded batch lanes across the ``data`` axis (one all_gather over
+    ICI; identity on a 1-wide data axis)."""
+    kk = mesh.shape[KEY_AXIS]
+    dd = mesh.shape[DATA_AXIS]
+    if K % kk:
+        raise WindFlowError(f"max_keys {K} not divisible by key axis {kk}")
+    if capacity % dd:
+        raise WindFlowError(
+            f"capacity {capacity} not divisible by data axis {dd}")
+    K_local = K // kk
+    key_base_fn = lambda: jax.lax.axis_index(KEY_AXIS) * K_local
+
+    def gather(payload, ts, valid):
+        if dd == 1:
+            return payload, ts, valid
+        ag = lambda a: jax.lax.all_gather(a, DATA_AXIS, axis=0, tiled=True)
+        return jax.tree.map(ag, payload), ag(ts), ag(valid)
+
+    return K_local, key_base_fn, gather
+
+
 def make_sharded_ffat_step(mesh: Mesh, capacity: int, K: int, Pn: int, R: int,
                            D: int, lift: Callable, comb: Callable,
                            key_fn: Optional[Callable]):
@@ -211,23 +237,12 @@ def make_sharded_ffat_step(mesh: Mesh, capacity: int, K: int, Pn: int, R: int,
     ``all_gather``-ed across ``data`` inside the program so every key shard
     sees every tuple exactly once over ICI.  Fired-window outputs come back
     key-sharded, one row block per chip."""
-    kk = mesh.shape[KEY_AXIS]
-    dd = mesh.shape[DATA_AXIS]
-    if K % kk:
-        raise WindFlowError(f"max_keys {K} not divisible by key axis {kk}")
-    if capacity % dd:
-        raise WindFlowError(
-            f"capacity {capacity} not divisible by data axis {dd}")
-    K_local = K // kk
-    step_local = make_ffat_step(
-        capacity, K_local, Pn, R, D, lift, comb, key_fn,
-        key_base_fn=lambda: jax.lax.axis_index(KEY_AXIS) * K_local)
+    K_local, key_base_fn, gather = _ffat_shard_layout(mesh, capacity, K)
+    step_local = make_ffat_step(capacity, K_local, Pn, R, D, lift, comb,
+                                key_fn, key_base_fn=key_base_fn)
 
     def local(state, payload, ts, valid):
-        if dd > 1:
-            ag = lambda a: jax.lax.all_gather(a, DATA_AXIS, axis=0, tiled=True)
-            payload = jax.tree.map(ag, payload)
-            ts, valid = ag(ts), ag(valid)
+        payload, ts, valid = gather(payload, ts, valid)
         return step_local(state, payload, ts, valid)
 
     fn = jax.shard_map(
@@ -243,3 +258,64 @@ def make_sharded_ffat_state(agg_spec, K: int, R: int, mesh: Mesh):
     state = make_ffat_state(agg_spec, K, R)
     sh = state_sharding(mesh)
     return jax.tree.map(lambda a: jax.device_put(a, sh), state)
+
+
+# Time-based FFAT on the mesh.  The single-chip TB state keeps scalar pane
+# clocks shared by all keys (ffat_kernels.make_ffat_tb_state); sharded along
+# ``key`` each shard's ring evolves independently — its capacity roll depends
+# on the panes of the keys it owns — so the scalars become one lane per key
+# shard, sharded the same way as the ``[K, NP]`` cells.
+_TB_SCALARS = ("base", "win_next", "max_seen", "n_late", "n_evicted")
+
+
+def make_sharded_ffat_tb_state(agg_spec, K: int, NP: int, mesh: Mesh):
+    """Allocate the TB pane-ring state pre-sharded along ``key``: cells split
+    by key rows, one scalar-clock lane per key shard."""
+    kk = mesh.shape[KEY_AXIS]
+    state = make_ffat_tb_state(agg_spec, K, NP)
+    for name in _TB_SCALARS:
+        state[name] = jnp.broadcast_to(state[name], (kk,))
+    sh = state_sharding(mesh)
+    return jax.tree.map(lambda a: jax.device_put(a, sh), state)
+
+
+def make_sharded_ffat_tb_step(mesh: Mesh, capacity: int, K: int, P_usec: int,
+                              R: int, D: int, NP: int, lift: Callable,
+                              comb: Callable, key_fn: Optional[Callable]):
+    """Compile one time-based FFAT step sharded over the mesh.
+
+    Same layout as the CB variant (:func:`make_sharded_ffat_step`): state
+    split along ``key`` — chip *i* owns keys ``[i*K/kk, (i+1)*K/kk)`` and its
+    own pane-ring clock — the data-sharded batch ``all_gather``-ed across
+    ``data`` so every key shard sees every tuple once over ICI, and the
+    watermark pane frontier passed replicated (it is host metadata, identical
+    on every chip).  Reference: ``Ffat_Windows_GPU`` TB replicas each owning
+    a key subset with quantum panes, ``ffat_replica_gpu.hpp:92-216,438-514``."""
+    K_local, key_base_fn, gather = _ffat_shard_layout(mesh, capacity, K)
+    step_local = make_ffat_tb_step(capacity, K_local, P_usec, R, D, NP,
+                                   lift, comb, key_fn,
+                                   key_base_fn=key_base_fn)
+
+    def local(state, payload, ts, valid, wm_pane):
+        payload, ts, valid = gather(payload, ts, valid)
+        sstate = {k: (v[0] if k in _TB_SCALARS else v)
+                  for k, v in state.items()}
+        new_state, out, fired, out_ts, n_adv = step_local(
+            sstate, payload, ts, valid, wm_pane)
+        new_state = {k: (v[None] if k in _TB_SCALARS else v)
+                     for k, v in new_state.items()}
+        # Total window advance across key shards (drivers loop flushes on
+        # it).  Along ``data`` the value is already replicated — every data
+        # row of a key shard saw the same gathered batch — so summing over
+        # KEY_AXIS alone keeps it both exact and mesh-replicated.
+        n_adv = jax.lax.psum(n_adv, KEY_AXIS)
+        return new_state, out, fired, out_ts, n_adv
+
+    sspec = {k: P(KEY_AXIS) for k in
+             ("cells", "cell_valid") + _TB_SCALARS}
+    fn = jax.shard_map(
+        local, mesh=mesh,
+        in_specs=(sspec, P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS), P()),
+        out_specs=(sspec, P(KEY_AXIS), P(KEY_AXIS), P(KEY_AXIS), P()),
+        check_vma=False)
+    return jax.jit(fn, donate_argnums=(0,))

@@ -275,6 +275,9 @@ def make_ffat_tb_state(agg_spec, K: int, NP: int):
         "cell_valid": jnp.zeros((K, NP), bool),
         "base": jnp.zeros((), jnp.int64),      # pane index of column 0
         "win_next": jnp.zeros((), jnp.int64),  # next unfired window id
+        # newest data pane ever placed: windows starting beyond it can never
+        # emit, so firing never advances past it (bounds EOS flush loops)
+        "max_seen": jnp.full((), -(1 << 60), jnp.int64),
         "n_late": jnp.zeros((), jnp.int64),    # dropped late tuples
         "n_evicted": jnp.zeros((), jnp.int64),  # pane cells lost to overflow
     }
@@ -289,11 +292,36 @@ def make_ffat_tb_step(capacity: int, K: int, P_usec: int, R: int, D: int,
     Window ``w`` covers panes ``[w*D, w*D + R)`` — times
     ``[w*slide, w*slide + win)`` — and fires once the (lateness-adjusted)
     watermark passes the window end; the host passes ``wm_adj`` per batch.
-    The ring holds ``NP`` panes: older panes are rolled out once their
-    windows fire (or, under overload, to make room — affected windows then
-    fire over their surviving panes only).
+    The ring holds ``NP`` panes.
+
+    The step fires in passes around placement so a watermark/time jump
+    (an idle gap in the stream) cannot evict fireable windows:
+
+    * pass A, *before* making room for the batch, fires windows complete
+      under ``min(wm, oldest batch pane)`` — the frontier below which no
+      tuple of this batch (nor, by the watermark contract, any future one)
+      can fall, so those windows' data is fully in the ring already.  It
+      runs TWICE: one pass only fires windows whose ends are inside the
+      ring, and with a lagging watermark the ring may hold data whose
+      windows end beyond it — the first pass's roll brings those ends in
+      range, the second fires them (two passes cover all in-ring data
+      because ``NP >= 2R``, enforced by the operator).
+    * the capacity roll then makes room for the batch's newest pane; panes
+      it evicts belong to windows overlapping the batch's own time range —
+      data loss only under a genuinely undersized ring (pane_capacity <
+      window span + batch time spread), surfaced via ``n_evicted``.
+    * pass B, after placement, fires what the batch itself completed —
+      windows ending between the batch's oldest pane and the watermark
+      (routinely non-empty: on an ordered stream these are the windows the
+      batch's own tuples closed).
+
+    Returns ``(state, out, fired, out_ts, n_advanced)``; ``n_advanced``
+    counts windows passed (fired or skipped-as-evicted) so drivers can loop
+    EOS/catch-up flushes until the frontier genuinely stops moving (windows
+    beyond an empty gap would otherwise stall behind a no-emission pass).
     """
     MW = NP // D + 2
+    N_PASSES = 3                     # A1, A2 (pre-place), B (post-place)
 
     def roll_left(flags, values, k):
         # advance the ring by k panes (k is traced); vacated tail = invalid
@@ -304,6 +332,56 @@ def make_ffat_tb_step(capacity: int, K: int, P_usec: int, R: int, D: int,
         v = jax.tree.map(lambda a: jnp.take(a, idxc, axis=1), values)
         return f, v
 
+    def fire_pass(cells, cell_valid, base, win_next, frontier, max_seen):
+        """Fire windows ending <= frontier whose end pane is inside the
+        ring; returns the rolled ring + firing outputs.  Firing is capped to
+        in-ring ends: if the frontier outruns the ring, later windows wait
+        for the next pass/step (the roll brings their ends in range) — every
+        fired fold is exactly over its own panes.  It is also capped to
+        windows starting at or before the newest data pane (``max_seen``):
+        later windows can never emit, so advancing past them would let an
+        infinite-watermark flush loop run forever."""
+        j = jnp.arange(MW, dtype=jnp.int64)
+        w = win_next + j
+        end_local = (w * D + R - 1 - base)                     # [MW]
+        fire = ((w * D + R) <= frontier) & (end_local < NP) \
+            & (w * D <= max_seen)                              # [MW] prefix
+        # end_local < 0 happens only when a capacity roll evicted the whole
+        # window (overload); such windows must not fire with pane-0 data
+        emitable = fire & (end_local >= 0)
+        eidx = jnp.clip(end_local, 0, NP - 1).astype(jnp.int32)
+        n_fired = jnp.sum(fire.astype(jnp.int64))
+
+        def do_fold(_):
+            # the O(K*NP*log R) sliding fold + gathers, only when this pass
+            # actually fires something (on an ordered stream the pre-place
+            # passes usually fire nothing — the previous step's post-place
+            # pass already did their work)
+            sflag, swin = _sliding_reduce(comb, cell_valid, cells, R, axis=1)
+
+            def pick_leaf(a):
+                idx = eidx.reshape(1, MW, *([1] * (a.ndim - 2)))
+                idx = jnp.broadcast_to(idx, (K, MW) + a.shape[2:])
+                return jnp.take_along_axis(a, idx, axis=1)
+            wvals = jax.tree.map(pick_leaf, swin)
+            any_data = jnp.take_along_axis(
+                sflag, jnp.broadcast_to(eidx[None, :], (K, MW)), axis=1)
+            # advance past fully-evicted windows (fire) but never emit them
+            # (emitable): their eidx clips to pane 0, which they don't cover
+            return emitable[None, :] & any_data, wvals
+
+        def no_fold(_):
+            zvals = jax.tree.map(
+                lambda a: jnp.zeros((K, MW) + a.shape[2:], a.dtype), cells)
+            return jnp.zeros((K, MW), bool), zvals
+
+        fired, wvals = jax.lax.cond(n_fired > 0, do_fold, no_fold, None)
+        new_next = win_next + n_fired
+        shift = jnp.clip(new_next * D - base, 0, NP)
+        cell_valid, cells = roll_left(cell_valid, cells, shift)
+        return (cells, cell_valid, base + shift, new_next,
+                fired, wvals, w, n_fired)
+
     def step(state, payload, ts, valid, wm_pane):
         B = capacity
         kb = key_base_fn() if key_base_fn is not None else None
@@ -313,23 +391,41 @@ def make_ffat_tb_step(capacity: int, K: int, P_usec: int, R: int, D: int,
             keys = keys - jnp.int32(kb)
         ok = valid & (keys >= 0) & (keys < K)
         pane = ts.astype(jnp.int64) // P_usec
+        if D > R:
+            # hopping windows with gaps (slide > win): panes in the
+            # inter-window gap belong to no window — never place or count
+            # them (pane p is covered iff p mod D < R)
+            ok = ok & ((pane % D) < R)
 
-        # 1. capacity roll: make room for this batch's newest pane.  Panes
-        # evicted here belong to windows not yet fired — data loss under an
-        # undersized ring (pane_capacity < window span + batch time spread),
-        # surfaced via the n_evicted counter.
-        max_pane = jnp.max(jnp.where(ok, pane, state["base"]))
-        shift_cap = jnp.maximum(
-            jnp.int64(0), max_pane - state["base"] - (NP - 1))
+        # 1. pass A (twice): fire everything no tuple of this batch can
+        # touch; the second pass reaches windows whose ends the first
+        # pass's roll brought inside the ring
+        min_pane = jnp.min(jnp.where(ok, pane, jnp.int64(1) << 60))
+        frontier_a = jnp.minimum(wm_pane, min_pane)
+        cells, cell_valid, base, win_next = (
+            state["cells"], state["cell_valid"], state["base"],
+            state["win_next"])
+        a_outs = []
+        for _ in range(2):
+            (cells, cell_valid, base, win_next,
+             fired_i, wvals_i, w_i, n_i) = fire_pass(
+                cells, cell_valid, base, win_next, frontier_a,
+                state["max_seen"])
+            a_outs.append((fired_i, wvals_i, w_i, n_i))
+
+        # 2. capacity roll: make room for this batch's newest pane
+        max_pane = jnp.max(jnp.where(ok, pane, base))
+        max_seen = jnp.maximum(state["max_seen"],
+                               jnp.max(jnp.where(ok, pane, -(1 << 60))))
+        shift_cap = jnp.maximum(jnp.int64(0), max_pane - base - (NP - 1))
         evicted = jnp.sum(
-            (state["cell_valid"]
+            (cell_valid
              & (jnp.arange(NP, dtype=jnp.int64)[None, :] < shift_cap))
             .astype(jnp.int64))
-        cell_valid, cells = roll_left(state["cell_valid"], state["cells"],
-                                      shift_cap)
-        base = state["base"] + shift_cap
+        cell_valid, cells = roll_left(cell_valid, cells, shift_cap)
+        base = base + shift_cap
 
-        # 2. place the batch: sort by (key, pane), fold runs, merge cells
+        # 3. place the batch: sort by (key, pane), fold runs, merge cells
         rel = pane - base
         late = ok & (rel < 0)
         ok = ok & (rel >= 0)
@@ -360,59 +456,40 @@ def make_ffat_tb_step(capacity: int, K: int, P_usec: int, R: int, D: int,
         cells = jax.tree.map(merge, cells, partial)
         cell_valid = cell_valid | partial_has
 
-        # 3. fire windows complete under the watermark frontier.  Firing is
-        # additionally capped to windows whose end pane is inside the ring:
-        # if the watermark jumps past the newest data, later windows wait
-        # for the next step (the roll below brings their ends in range) —
-        # this keeps every fired fold exactly over its own panes.
-        j = jnp.arange(MW, dtype=jnp.int64)
-        w = state["win_next"] + j
-        sflag, swin = _sliding_reduce(comb, cell_valid, cells, R, axis=1)
-        end_local = (w * D + R - 1 - base)                     # [MW]
-        fire = ((w * D + R) <= wm_pane) & (end_local < NP)     # [MW] prefix
-        # end_local < 0 happens only when a capacity roll evicted the whole
-        # window (overload); such windows must not fire with pane-0 data
-        emitable = fire & (end_local >= 0)
-        eidx = jnp.clip(end_local, 0, NP - 1).astype(jnp.int32)
-
-        def pick_leaf(a):
-            idx = eidx.reshape(1, MW, *([1] * (a.ndim - 2)))
-            idx = jnp.broadcast_to(idx, (K, MW) + a.shape[2:])
-            return jnp.take_along_axis(a, idx, axis=1)
-        wvals = jax.tree.map(pick_leaf, swin)
-        any_data = jnp.take_along_axis(
-            sflag, jnp.broadcast_to(eidx[None, :], (K, MW)), axis=1)
-        # advance past fully-evicted windows (fire) but never emit them
-        # (emitable): their eidx clips to pane 0, which they do not cover
-        fired = emitable[None, :] & any_data                   # [K, MW]
-
-        n_fired = jnp.sum(fire.astype(jnp.int64))
-        win_next = state["win_next"] + n_fired
-
-        # 4. roll fired windows' dead panes out of the ring
-        shift_fire = jnp.clip(win_next * D - base, 0, NP)
-        cell_valid, cells = roll_left(cell_valid, cells, shift_fire)
-        base = base + shift_fire
+        # 4. pass B: fire what this batch completed under the watermark
+        (cells, cell_valid, base, win_next,
+         fired_b, wvals_b, w_b, n_b) = fire_pass(
+            cells, cell_valid, base, win_next, wm_pane, max_seen)
 
         new_state = {
             "cells": cells,
             "cell_valid": cell_valid,
             "base": base,
             "win_next": win_next,
+            "max_seen": max_seen,
             "n_late": state["n_late"] + jnp.sum(late.astype(jnp.int64)),
             "n_evicted": state["n_evicted"] + evicted,
         }
-        out_ts = (w * D + R) * P_usec - 1                      # end-1 (TB)
+        # outputs: pass A1, A2, then B rows, [K, N_PASSES*MW] flattened
+        all_passes = a_outs + [(fired_b, wvals_b, w_b, n_b)]
+        w2 = jnp.concatenate([p[2] for p in all_passes])
+        fired = jnp.concatenate([p[0] for p in all_passes], axis=1)
+        wvals = jax.tree.map(
+            lambda *leaves: jnp.concatenate(leaves, axis=1),
+            *[p[1] for p in all_passes])
+        NM = N_PASSES * MW
+        out_ts = (w2 * D + R) * P_usec - 1                     # end-1 (TB)
         out = {
             "key": (jnp.broadcast_to(
-                jnp.arange(K, dtype=jnp.int32)[:, None], (K, MW))
+                jnp.arange(K, dtype=jnp.int32)[:, None], (K, NM))
                 + (jnp.int32(kb) if kb is not None else 0)).reshape(-1),
-            "wid": jnp.broadcast_to(w[None, :], (K, MW)).reshape(-1),
+            "wid": jnp.broadcast_to(w2[None, :], (K, NM)).reshape(-1),
             "value": jax.tree.map(
-                lambda a: a.reshape((K * MW,) + a.shape[2:]), wvals),
+                lambda a: a.reshape((K * NM,) + a.shape[2:]), wvals),
         }
+        n_adv = sum(p[3] for p in all_passes)
         return new_state, out, fired.reshape(-1), \
-            jnp.broadcast_to(out_ts[None, :], (K, MW)).reshape(-1)
+            jnp.broadcast_to(out_ts[None, :], (K, NM)).reshape(-1), n_adv
 
     return step
 

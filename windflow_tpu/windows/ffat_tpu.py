@@ -89,11 +89,19 @@ class FfatWindowsTPU(Operator):
         self.R = spec.win_len // self.P
         self.D = spec.slide // self.P
         self.is_tb = spec.win_type == WinType.TB
-        # TB pane ring length: window span plus slack for the time spread of
-        # in-flight batches (tunable via the builder's withPaneCapacity)
+        # TB pane ring contract: the ring must cover the window span, plus
+        # the time spread of any single batch (including idle gaps *inside*
+        # a batch — gaps between batches cost nothing, pre-gap windows fire
+        # before the ring rolls), plus the lateness allowance in panes
+        # (lateness holds windows open, so their panes stay pinned in the
+        # ring).  Exceeding it is overload: panes are evicted and counted
+        # (n_evicted).  Tunable via the builder's withPaneCapacity.
         self.NP = pane_capacity or max(2 * self.R, self.R + 64)
-        if self.is_tb and self.NP < self.R + 1:
-            raise WindFlowError("pane_capacity must exceed win/gcd panes")
+        if self.is_tb and self.NP < 2 * self.R:
+            # >= 2R also guarantees the step's two pre-place fire passes
+            # reach every window over in-ring data (ffat_kernels docstring)
+            raise WindFlowError(
+                "pane_capacity must be at least 2*win/gcd panes")
         self._state = None          # device state, created on first batch
         self._jit_step = None
         self._jit_flush = None
@@ -105,7 +113,11 @@ class FfatWindowsTPU(Operator):
     # -- state layout --------------------------------------------------------
     def _init_state(self, agg_spec):
         if self.mesh is not None:
-            from windflow_tpu.parallel.mesh import make_sharded_ffat_state
+            from windflow_tpu.parallel.mesh import (
+                make_sharded_ffat_state, make_sharded_ffat_tb_state)
+            if self.is_tb:
+                return make_sharded_ffat_tb_state(
+                    agg_spec, self.max_keys, self.NP, self.mesh)
             return make_sharded_ffat_state(agg_spec, self.max_keys, self.R,
                                            self.mesh)
         if self.is_tb:
@@ -118,11 +130,13 @@ class FfatWindowsTPU(Operator):
             # Multi-chip: key-sharded state, data-sharded batches riding an
             # all_gather over ICI (parallel/mesh.py make_sharded_ffat_step).
             # Config.mesh is how the graph API reaches the sharded kernels.
+            from windflow_tpu.parallel.mesh import (make_sharded_ffat_step,
+                                                    make_sharded_ffat_tb_step)
             if self.is_tb:
-                raise WindFlowError(
-                    "FfatWindowsTPU: TB windows on a mesh are not supported "
-                    "yet; use CB windows or run single-chip")
-            from windflow_tpu.parallel.mesh import make_sharded_ffat_step
+                return make_sharded_ffat_tb_step(
+                    self.mesh, capacity, self.max_keys, self.P, self.R,
+                    self.D, self.NP, self.lift, self.comb,
+                    self.key_extractor)
             return make_sharded_ffat_step(
                 self.mesh, capacity, self.max_keys, self.P, self.R, self.D,
                 self.lift, self.comb, self.key_extractor)
@@ -166,7 +180,7 @@ class FfatWindowsTPU(Operator):
             # propagated stamp: the step places every tuple of the batch
             # before firing, so the newest frontier is safe here and saves
             # one batch of firing lag (batch.py DeviceBatch.frontier).
-            self._state, out, fired, out_ts = self._jit_step(
+            self._state, out, fired, out_ts, _ = self._jit_step(
                 self._state, batch.payload, batch.ts, batch.valid,
                 jnp.int64(self._wm_pane(batch.frontier)))
         else:
@@ -193,13 +207,16 @@ class FfatWindowsTPU(Operator):
             invalid = jnp.zeros(cap, bool)
             outs = []
             while True:
-                self._state, out, fired, out_ts = self._jit_step(
+                self._state, out, fired, out_ts, n_adv = self._jit_step(
                     self._state, self._payload_zero, ts0, invalid,
                     jnp.int64(1 << 60))
-                if not bool(np.asarray(fired).any()):
+                if bool(np.asarray(fired).any()):
+                    outs.append(DeviceBatch(out, out_ts, fired, watermark=0,
+                                            size=None))
+                # loop on ADVANCE, not emission: windows beyond an empty gap
+                # in the pane sequence would stall behind a no-emission pass
+                if int(n_adv) == 0:
                     break
-                outs.append(DeviceBatch(out, out_ts, fired, watermark=0,
-                                        size=None))
             return outs
         if self._jit_flush is None:
             self._jit_flush = self._build_flush()
@@ -208,15 +225,17 @@ class FfatWindowsTPU(Operator):
 
     def num_dropped_tuples(self) -> int:
         if self.is_tb and self._state is not None:
-            return int(self._state["n_late"])  # device sync, stats only
+            # device sync, stats only; sum over key shards on a mesh
+            return int(jnp.sum(self._state["n_late"]))
         return 0
 
     def dump_stats(self) -> dict:
         n_late = n_evicted = None
         if self.is_tb and self._state is not None:
-            # one device sync at dump time, never on the step path
-            n_late = int(self._state["n_late"])
-            n_evicted = int(self._state["n_evicted"])
+            # one device sync at dump time, never on the step path;
+            # per-key-shard lanes on a mesh, scalars single-chip
+            n_late = int(jnp.sum(self._state["n_late"]))
+            n_evicted = int(jnp.sum(self._state["n_evicted"]))
             if self.replicas:
                 self.replicas[0].stats.inputs_ignored = n_late
         st = super().dump_stats()
