@@ -44,7 +44,7 @@ from windflow_tpu.ops.filter_op import Filter
 from windflow_tpu.ops.flatmap_op import FlatMap, Shipper
 from windflow_tpu.ops.map_op import Map
 from windflow_tpu.ops.reduce_op import Reduce
-from windflow_tpu.ops.sink import Sink
+from windflow_tpu.ops.sink import Sink, SinkColumns
 from windflow_tpu.ops.source import Source
 from windflow_tpu.ops.tpu import FilterTPU, MapTPU, ReduceTPU
 from windflow_tpu.ops.tpu_stateful import StatefulFilterTPU, StatefulMapTPU
@@ -69,7 +69,7 @@ __all__ = [
     "DeviceBatch", "HostBatch", "Punctuation", "device_to_host",
     "host_to_device", "LocalStorage", "RuntimeContext", "MultiPipe",
     "PipeGraph", "Operator", "Replica", "Source", "Map", "Filter", "FlatMap",
-    "Shipper", "Reduce", "Sink", "MapTPU", "FilterTPU", "ReduceTPU",
+    "Shipper", "Reduce", "Sink", "SinkColumns", "MapTPU", "FilterTPU", "ReduceTPU",
     "StatefulMapTPU", "StatefulFilterTPU",
     "Source_Builder", "Map_Builder", "Filter_Builder", "FlatMap_Builder",
     "Reduce_Builder", "Sink_Builder", "MapTPU_Builder", "FilterTPU_Builder",

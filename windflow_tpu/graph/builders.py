@@ -160,11 +160,25 @@ class Sink_Builder(_BuilderBase):
     def __init__(self, fn: Callable) -> None:
         super().__init__()
         self._fn = fn
+        self._columnar = False
+        self._columnar_defer = 2
+
+    def withColumnarSink(self, defer: int = 2):
+        """Deliver TPU→Sink batches as SoA numpy columns (``SinkColumns``)
+        instead of per-record dicts — one bulk device→host copy, zero
+        per-tuple Python (egress twin of the columnar ingest path).
+        ``defer`` batches are held before conversion so the device→host
+        transfer overlaps later batches' compute (0 = convert eagerly)."""
+        self._columnar = True
+        self._columnar_defer = defer
+        return self
 
     def build(self) -> Sink:
         return Sink(self._fn, name=self._name, parallelism=self._parallelism,
                     routing=self._routing(),
-                    key_extractor=self._key_extractor)
+                    key_extractor=self._key_extractor,
+                    columnar=self._columnar,
+                    columnar_defer=self._columnar_defer)
 
 
 # ---------------------------------------------------------------------------

@@ -69,15 +69,36 @@ class FrameSourceReplica(BaseSourceReplica):
         if n == 0:
             return
         if self.time_policy == TimePolicy.INGRESS:
-            base = max(current_time_usecs(), self._last_ts + 1)
-            tss = base + np.arange(n, dtype=np.int64)
+            # every record of the chunk arrived with the chunk: one arrival
+            # stamp (monotone vs earlier chunks), not a synthetic +arange
+            # ramp that would place timestamps in the wall-clock future
+            base = max(current_time_usecs(), self._last_ts)
+            tss = np.full(n, base, dtype=np.int64)
+            row_wms = tss
+        else:
+            # per-row frontier: running max event ts (reference
+            # Source_Shipper advances the watermark per tuple) — lets the
+            # staging emitter stamp batches that split this chunk exactly
+            row_wms = np.maximum(np.maximum.accumulate(tss),
+                                 max(self._last_ts, 0))
         self._last_ts = max(self._last_ts, int(tss.max()))
         self._advance_wm(self._last_ts)
         self.stats.outputs_sent += n
-        cols = {"key": keys.astype(np.int64)}
+        # int32 keys on device when they fit: every keyed device operator
+        # interns int32 keys (KeyedDeviceStageEmitter._key32), so staging
+        # the full int64 wire key usually doubles the lane's bytes for no
+        # extra key space — but keys outside int32 (e.g. 64-bit hash ids)
+        # keep their width so host-side consumers never see collisions
+        keys = keys.astype(np.int64)
+        if len(keys) and np.int32(keys.max() >> 31) == (keys.min() >> 31)                 and -(1 << 31) <= keys.min() and keys.max() < (1 << 31):
+            keys = keys.astype(np.int32)
+        cols = {"key": keys}
+        vd = self.op.value_dtype
         for i, name in enumerate(self.op.fields):
-            cols[name] = np.ascontiguousarray(vals[:, i])
-        self.emitter.emit_columns(cols, tss, self.current_wm)
+            cols[name] = np.ascontiguousarray(vals[:, i].astype(vd,
+                                                                copy=False))
+        self.emitter.emit_columns(cols, tss, self.current_wm,
+                                  row_wms=row_wms)
         self._count_toward_punctuation(n)
 
 
@@ -87,14 +108,21 @@ class FrameSource(Source):
     ``chunks_fn`` (optionally taking a RuntimeContext) yields ``bytes``
     objects; records may span chunk boundaries (the remainder is carried).
     ``fields`` names the ``nv`` float64 value columns; records surface
-    downstream as ``{"key": int, <field>: float, ...}``."""
+    downstream as ``{"key": int, <field>: float, ...}``.
+
+    TPU-first dtype policy: value columns are staged as **float32** by
+    default even though the wire format is float64 — the TPU has no native
+    f64 (XLA emulates it with 32-bit pairs at several times the cost) and
+    f32 halves the staged bytes.  Pass ``value_dtype=np.float64`` for full
+    wire precision; keys keep int64 whenever they don't fit int32."""
 
     replica_class = FrameSourceReplica
 
     def __init__(self, chunks_fn: Callable[..., Iterable[bytes]],
                  nv: int = 1, fields: Optional[List[str]] = None,
                  fmt: str = "frames", name: str = "frame_source",
-                 parallelism: int = 1, output_batch_size: int = 0) -> None:
+                 parallelism: int = 1, output_batch_size: int = 0,
+                 value_dtype=np.float32) -> None:
         if fmt not in ("frames", "csv"):
             raise WindFlowError(f"unknown frame format '{fmt}'")
         if fields is not None and len(fields) != nv:
@@ -105,4 +133,8 @@ class FrameSource(Source):
         self.nv = nv
         self.fields = fields or [f"v{i}" for i in range(nv)]
         self.fmt = fmt
+        #: device dtype for value columns.  float32 by default — the wire
+        #: format is float64, but the TPU has no native f64 (XLA emulates
+        #: it with 32-bit pairs); pass np.float64 to keep full precision.
+        self.value_dtype = np.dtype(value_dtype)
         self.ts_extractor = None

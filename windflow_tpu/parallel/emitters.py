@@ -114,16 +114,21 @@ class Emitter:
             self.emit(item, ts, hb.watermark, hb.shared)
 
     # -- columnar interface (bulk sources, windflow_tpu/io) -----------------
-    def emit_columns(self, cols, tss, wm: int) -> None:
-        """Emit a block of tuples given as SoA numpy columns.  The default
-        explodes to per-tuple records (host destinations care about items,
-        not layout); the device staging emitter overrides this with a
-        zero-per-tuple path."""
+    def emit_columns(self, cols, tss, wm: int, row_wms=None) -> None:
+        """Emit a block of tuples given as SoA numpy columns.  ``wm`` is the
+        frontier after the block's LAST row; ``row_wms`` (optional int64
+        [n]) is the frontier after EACH row — sources that know it (e.g. a
+        cumulative max of event timestamps) let the staging emitter stamp
+        batches that split the block exactly instead of conservatively.
+        The default explodes to per-tuple records (host destinations care
+        about items, not layout); the device staging emitter overrides this
+        with a zero-per-tuple path."""
         names = list(cols)
         arrs = [cols[n] for n in names]
         for i in range(len(tss)):
             item = {n: a[i].item() for n, a in zip(names, arrs)}
-            self.emit(item, int(tss[i]), wm)
+            self.emit(item, int(tss[i]),
+                      int(row_wms[i]) if row_wms is not None else wm)
 
     def propagate_punctuation(self, wm: int) -> None:
         """Flush open batches, then multicast a watermark punctuation
@@ -303,10 +308,16 @@ class DeviceStageEmitter(Emitter):
         # _OpenBatch and DeviceBatch.frontier for why the propagated
         # watermark stays min-folded.
         self._frontier = WM_NONE
-        # columnar accumulation: list of (cols dict, tss) chunks + row count
+        # Columnar accumulation: list of (cols dict, tss, per-row-wm)
+        # chunks + row count.  A chunk-level watermark is only valid after
+        # the chunk's LAST row — stamping a head batch of a split chunk
+        # with it would let downstream time windows fire ahead of the
+        # chunk's still-buffered tail rows and drop them as late.  So each
+        # chunk is kept with a per-row frontier lane (given by the source,
+        # or synthesized as last-row-only), and a staged batch is stamped
+        # with the running max at ITS last row.
         self._col_chunks = []
         self._col_rows = 0
-        self._col_wm = WM_NONE
         # Multi-chip: lay staged batch lanes out data-sharded over the mesh
         # so downstream sharded programs consume them without a reshard
         # (parallel/mesh.py batch_sharding).
@@ -331,39 +342,40 @@ class DeviceStageEmitter(Emitter):
         if len(self._ob.items) >= self.output_batch_size:
             self.flush(wm)
 
-    def emit_columns(self, cols, tss, wm):
+    def emit_columns(self, cols, tss, wm, row_wms=None):
         """Columnar fast path: accumulate SoA chunks, stage full batches with
         one concatenate + one transfer (reference pinned staging without the
-        per-tuple fill loop, ``forward_emitter_gpu.hpp:254-300``)."""
-        self._advance_frontier(wm)
-        self._col_chunks.append((cols, tss))
+        per-tuple fill loop, ``forward_emitter_gpu.hpp:254-300``).  See the
+        ``_col_chunks`` note for the watermark lane."""
+        if row_wms is None:
+            # chunk-level wm: valid only after the last row
+            row_wms = np.full(len(tss), WM_NONE, np.int64)
+            if len(tss) and wm != WM_NONE:
+                row_wms[-1] = wm
+        self._col_chunks.append((cols, tss, row_wms))
         self._col_rows += len(tss)
-        # min-fold, as _OpenBatch.add (each chunk's wm covers its rows)
-        if wm != WM_NONE:
-            self._col_wm = (wm if self._col_wm == WM_NONE
-                            else min(self._col_wm, wm))
         cap = self.output_batch_size
-        if self._col_rows >= cap:
-            names = list(self._col_chunks[0][0])
-            cat = {n: _concat([c[0][n] for c in self._col_chunks])
-                   for n in names}
-            tcat = _concat([c[1] for c in self._col_chunks])
-            total = len(tcat)
-            for lo in range(0, total - total % cap, cap):
-                self._stage_columns(
-                    {n: a[lo:lo + cap] for n, a in cat.items()},
-                    tcat[lo:lo + cap], self._col_wm)
-            rem = total % cap
-            self._col_chunks = [] if rem == 0 else [
-                ({n: a[total - rem:] for n, a in cat.items()},
-                 tcat[total - rem:])]
-            self._col_rows = rem
-            # Remaining rows are the tail of the newest chunk: re-stamp with
-            # its wm, but never discard a known frontier for WM_NONE.
-            if rem == 0:
-                self._col_wm = WM_NONE
-            elif wm != WM_NONE:
-                self._col_wm = wm
+        if self._col_rows < cap:
+            return
+        names = list(self._col_chunks[0][0])
+        cat = {n: _concat([c[0][n] for c in self._col_chunks])
+               for n in names}
+        tcat = _concat([c[1] for c in self._col_chunks])
+        wcat = np.maximum.accumulate(
+            _concat([c[2] for c in self._col_chunks]))
+        total = len(tcat)
+        for lo in range(0, total - total % cap, cap):
+            hi = lo + cap
+            bwm = int(wcat[hi - 1])
+            self._advance_frontier(bwm)
+            self._stage_columns(
+                {n: a[lo:lo + cap] for n, a in cat.items()},
+                tcat[lo:lo + cap], bwm)
+        rem = total % cap
+        self._col_chunks = [] if rem == 0 else [
+            ({n: a[total - rem:] for n, a in cat.items()},
+             tcat[total - rem:], wcat[total - rem:])]
+        self._col_rows = rem
 
     def _stage_columns(self, cols, tss, wm):
         db = columns_to_device(cols, tss, self.output_batch_size,
@@ -374,17 +386,19 @@ class DeviceStageEmitter(Emitter):
         self._send(d, db)
 
     def flush(self, wm):
-        self._advance_frontier(wm)
         if self._col_chunks:
             names = list(self._col_chunks[0][0])
             cat = {n: _concat([c[0][n] for c in self._col_chunks])
                    for n in names}
             tcat = _concat([c[1] for c in self._col_chunks])
+            # everything buffered is fully staged by this batch, so the
+            # newest row frontier applies
+            w = int(max(int(c[2].max()) for c in self._col_chunks))
             self._col_chunks = []
             self._col_rows = 0
-            w = self._col_wm if self._col_wm != WM_NONE else wm
-            self._col_wm = WM_NONE
-            self._stage_columns(cat, tcat, w)
+            self._advance_frontier(w)
+            self._stage_columns(cat, tcat, w if w != WM_NONE else wm)
+        self._advance_frontier(wm)
         if not self._ob.items:
             return
         hb = HostBatch(self._ob.items, self._ob.tss, self._ob.wm)
@@ -426,7 +440,7 @@ class KeyedDeviceStageEmitter(Emitter):
         d = self._key32(self.key_extractor(item)) % len(self.dests)
         self._inner[d].emit(item, ts, wm)
 
-    def emit_columns(self, cols, tss, wm):
+    def emit_columns(self, cols, tss, wm, row_wms=None):
         n = len(self.dests)
         dest = None
         try:
@@ -449,8 +463,12 @@ class KeyedDeviceStageEmitter(Emitter):
         for d in range(n):
             idx = np.nonzero(dest == d)[0]
             if len(idx):
+                # the row frontier is global (covers rows of every
+                # partition up to that point), so slicing it per partition
+                # keeps each channel's stamps valid
                 self._inner[d].emit_columns(
-                    {k: v[idx] for k, v in cols.items()}, tss[idx], wm)
+                    {k: v[idx] for k, v in cols.items()}, tss[idx], wm,
+                    row_wms[idx] if row_wms is not None else None)
 
     def emit_device_batch(self, batch):
         raise WindFlowError(
@@ -592,6 +610,12 @@ def create_emitter(routing: RoutingMode,
             return DevicePassEmitter(dests, routing)
         return DeviceStageEmitter(dests, output_batch_size, mesh=mesh)
     # host destination
+    if src_is_tpu and routing != RoutingMode.KEYBY and dests \
+            and all(getattr(r.op, "columnar", False) for r, _ in dests):
+        # Columnar sinks consume DeviceBatches whole (bulk D2H inside the
+        # sink replica, zero per-tuple Python); keyed columnar sinks still
+        # need per-key routing and take the record path below.
+        return DevicePassEmitter(dests, routing)
     if routing == RoutingMode.KEYBY:
         inner = KeyByEmitter(dests, output_batch_size, key_extractor)
     elif routing == RoutingMode.BROADCAST:

@@ -121,9 +121,21 @@ def make_ffat_step(capacity: int, K: int, P: int, R: int, D: int,
     key base: when ``key_base_fn`` is given, raw keys are rebased by its traced
     value, so a chip owning keys ``[base, base+K)`` sees them as ``[0, K)`` and
     out-of-range keys are masked out (the dense-key sharding answer to the
-    reference's per-key device state, ``ffat_replica_gpu.hpp:438-514``)."""
+    reference's per-key device state, ``ffat_replica_gpu.hpp:438-514``).
+
+    The output batch is COMPACTED on device: the worst case for ONE key is
+    the whole batch (``capacity/(P*D)`` windows), but the *total* windows a
+    batch can fire across all keys has the same bound (plus a per-key
+    partial), so the egress batch is ``MAXO ~ capacity/(P*D) + 2K`` rows
+    where a dense per-key grid would hold millions.  Firing is a per-key
+    prefix of window ids, so compaction is pure index arithmetic — a K-long
+    running sum + searchsorted — never a dense-grid scatter (a dense-grid
+    device→host copy per step would dominate any end-to-end pipeline; the
+    reference's ``numWinsPerBatch`` output buffer is likewise sized to
+    fired windows, not the worst case, ``flatfat_gpu.hpp:60-139``)."""
     NP1 = capacity // P + 2           # pane cells incl. continuation cell
-    MW = (capacity // P) // D + 2     # max windows fired per batch
+    # total fired across all keys: sum_k panes_k/D + per-key partials
+    MAXO = capacity // (P * D) + 2 * K + 8
 
     def step(state, payload, ts, valid):
         B = capacity
@@ -192,28 +204,18 @@ def make_ffat_step(capacity: int, K: int, P: int, R: int, D: int,
         full_valid = jnp.concatenate([state["carry_valid"], pane_valid],
                                      axis=1)
 
-        # fire windows: end panes e = win_next + j*D while e <= done
+        # fire windows: key k fires ends e = win_next[k] + j*D while
+        # e <= done[k] — a per-key PREFIX, so no dense [K, MW] firing grid
+        # is ever needed: per-key counts + a searchsorted over their running
+        # sum enumerate the fired (key, window) pairs directly in compacted
+        # order.  The sliding fold (log2(R) dilated combines over the
+        # [K, R-1+NP1] pane sequence) stays dense; window values are
+        # gathered only at the MAXO compacted output slots.
         done = state["pane_base"] + m_k
-        j = jnp.arange(MW, dtype=jnp.int64)
-        e = state["win_next"][:, None] + j[None, :] * D        # [K, MW]
-        fired = e <= done[:, None]
-        local_end = (e - state["pane_base"][:, None]
-                     + (R - 1)).astype(jnp.int32)              # exclusive
-        # sliding fold of R consecutive panes (log2(R) dilated combines over
-        # the [K, R-1+NP1] pane sequence), then one [K, MW] gather of the
-        # fired window ends — never materializes a [K, MW, R] panes tensor
         _, swin = _sliding_reduce(comb, full_valid, full, R, axis=1)
-        widx = jnp.clip(local_end - 1, 0, R - 1 + NP1 - 1)     # [K, MW]
 
-        def pick_leaf(a):
-            idx = widx.reshape(K, MW, *([1] * (a.ndim - 2)))
-            idx = jnp.broadcast_to(idx, (K, MW) + a.shape[2:])
-            return jnp.take_along_axis(a, idx, axis=1)
-        wvals = jax.tree.map(pick_leaf, swin)
-
-        n_fired = jnp.where(
-            fired[:, 0],
-            ((done - state["win_next"]) // D + 1), 0)
+        n_fired = jnp.maximum(
+            jnp.int64(0), (done - state["win_next"]) // D + 1)
         new_win_next = state["win_next"] + n_fired * D
 
         # new carry: panes [pane_base+m_k-(R-1), pane_base+m_k)
@@ -242,21 +244,31 @@ def make_ffat_step(capacity: int, K: int, P: int, R: int, D: int,
             "win_next": new_win_next,
         }
 
-        # output batch: one row per (key, window-slot)
-        wid = (e - R) // D
-        out_keys = jnp.broadcast_to(
-            jnp.arange(K, dtype=jnp.int32)[:, None], (K, MW))
-        if kb is not None:
-            out_keys = out_keys + jnp.int32(kb)
-        out_ts = jnp.broadcast_to(
-            jnp.max(jnp.where(valid, ts, 0)), (K, MW))
+        # output batch (see docstring): compacted slot i belongs to the key
+        # whose fired-count running sum first exceeds i; everything else is
+        # per-slot arithmetic + one gather from the sliding fold.
+        offs = jnp.cumsum(n_fired)                             # [K]
+        n_out = offs[K - 1]
+        i_slot = jnp.arange(MAXO, dtype=jnp.int64)
+        k_out = jnp.searchsorted(offs, i_slot, side="right") \
+            .astype(jnp.int32)                                 # [MAXO]
+        k_c = jnp.minimum(k_out, K - 1)
+        j_out = i_slot - (offs[k_c] - n_fired[k_c])            # rank in key
+        e_out = state["win_next"][k_c] + j_out * D
+        # window value: sliding-fold cell at the window's end pane
+        widx_out = jnp.clip(
+            (e_out - state["pane_base"][k_c] + (R - 2)).astype(jnp.int32),
+            0, R - 1 + NP1 - 1)                                # [MAXO]
+        wvals_out = jax.tree.map(lambda a: a[k_c, widx_out], swin)
         out = {
-            "key": out_keys.reshape(-1),
-            "wid": wid.reshape(-1),
-            "value": jax.tree.map(
-                lambda a: a.reshape((K * MW,) + a.shape[2:]), wvals),
+            "key": k_c + (jnp.int32(kb) if kb is not None else 0),
+            "wid": (e_out - R) // D,
+            "value": wvals_out,
         }
-        return new_state, out, fired.reshape(-1), out_ts.reshape(-1)
+        out_valid = i_slot < n_out
+        batch_ts = jnp.max(jnp.where(valid, ts, 0))
+        out_ts = jnp.where(out_valid, batch_ts, 0)
+        return new_state, out, out_valid, out_ts
 
     return step
 
