@@ -66,7 +66,7 @@ def test_ffat_tpu_cb_on_mesh():
 
     assert (acc["count"], acc["total"]) == exp
     # the window state must actually live key-sharded on the mesh
-    assert op._state["cur"].sharding.spec == P(KEY_AXIS)
+    assert op._states[0]["cur"].sharding.spec == P(KEY_AXIS)
 
 
 def test_ffat_tpu_tb_on_mesh():
@@ -109,8 +109,8 @@ def test_ffat_tpu_tb_on_mesh():
 
     assert got == exp
     # pane rings and per-shard clocks must actually live key-sharded
-    assert op._state["cells"].sharding.spec == P(KEY_AXIS)
-    assert op._state["base"].sharding.spec == P(KEY_AXIS)
+    assert op._states[0]["cells"].sharding.spec == P(KEY_AXIS)
+    assert op._states[0]["base"].sharding.spec == P(KEY_AXIS)
     st = op.dump_stats()
     assert st["Late_tuples_dropped"] == 0
 
@@ -194,3 +194,61 @@ def test_mesh_requires_divisible_batch():
         .add_sink(wf.Sink_Builder(lambda r: None).build())
     with pytest.raises(wf.WindFlowError, match="not divisible"):
         g.run()
+
+
+def test_keyed_reduce_tpu_on_mesh_arbitrary_keys():
+    """Keyed mesh Reduce WITHOUT withMaxKeys: keys from the full int32
+    range (negative, huge) hash-shard to their owner chip over an
+    all_to_all; nothing is dropped and per-key totals are exact
+    (VERDICT r2 item 5; reference reduce_gpu.hpp:227-258)."""
+    import numpy as np
+    rnd = np.random.default_rng(9)
+    raw_keys = rnd.integers(-2**31, 2**31, 37).astype(np.int64)
+    items = [{"key": int(raw_keys[i % len(raw_keys)]), "value": i}
+             for i in range(LENGTH)]
+
+    acc = {}
+    src = (wf.Source_Builder(lambda: iter(items))
+           .withOutputBatchSize(64).build())
+    op = (wf.ReduceTPU_Builder(
+            lambda a, b: {"key": b["key"], "value": a["value"] + b["value"]})
+          .withKeyBy(lambda t: t["key"]).build())   # NO withMaxKeys
+    snk = wf.Sink_Builder(
+        lambda r: acc.__setitem__(int(r["key"]),
+                                  acc.get(int(r["key"]), 0)
+                                  + int(r["value"]))
+        if r is not None else None).build()
+    g = wf.PipeGraph("red_mesh_arb", config=_mesh_cfg())
+    g.add_source(src).add(op).add_sink(snk)
+    g.run()
+
+    exp = {}
+    for t in items:
+        k = np.int32(t["key"] & 0xFFFFFFFF).item() \
+            if t["key"] >= 2**31 else t["key"]
+        exp[k] = exp.get(k, 0) + t["value"]
+    assert acc == exp
+    assert op.num_dropped_tuples() == 0
+
+
+def test_mesh_arbitrary_keys_int32_max_not_dropped():
+    """A genuine key of INT32_MAX must not be mistaken for the reduce's
+    invalid-lane sentinel and silently dropped (the sort lane is int64 with
+    an out-of-range sentinel)."""
+    items = [{"key": 2**31 - 1, "value": i} for i in range(64)]
+    acc = {}
+    src = (wf.Source_Builder(lambda: iter(items))
+           .withOutputBatchSize(64).build())
+    op = (wf.ReduceTPU_Builder(
+            lambda a, b: {"key": b["key"], "value": a["value"] + b["value"]})
+          .withKeyBy(lambda t: t["key"]).build())
+    snk = wf.Sink_Builder(
+        lambda r: acc.__setitem__(int(r["key"]),
+                                  acc.get(int(r["key"]), 0)
+                                  + int(r["value"]))
+        if r is not None else None).build()
+    g = wf.PipeGraph("red_mesh_maxkey", config=_mesh_cfg())
+    g.add_source(src).add(op).add_sink(snk)
+    g.run()
+    assert acc == {2**31 - 1: sum(range(64))}
+    assert op.num_dropped_tuples() == 0

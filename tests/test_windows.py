@@ -539,3 +539,80 @@ def test_ffat_tpu_tb_late_drops_counted():
     on_time_ok = sum(1 for kk, v in exp_on_time.items()
                      if got.get(kk) == v)
     assert on_time_ok > 0.8 * len(exp_on_time)
+
+
+def test_ffat_tpu_tb_overflow_policies():
+    """TB ring overflow (one batch spanning far more panes than the ring):
+    'drop' (default) suppresses windows that lost data and counts them —
+    every window that IS emitted is exact; 'count' fires them over surviving
+    panes (wrong aggregates, evictions counted); 'error' raises."""
+    P = 4_000
+    items = [{"key": 0, "value": i, "ts": i * P} for i in range(40)]
+    exp = _oracle_tb_items(items, TWIN, TSLIDE)   # R=4, D=1
+
+    def run(policy):
+        # lateness of 60 panes >> the 8-pane ring pins windows open while
+        # data keeps arriving: the capacity roll must evict unfired data
+        got = {}
+        src = (wf.Source_Builder(lambda: iter(items))
+               .withTimestampExtractor(lambda t: t["ts"])
+               .withOutputBatchSize(8).build())
+        op = (wf.Ffat_WindowsTPU_Builder(lambda t: t["value"],
+                                         lambda a, b: a + b)
+              .withTBWindows(TWIN, TSLIDE).withKeyBy(lambda t: t["key"])
+              .withMaxKeys(1).withPaneCapacity(8).withLateness(240_000)
+              .withOverflowPolicy(policy).build())
+        snk = wf.Sink_Builder(
+            lambda r: got.__setitem__((r["key"], r["wid"]), r["value"])
+            if r is not None else None).build()
+        g = wf.PipeGraph("tb_overflow", wf.ExecutionMode.DEFAULT,
+                         wf.TimePolicy.EVENT)
+        g.add_source(src).add(op).add_sink(snk)
+        g.run()
+        return got, op.dump_stats()
+
+    got, st = run("drop")
+    assert st["Pane_cells_evicted"] > 0
+    assert st["Windows_dropped_on_overflow"] > 0
+    assert all(exp[kw] == v for kw, v in got.items())   # emitted => exact
+    assert len(got) < len(exp)                          # some suppressed
+
+    got_c, st_c = run("count")
+    assert st_c["Pane_cells_evicted"] > 0
+    assert st_c["Windows_dropped_on_overflow"] == 0
+    assert any(exp.get(kw) != v for kw, v in got_c.items())  # wrong fires
+
+    import pytest
+    with pytest.raises(wf.WindFlowError, match="overflow"):
+        run("error")
+
+
+def test_ffat_tpu_tb_forward_parallelism_shares_state():
+    """Non-keyed (FORWARD-routed) TB windows at parallelism > 1: batches
+    round-robin over replicas into ONE shared state — every window fires
+    exactly once with its full aggregate (per-replica rings would fire each
+    window once per replica with partial sums)."""
+    items = [{"value": i, "ts": i * 1000} for i in range(60)]
+    got = {}
+    src = (wf.Source_Builder(lambda: iter(items))
+           .withTimestampExtractor(lambda t: t["ts"])
+           .withOutputBatchSize(5).build())
+    op = (wf.Ffat_WindowsTPU_Builder(lambda t: t["value"],
+                                     lambda a, b: a + b)
+          .withTBWindows(8_000, 8_000).withMaxKeys(1)
+          .withParallelism(2).build())
+    def sink(r):
+        if r is None:
+            return
+        assert (r["key"], r["wid"]) not in got, "window fired twice"
+        got[(r["key"], r["wid"])] = r["value"]
+    snk = wf.Sink_Builder(sink).build()
+    g = wf.PipeGraph("tb_fwd_par", wf.ExecutionMode.DEFAULT,
+                     wf.TimePolicy.EVENT)
+    g.add_source(src).add(op).add_sink(snk)
+    g.run()
+    exp = {}
+    for t in items:
+        w = t["ts"] // 8_000
+        exp[(0, w)] = exp.get((0, w), 0) + t["value"]
+    assert got == exp

@@ -494,6 +494,7 @@ class Ffat_WindowsTPU_Builder(_WindowBuilderBase):
         self._comb = comb_fn
         self._max_keys = 1
         self._pane_capacity = None
+        self._overflow_policy = "drop"
 
     def withMaxKeys(self, n: int):
         """Size of the dense device key space [0, n)."""
@@ -507,10 +508,20 @@ class Ffat_WindowsTPU_Builder(_WindowBuilderBase):
         self._pane_capacity = int(n)
         return self
 
+    def withOverflowPolicy(self, policy: str):
+        """TB ring-overflow behavior: ``"drop"`` (default — suppress windows
+        that lost data panes, count them in Windows_dropped_on_overflow),
+        ``"count"`` (fire them over surviving panes only; wrong aggregates,
+        surfaced via Pane_cells_evicted), or ``"error"`` (raise at the next
+        host checkpoint)."""
+        self._overflow_policy = policy
+        return self
+
     def build(self) -> FfatWindowsTPU:
         return FfatWindowsTPU(
             self._lift, self._comb, self._spec(), max_keys=self._max_keys,
             name=self._name,
             parallelism=self._parallelism,
             key_extractor=self._key_extractor,
-            pane_capacity=self._pane_capacity)
+            pane_capacity=self._pane_capacity,
+            overflow_policy=self._overflow_policy)

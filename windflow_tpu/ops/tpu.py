@@ -135,11 +135,14 @@ def _segmented_reduce(keys, payload, ts, valid, comb, capacity):
     ``Extract_Keys_Kernel`` → ``thrust::sort_by_key`` → ``thrust::reduce_by_key``
     pipeline (``reduce_gpu.hpp:227-258``).
 
-    Invalid lanes get a sentinel key so they sort behind every real segment.
-    Returns (distinct_keys, combined_payload, seg_ts, out_valid) with the
-    distinct-key results left-compacted to the front of the batch."""
-    sentinel = jnp.int32(2**31 - 1)
-    skeys = jnp.where(valid, keys, sentinel)
+    Invalid lanes get a sentinel sort key so they sort behind every real
+    segment; the sort lane is int64 so the sentinel lies OUTSIDE the int32
+    key space (an actual key of INT32_MAX must not be mistaken for padding
+    and dropped).  Returns (distinct_keys, combined_payload, seg_ts,
+    out_valid) with the distinct-key results left-compacted to the front of
+    the batch."""
+    sentinel = jnp.int64(1) << 32
+    skeys = jnp.where(valid, keys.astype(jnp.int64), sentinel)
     order = jnp.argsort(skeys)
     skeys = skeys[order]
     spayload = jax.tree.map(lambda a: a[order], payload)
@@ -252,16 +255,21 @@ class ReduceTPU(Operator):
     def _get_sharded_step(self, capacity: int):
         step = self._jit_steps.get(("mesh", capacity))
         if step is None:
-            from windflow_tpu.parallel.mesh import make_sharded_reduce_step
+            from windflow_tpu.parallel.mesh import (
+                make_sharded_reduce_arbitrary, make_sharded_reduce_step)
             K = self.max_keys if self.key_extractor is not None else 1
             if K is None:
-                raise WindFlowError(
-                    "keyed ReduceTPU on a mesh needs max_keys (the dense "
-                    "cross-chip partial tables are [max_keys] wide); set "
-                    "ReduceTPU_Builder.withMaxKeys")
-            step = make_sharded_reduce_step(self.mesh, capacity, K,
-                                            self.comb, self.key_extractor,
-                                            use_psum=self.sum_like)
+                # Arbitrary int32 keys: hash-shard lanes to their owner
+                # chip with one all_to_all, then per-chip sort/reduce — no
+                # dense table bound, nothing dropped (reference
+                # reduce_gpu.hpp:227-258 arbitrary-key path).  withMaxKeys
+                # remains the faster dense/psum variant for bounded keys.
+                step = make_sharded_reduce_arbitrary(
+                    self.mesh, capacity, self.comb, self.key_extractor)
+            else:
+                step = make_sharded_reduce_step(
+                    self.mesh, capacity, K, self.comb, self.key_extractor,
+                    use_psum=self.sum_like)
             self._jit_steps[("mesh", capacity)] = step
         return step
 

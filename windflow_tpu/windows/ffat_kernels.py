@@ -290,15 +290,21 @@ def make_ffat_tb_state(agg_spec, K: int, NP: int):
         # newest data pane ever placed: windows starting beyond it can never
         # emit, so firing never advances past it (bounds EOS flush loops)
         "max_seen": jnp.full((), -(1 << 60), jnp.int64),
+        # per-key overflow taint: one past the newest DATA pane evicted by a
+        # capacity roll before its windows fired; windows starting below it
+        # lost data (the drop-window overflow policy suppresses them)
+        "horizon": jnp.full((K,), -(1 << 60), jnp.int64),
         "n_late": jnp.zeros((), jnp.int64),    # dropped late tuples
         "n_evicted": jnp.zeros((), jnp.int64),  # pane cells lost to overflow
+        "n_win_dropped": jnp.zeros((), jnp.int64),  # windows suppressed
     }
 
 
 def make_ffat_tb_step(capacity: int, K: int, P_usec: int, R: int, D: int,
                       NP: int, lift: Callable, comb: Callable,
                       key_fn: Optional[Callable],
-                      key_base_fn: Optional[Callable[[], Any]] = None):
+                      key_base_fn: Optional[Callable[[], Any]] = None,
+                      drop_tainted: bool = False):
     """Time-based FFAT per-batch program.
 
     Window ``w`` covers panes ``[w*D, w*D + R)`` — times
@@ -331,6 +337,12 @@ def make_ffat_tb_step(capacity: int, K: int, P_usec: int, R: int, D: int,
     counts windows passed (fired or skipped-as-evicted) so drivers can loop
     EOS/catch-up flushes until the frontier genuinely stops moving (windows
     beyond an empty gap would otherwise stall behind a no-emission pass).
+
+    ``drop_tainted`` (the drop-window overflow policy): windows whose span
+    lost a DATA pane to a capacity-roll eviction are suppressed instead of
+    firing a wrong partial aggregate; every suppression increments
+    ``n_win_dropped``.  The reference never fires a wrong window — it
+    grows/blocks instead — so wrong-but-counted is opt-in (``count``).
     """
     MW = NP // D + 2
     N_PASSES = 3                     # A1, A2 (pre-place), B (post-place)
@@ -344,7 +356,8 @@ def make_ffat_tb_step(capacity: int, K: int, P_usec: int, R: int, D: int,
         v = jax.tree.map(lambda a: jnp.take(a, idxc, axis=1), values)
         return f, v
 
-    def fire_pass(cells, cell_valid, base, win_next, frontier, max_seen):
+    def fire_pass(cells, cell_valid, base, win_next, frontier, max_seen,
+                  horizon):
         """Fire windows ending <= frontier whose end pane is inside the
         ring; returns the rolled ring + firing outputs.  Firing is capped to
         in-ring ends: if the frontier outruns the ring, later windows wait
@@ -380,19 +393,32 @@ def make_ffat_tb_step(capacity: int, K: int, P_usec: int, R: int, D: int,
                 sflag, jnp.broadcast_to(eidx[None, :], (K, MW)), axis=1)
             # advance past fully-evicted windows (fire) but never emit them
             # (emitable): their eidx clips to pane 0, which they don't cover
-            return emitable[None, :] & any_data, wvals
+            f = emitable[None, :] & any_data
+            n_drop = jnp.zeros((), jnp.int64)
+            if drop_tainted:
+                # suppress windows whose span lost data to an eviction;
+                # count them per tainted key — including windows whose
+                # WHOLE span was evicted (fire & ~emitable), which can
+                # never emit but did lose that key's data
+                clean = (w * D)[None, :] >= horizon[:, None]
+                gone = (fire & ~emitable)[None, :] & ~clean
+                n_drop = jnp.sum((f & ~clean).astype(jnp.int64)) \
+                    + jnp.sum(gone.astype(jnp.int64))
+                f = f & clean
+            return f, wvals, n_drop
 
         def no_fold(_):
             zvals = jax.tree.map(
                 lambda a: jnp.zeros((K, MW) + a.shape[2:], a.dtype), cells)
-            return jnp.zeros((K, MW), bool), zvals
+            return jnp.zeros((K, MW), bool), zvals, jnp.zeros((), jnp.int64)
 
-        fired, wvals = jax.lax.cond(n_fired > 0, do_fold, no_fold, None)
+        fired, wvals, n_drop = jax.lax.cond(n_fired > 0, do_fold, no_fold,
+                                            None)
         new_next = win_next + n_fired
         shift = jnp.clip(new_next * D - base, 0, NP)
         cell_valid, cells = roll_left(cell_valid, cells, shift)
         return (cells, cell_valid, base + shift, new_next,
-                fired, wvals, w, n_fired)
+                fired, wvals, w, n_fired, n_drop)
 
     def step(state, payload, ts, valid, wm_pane):
         B = capacity
@@ -418,22 +444,28 @@ def make_ffat_tb_step(capacity: int, K: int, P_usec: int, R: int, D: int,
             state["cells"], state["cell_valid"], state["base"],
             state["win_next"])
         a_outs = []
+        n_win_dropped = state["n_win_dropped"]
         for _ in range(2):
             (cells, cell_valid, base, win_next,
-             fired_i, wvals_i, w_i, n_i) = fire_pass(
+             fired_i, wvals_i, w_i, n_i, nd_i) = fire_pass(
                 cells, cell_valid, base, win_next, frontier_a,
-                state["max_seen"])
+                state["max_seen"], state["horizon"])
             a_outs.append((fired_i, wvals_i, w_i, n_i))
+            n_win_dropped = n_win_dropped + nd_i
 
         # 2. capacity roll: make room for this batch's newest pane
         max_pane = jnp.max(jnp.where(ok, pane, base))
         max_seen = jnp.maximum(state["max_seen"],
                                jnp.max(jnp.where(ok, pane, -(1 << 60))))
         shift_cap = jnp.maximum(jnp.int64(0), max_pane - base - (NP - 1))
-        evicted = jnp.sum(
-            (cell_valid
-             & (jnp.arange(NP, dtype=jnp.int64)[None, :] < shift_cap))
-            .astype(jnp.int64))
+        col = jnp.arange(NP, dtype=jnp.int64)[None, :]
+        evict_mask = cell_valid & (col < shift_cap)
+        evicted = jnp.sum(evict_mask.astype(jnp.int64))
+        # per-key taint horizon: one past the newest data pane lost here
+        horizon = jnp.maximum(
+            state["horizon"],
+            jnp.max(jnp.where(evict_mask, base + col + 1, -(1 << 60)),
+                    axis=1))
         cell_valid, cells = roll_left(cell_valid, cells, shift_cap)
         base = base + shift_cap
 
@@ -470,8 +502,9 @@ def make_ffat_tb_step(capacity: int, K: int, P_usec: int, R: int, D: int,
 
         # 4. pass B: fire what this batch completed under the watermark
         (cells, cell_valid, base, win_next,
-         fired_b, wvals_b, w_b, n_b) = fire_pass(
-            cells, cell_valid, base, win_next, wm_pane, max_seen)
+         fired_b, wvals_b, w_b, n_b, nd_b) = fire_pass(
+            cells, cell_valid, base, win_next, wm_pane, max_seen, horizon)
+        n_win_dropped = n_win_dropped + nd_b
 
         new_state = {
             "cells": cells,
@@ -479,8 +512,10 @@ def make_ffat_tb_step(capacity: int, K: int, P_usec: int, R: int, D: int,
             "base": base,
             "win_next": win_next,
             "max_seen": max_seen,
+            "horizon": horizon,
             "n_late": state["n_late"] + jnp.sum(late.astype(jnp.int64)),
             "n_evicted": state["n_evicted"] + evicted,
+            "n_win_dropped": n_win_dropped,
         }
         # outputs: pass A1, A2, then B rows, [K, N_PASSES*MW] flattened
         all_passes = a_outs + [(fired_b, wvals_b, w_b, n_b)]

@@ -51,14 +51,39 @@ from windflow_tpu.windows.ffat_kernels import (_masked_reduce_last,
 
 
 class FfatTPUReplica(_TPUReplica):
+    def process_device_batch(self, batch):
+        out = self.op._step(batch, self.index)
+        self.stats.device_programs_launched += 1
+        if out is not None:
+            self.stats.outputs_sent += out.known_size or 0
+            self.emitter.emit_device_batch(out)
+
     def on_eos(self):
-        # State is operator-level; only the LAST replica to terminate may
-        # flush it — earlier-terminating siblings' peers might still hold
-        # queued data batches whose tuples belong in the open windows.
-        self.op._eos_replicas += 1
-        if self.op._eos_replicas < self.op.parallelism:
-            return
-        for out in self.op._flush():
+        if self.op.is_tb and self.op._per_replica_state:
+            # Keyed TB state is PER REPLICA (each replica owns its key
+            # partition's pane ring and clock — independent partitions'
+            # watermark frontiers must never advance each other's rings),
+            # so every replica flushes its own state at its own EOS.
+            outs = self.op._flush_tb(self.index)
+        elif self.op.is_tb:
+            # FORWARD-routed TB: batches round-robin over replicas into ONE
+            # shared state (no key partition exists to split it by), so the
+            # last replica to terminate flushes it once.
+            self.op._eos_replicas += 1
+            if self.op._eos_replicas < self.op.parallelism:
+                return
+            outs = self.op._flush_tb(0)
+        else:
+            # CB state is operator-level (per-key clock lanes make the one
+            # dense table safe under key partitioning); only the LAST
+            # replica to terminate may flush it — earlier-terminating
+            # siblings' peers might still hold queued data batches whose
+            # tuples belong in the open windows.
+            self.op._eos_replicas += 1
+            if self.op._eos_replicas < self.op.parallelism:
+                return
+            outs = self.op._flush()
+        for out in outs:
             self.stats.device_programs_launched += 1
             self.emitter.emit_device_batch(out)
 
@@ -76,7 +101,8 @@ class FfatWindowsTPU(Operator):
                  max_keys: int, name: str = "ffat_windows_tpu",
                  parallelism: int = 1,
                  key_extractor: Optional[Callable] = None,
-                 pane_capacity: Optional[int] = None) -> None:
+                 pane_capacity: Optional[int] = None,
+                 overflow_policy: str = "drop") -> None:
         routing = (RoutingMode.KEYBY if key_extractor is not None
                    else RoutingMode.FORWARD)
         super().__init__(name, parallelism, routing=routing, is_tpu=True,
@@ -95,14 +121,32 @@ class FfatWindowsTPU(Operator):
         # before the ring rolls), plus the lateness allowance in panes
         # (lateness holds windows open, so their panes stay pinned in the
         # ring).  Exceeding it is overload: panes are evicted and counted
-        # (n_evicted).  Tunable via the builder's withPaneCapacity.
-        self.NP = pane_capacity or max(2 * self.R, self.R + 64)
-        if self.is_tb and self.NP < 2 * self.R:
+        # (n_evicted).  When not set via withPaneCapacity, the ring is
+        # auto-sized at the first batch to one batch's worth of panes
+        # (capped at 8192) — keyed partitioning concentrates one key's
+        # tuples, so a partition batch of C tuples can span C panes.
+        self.NP = pane_capacity
+        if self.is_tb and pane_capacity is not None                 and pane_capacity < 2 * self.R:
             # >= 2R also guarantees the step's two pre-place fire passes
             # reach every window over in-ring data (ffat_kernels docstring)
             raise WindFlowError(
                 "pane_capacity must be at least 2*win/gcd panes")
-        self._state = None          # device state, created on first batch
+        if overflow_policy not in ("drop", "count", "error"):
+            raise WindFlowError(
+                f"unknown overflow policy '{overflow_policy}' "
+                "(drop | count | error)")
+        #: TB ring-overflow policy: "drop" (default) suppresses windows
+        #: that lost data panes and counts them; "count" fires them over
+        #: the surviving panes only (wrong aggregates, n_evicted counts);
+        #: "error" raises at the next host checkpoint.  The reference never
+        #: fires a wrong window (its FlatFAT grows instead).
+        self.overflow_policy = overflow_policy
+        self._overflow_steps = 0
+        # Device state, created on first batch.  CB: one shared table (key
+        # 0) — per-key clock lanes make it partition-safe.  TB: one state
+        # PER REPLICA index — the ring clocks are shared across a state's
+        # keys, so each key partition needs its own.
+        self._states = {}
         self._jit_step = None
         self._jit_flush = None
         self._capacity = None
@@ -136,7 +180,8 @@ class FfatWindowsTPU(Operator):
                 return make_sharded_ffat_tb_step(
                     self.mesh, capacity, self.max_keys, self.P, self.R,
                     self.D, self.NP, self.lift, self.comb,
-                    self.key_extractor)
+                    self.key_extractor,
+                    drop_tainted=self.overflow_policy == "drop")
             return make_sharded_ffat_step(
                 self.mesh, capacity, self.max_keys, self.P, self.R, self.D,
                 self.lift, self.comb, self.key_extractor)
@@ -144,7 +189,9 @@ class FfatWindowsTPU(Operator):
             step = make_ffat_tb_step(capacity, self.max_keys, self.P,
                                      self.R, self.D, self.NP,
                                      self.lift, self.comb,
-                                     self.key_extractor)
+                                     self.key_extractor,
+                                     drop_tainted=self.overflow_policy
+                                     == "drop")
         else:
             step = make_ffat_step(capacity, self.max_keys, self.P, self.R,
                                   self.D, self.lift, self.comb,
@@ -152,11 +199,29 @@ class FfatWindowsTPU(Operator):
         return jax.jit(step, donate_argnums=(0,))
 
     # -- operator plumbing ---------------------------------------------------
-    def _ensure(self, batch: DeviceBatch):
-        if self._state is None:
-            self._state = self._init_state(
-                agg_spec_for(self.lift, batch.payload))
+    @property
+    def _per_replica_state(self) -> bool:
+        # TB ring clocks are shared across a state's keys, so KEYBY
+        # partitions (disjoint keys, independent watermark frontiers) need
+        # one state per replica; FORWARD round-robin feeds every replica
+        # the same keys and must share one state.
+        return self.is_tb and self.routing == RoutingMode.KEYBY             and self.parallelism > 1
+
+    def _sidx(self, ridx: int) -> int:
+        return ridx if self._per_replica_state else 0
+
+    def _ensure(self, batch: DeviceBatch, sidx: int):
+        if self._capacity is None:
             self._capacity = batch.capacity
+            if self.NP is None:
+                # auto-size to one batch's worth of panes (a keyed
+                # partition batch of C tuples can span C panes), bounded so
+                # the dense [max_keys, NP] state stays ~O(32 MB)/leaf —
+                # beyond that, size explicitly with withPaneCapacity
+                cap_by_mem = max(64, (1 << 23) // max(1, self.max_keys))
+                self.NP = max(2 * self.R, self.R + 64,
+                              self.R + min(batch.capacity, 8192,
+                                           cap_by_mem) + 2)
             self._jit_step = self._build_step(batch.capacity)
             if self.is_tb:
                 self._payload_zero = jax.tree.map(jnp.zeros_like,
@@ -165,6 +230,9 @@ class FfatWindowsTPU(Operator):
             raise WindFlowError(
                 "FfatWindowsTPU requires a fixed upstream batch capacity "
                 f"({self._capacity}), got {batch.capacity}")
+        if sidx not in self._states:
+            self._states[sidx] = self._init_state(
+                agg_spec_for(self.lift, batch.payload))
 
     def _wm_pane(self, wm: int) -> int:
         """Lateness-adjusted watermark in pane units (the host-side firing
@@ -173,75 +241,100 @@ class FfatWindowsTPU(Operator):
             return -(1 << 60)
         return (wm - self.spec.lateness) // self.P
 
-    def _step(self, batch: DeviceBatch) -> DeviceBatch:
-        self._ensure(batch)
+    def _step(self, batch: DeviceBatch, ridx: int = 0) -> DeviceBatch:
+        sidx = self._sidx(ridx)
+        self._ensure(batch, sidx)
         if self.is_tb:
             # Fire on the batch's staging-time frontier, not the min-folded
             # propagated stamp: the step places every tuple of the batch
             # before firing, so the newest frontier is safe here and saves
             # one batch of firing lag (batch.py DeviceBatch.frontier).
-            self._state, out, fired, out_ts, _ = self._jit_step(
-                self._state, batch.payload, batch.ts, batch.valid,
+            self._states[sidx], out, fired, out_ts, _ = self._jit_step(
+                self._states[sidx], batch.payload, batch.ts, batch.valid,
                 jnp.int64(self._wm_pane(batch.frontier)))
+            if self.overflow_policy == "error":
+                # periodic host checkpoint (one sync every 64 steps, and at
+                # EOS): fail loudly instead of producing wrong aggregates
+                self._overflow_steps += 1
+                if self._overflow_steps % 64 == 0:
+                    self._check_overflow(sidx)
         else:
-            self._state, out, fired, out_ts = self._jit_step(
-                self._state, batch.payload, batch.ts, batch.valid)
+            self._states[sidx], out, fired, out_ts = self._jit_step(
+                self._states[sidx], batch.payload, batch.ts, batch.valid)
         return DeviceBatch(out, out_ts, fired,
                            watermark=batch.watermark, size=None)
 
     def _flush(self) -> list:
-        """EOS: fire remaining partial windows (reference EOS flush of open
-        windows).  State is operator-level (one logical device table
-        regardless of replica count), so the last replica to terminate
-        flushes it once.  CB runs a dedicated flush program; TB iterates
-        the normal step with an empty batch and an infinite watermark —
-        each pass fires the windows whose ends the ring roll has brought
-        into range, until nothing fires."""
-        if self._state is None or self._flushed:
+        """EOS flush of the CB shared state: fire remaining partial windows
+        (reference EOS flush of open windows).  Called once, by the last
+        replica to terminate."""
+        if not self._states or self._flushed:
             return []
         self._flushed = True
-        if self.is_tb:
-            import numpy as np
-            cap = self._capacity
-            ts0 = jnp.zeros(cap, jnp.int64)
-            invalid = jnp.zeros(cap, bool)
-            outs = []
-            while True:
-                self._state, out, fired, out_ts, n_adv = self._jit_step(
-                    self._state, self._payload_zero, ts0, invalid,
-                    jnp.int64(1 << 60))
-                if bool(np.asarray(fired).any()):
-                    outs.append(DeviceBatch(out, out_ts, fired, watermark=0,
-                                            size=None))
-                # loop on ADVANCE, not emission: windows beyond an empty gap
-                # in the pane sequence would stall behind a no-emission pass
-                if int(n_adv) == 0:
-                    break
-            return outs
         if self._jit_flush is None:
             self._jit_flush = self._build_flush()
-        out, fired, ts = self._jit_flush(self._state)
+        out, fired, ts = self._jit_flush(self._states[0])
         return [DeviceBatch(out, ts, fired, watermark=0, size=None)]
 
+    def _flush_tb(self, ridx: int) -> list:
+        """EOS flush of one TB state: iterate the normal step with an empty
+        batch and an infinite watermark — each pass fires the windows whose
+        ends the ring roll has brought into range, until the window
+        frontier stops advancing.  Keyed TB flushes per replica; FORWARD TB
+        flushes the shared state once (guarded by the caller)."""
+        import numpy as np
+        sidx = self._sidx(ridx)
+        if sidx not in self._states:
+            return []
+        if self.overflow_policy == "error":
+            self._check_overflow(sidx)
+        cap = self._capacity
+        ts0 = jnp.zeros(cap, jnp.int64)
+        invalid = jnp.zeros(cap, bool)
+        outs = []
+        while True:
+            self._states[sidx], out, fired, out_ts, n_adv = self._jit_step(
+                self._states[sidx], self._payload_zero, ts0, invalid,
+                jnp.int64(1 << 60))
+            if bool(np.asarray(fired).any()):
+                outs.append(DeviceBatch(out, out_ts, fired, watermark=0,
+                                        size=None))
+            # loop on ADVANCE, not emission: windows beyond an empty gap
+            # in the pane sequence would stall behind a no-emission pass
+            if int(n_adv) == 0:
+                break
+        return outs
+
+    def _check_overflow(self, sidx: int):
+        if int(jnp.sum(self._states[sidx]["n_evicted"])) > 0:
+            raise WindFlowError(
+                f"{self.name}: TB pane ring overflow (pane_capacity="
+                f"{self.NP} < window span + batch time spread + lateness "
+                "panes); increase withPaneCapacity or choose overflow "
+                "policy 'drop'/'count'")
+
+    def _tb_counter(self, name: str) -> int:
+        # one device sync at read time, never on the step path; summed over
+        # replica states (and over key-shard lanes on a mesh)
+        return sum(int(jnp.sum(st[name])) for st in self._states.values())
+
     def num_dropped_tuples(self) -> int:
-        if self.is_tb and self._state is not None:
-            # device sync, stats only; sum over key shards on a mesh
-            return int(jnp.sum(self._state["n_late"]))
+        if self.is_tb and self._states:
+            return self._tb_counter("n_late")
         return 0
 
     def dump_stats(self) -> dict:
-        n_late = n_evicted = None
-        if self.is_tb and self._state is not None:
-            # one device sync at dump time, never on the step path;
-            # per-key-shard lanes on a mesh, scalars single-chip
-            n_late = int(jnp.sum(self._state["n_late"]))
-            n_evicted = int(jnp.sum(self._state["n_evicted"]))
+        n_late = None
+        if self.is_tb and self._states:
+            n_late = self._tb_counter("n_late")
             if self.replicas:
                 self.replicas[0].stats.inputs_ignored = n_late
         st = super().dump_stats()
         if n_late is not None:
             st["Late_tuples_dropped"] = n_late
-            st["Pane_cells_evicted"] = n_evicted
+            st["Pane_cells_evicted"] = self._tb_counter("n_evicted")
+            st["Windows_dropped_on_overflow"] = \
+                self._tb_counter("n_win_dropped")
         return st
 
     def _build_flush(self):
