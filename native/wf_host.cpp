@@ -5,8 +5,8 @@
 // ff::MPMC_Ptr_Queue lock-free queues, forward_emitter_gpu.hpp pinned
 // staging, keyby_emitter.hpp hash routing): the pieces of the runtime that
 // sit AROUND the XLA compute path and want to be native — bulk ingest
-// parsing, key partitioning, buffer pooling with in-transit throttling, and
-// an SPSC ring for threaded stages.  Exposed as a plain C ABI consumed via
+// parsing, key partitioning, and the watermark fold.  Exposed as a plain
+// C ABI consumed via
 // ctypes (windflow_tpu/native/__init__.py); no Python.h dependency so the
 // library builds with any g++ and loads in any CPython.
 //
@@ -46,13 +46,6 @@ void wf_keyby_partition(const int64_t* keys, int64_t n, int32_t ndest,
   }
 }
 
-// Stable scatter of per-destination positions: offs_out[i] is the slot of
-// tuple i within its destination's output batch.
-void wf_partition_offsets(const int32_t* dests, int64_t n, int32_t ndest,
-                          int64_t* offs_out) {
-  std::vector<int64_t> next((size_t)ndest, 0);
-  for (int64_t i = 0; i < n; ++i) offs_out[i] = next[(size_t)dests[i]]++;
-}
 
 // ---------------------------------------------------------------------------
 // Bulk ingest: parse binary frames / CSV into columns (the native
@@ -121,111 +114,6 @@ int64_t wf_parse_csv(const char* buf, int64_t nbytes, int32_t nv,
   }
   *consumed_out = pos;
   return n;
-}
-
-// ---------------------------------------------------------------------------
-// Buffer pool with in-transit throttling (reference recycling_gpu.hpp:88-126:
-// free-list keyed by size + inTransit_counter cap).
-// ---------------------------------------------------------------------------
-
-struct WfPool {
-  int64_t buf_bytes;
-  int32_t capacity;  // max outstanding buffers (in-transit cap)
-  std::vector<void*> free_list;
-  std::atomic<int32_t> outstanding{0};
-  std::mutex mu;
-};
-
-void* wf_pool_create(int64_t buf_bytes, int32_t capacity) {
-  WfPool* p = new WfPool();
-  p->buf_bytes = buf_bytes;
-  p->capacity = capacity;
-  return p;
-}
-
-void wf_pool_destroy(void* pool) {
-  WfPool* p = (WfPool*)pool;
-  for (void* b : p->free_list) free(b);
-  delete p;
-}
-
-// nullptr when `capacity` buffers are already outstanding — the caller
-// throttles, exactly like the reference's FullGPUMemoryException retry.
-void* wf_pool_acquire(void* pool) {
-  WfPool* p = (WfPool*)pool;
-  std::lock_guard<std::mutex> lock(p->mu);
-  if (p->outstanding.load() >= p->capacity) return nullptr;
-  void* buf;
-  if (!p->free_list.empty()) {
-    buf = p->free_list.back();
-    p->free_list.pop_back();
-  } else {
-    buf = aligned_alloc(64, (size_t)((p->buf_bytes + 63) / 64 * 64));
-    if (buf == nullptr) return nullptr;  // allocation failure != in-transit
-  }
-  p->outstanding.fetch_add(1);
-  return buf;
-}
-
-void wf_pool_release(void* pool, void* buf) {
-  WfPool* p = (WfPool*)pool;
-  std::lock_guard<std::mutex> lock(p->mu);
-  p->free_list.push_back(buf);
-  p->outstanding.fetch_sub(1);
-}
-
-int32_t wf_pool_outstanding(void* pool) {
-  return ((WfPool*)pool)->outstanding.load();
-}
-
-// ---------------------------------------------------------------------------
-// Lock-free SPSC ring (reference ff SPSC queues): one producer thread, one
-// consumer thread, pointer-sized items.
-// ---------------------------------------------------------------------------
-
-struct WfRing {
-  std::vector<void*> slots;
-  int64_t mask;
-  std::atomic<int64_t> head{0};  // consumer position
-  std::atomic<int64_t> tail{0};  // producer position
-};
-
-void* wf_ring_create(int64_t capacity_pow2) {
-  // round capacity up to a power of two
-  int64_t cap = 1;
-  while (cap < capacity_pow2) cap <<= 1;
-  WfRing* r = new WfRing();
-  r->slots.resize((size_t)cap, nullptr);
-  r->mask = cap - 1;
-  return r;
-}
-
-void wf_ring_destroy(void* ring) { delete (WfRing*)ring; }
-
-int32_t wf_ring_push(void* ring, void* item) {
-  WfRing* r = (WfRing*)ring;
-  int64_t t = r->tail.load(std::memory_order_relaxed);
-  int64_t h = r->head.load(std::memory_order_acquire);
-  if (t - h > r->mask) return 0;  // full
-  r->slots[(size_t)(t & r->mask)] = item;
-  r->tail.store(t + 1, std::memory_order_release);
-  return 1;
-}
-
-void* wf_ring_pop(void* ring) {
-  WfRing* r = (WfRing*)ring;
-  int64_t h = r->head.load(std::memory_order_relaxed);
-  int64_t t = r->tail.load(std::memory_order_acquire);
-  if (h >= t) return nullptr;  // empty
-  void* item = r->slots[(size_t)(h & r->mask)];
-  r->head.store(h + 1, std::memory_order_release);
-  return item;
-}
-
-int64_t wf_ring_size(void* ring) {
-  WfRing* r = (WfRing*)ring;
-  return r->tail.load(std::memory_order_acquire) -
-         r->head.load(std::memory_order_acquire);
 }
 
 // ---------------------------------------------------------------------------

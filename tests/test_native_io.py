@@ -113,34 +113,6 @@ def test_frame_source_csv_without_trailing_newline():
     assert sorted(got) == [(1, 2.5), (2, 3.5)]
 
 
-def test_buffer_pool_throttles():
-    pool = native.BufferPool(1024, capacity=2)
-    a = pool.acquire()
-    b = pool.acquire()
-    assert a is not None and b is not None
-    assert pool.acquire() is None          # in-transit cap hit
-    assert pool.outstanding == 2
-    pool.release(a)
-    c = pool.acquire()                     # recycled
-    assert c is not None
-    pool.release(b)
-    pool.release(c)
-    assert pool.outstanding == 0
-
-
-def test_spsc_ring():
-    L = native.lib()
-    r = L.wf_ring_create(4)
-    vals = [ctypes.c_void_p(addr) for addr in (8, 16, 24, 32, 40)]
-    assert all(L.wf_ring_push(r, v) for v in vals[:4])
-    assert L.wf_ring_push(r, vals[4]) == 0      # full
-    assert L.wf_ring_size(r) == 4
-    got = [L.wf_ring_pop(r) for _ in range(4)]
-    assert got == [8, 16, 24, 32]
-    assert L.wf_ring_pop(r) is None             # empty
-    L.wf_ring_destroy(r)
-
-
 def test_min_watermark():
     WM = -1
     assert native.min_watermark(np.array([5, 3, 9], np.int64), WM) == 3
@@ -313,3 +285,23 @@ def test_chunk_spanning_batches_do_not_fire_ahead():
     assert st["Late_tuples_dropped"] == 0
     assert st["Pane_cells_evicted"] == 0
     assert got == exp
+
+
+def test_keyby_placement_agrees_across_paths():
+    """The per-tuple, columnar-native, and on-device keyby paths must place
+    every key on the same replica (a keyed operator can be fed by host and
+    device edges at once)."""
+    import jax.numpy as jnp
+    from windflow_tpu import native
+    from windflow_tpu.parallel.emitters import (_splitmix64_dev,
+                                                splitmix64_int)
+
+    rnd = np.random.default_rng(3)
+    keys = rnd.integers(-2**31, 2**31, 257).astype(np.int64)
+    for n in (2, 3, 7):
+        native_dest, _ = native.keyby_partition(keys, n)
+        py_dest = np.array([splitmix64_int(int(k)) % n for k in keys])
+        dev_dest = np.asarray(
+            _splitmix64_dev(jnp.asarray(keys, jnp.int32)) % jnp.uint64(n))
+        assert np.array_equal(native_dest, py_dest)
+        assert np.array_equal(native_dest, dev_dest.astype(np.int64))

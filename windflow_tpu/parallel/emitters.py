@@ -37,6 +37,31 @@ from windflow_tpu.batch import (DeviceBatch, HostBatch, Punctuation, WM_NONE,
                                 columns_to_device, host_to_device)
 
 
+_M64 = (1 << 64) - 1
+
+
+def splitmix64_int(k: int) -> int:
+    """Pure-Python splitmix64, bit-identical to the native ``wf_hash64`` /
+    ``native.hash64`` (keyed routing placement must agree across the
+    per-tuple, columnar-native, and on-device paths)."""
+    x = (k + 0x9E3779B97F4A7C15) & _M64
+    x = ((x ^ (x >> 30)) * 0xBF58476D1CE4E5B9) & _M64
+    x = ((x ^ (x >> 27)) * 0x94D049BB133111EB) & _M64
+    return x ^ (x >> 31)
+
+
+def _splitmix64_dev(k32):
+    """splitmix64 as jnp ops over an int32 key lane (sign-extended to the
+    same int64 the host paths hash) — keeps device-side keyby placement
+    bit-identical to the host staging emitter's."""
+    import jax.numpy as jnp
+    x = k32.astype(jnp.int64).astype(jnp.uint64) \
+        + jnp.uint64(0x9E3779B97F4A7C15)
+    x = (x ^ (x >> jnp.uint64(30))) * jnp.uint64(0xBF58476D1CE4E5B9)
+    x = (x ^ (x >> jnp.uint64(27))) * jnp.uint64(0x94D049BB133111EB)
+    return x ^ (x >> jnp.uint64(31))
+
+
 def stable_hash(key: Any) -> int:
     """Deterministic key hash (reference uses ``std::hash`` —
     ``keyby_emitter.hpp:216``).  Python's ``hash`` is salted for str/bytes, so
@@ -414,11 +439,15 @@ class DeviceStageEmitter(Emitter):
 class KeyedDeviceStageEmitter(Emitter):
     """Host→TPU boundary with KEYBY routing (reference CPU→GPU
     ``KeyBy_Emitter_GPU``, ``keyby_emitter_gpu.hpp:400-476``): tuples are
-    partitioned by ``hash(key) % num_dests`` into per-destination staged
-    batches, so every key's tuples flow through exactly one replica in
-    arrival order — the invariant that makes shared per-key device state
+    partitioned by ``splitmix64(key) % num_dests`` into per-destination
+    staged batches, so every key's tuples flow through exactly one replica
+    in arrival order — the invariant that makes shared per-key device state
     (ops/tpu_stateful.py) correct at parallelism > 1, exactly as the
-    reference's keyby routing does for its stateful GPU operators."""
+    reference's keyby routing does for its stateful GPU operators
+    (``std::hash % num_dests``, ``keyby_emitter.hpp:216``).  Hashing (the
+    native ``wf_keyby_partition``) rather than a plain modulo keeps
+    structured key sets (all-even ids, strided ids) from landing on one
+    replica."""
 
     def __init__(self, dests, output_batch_size, key_extractor, mesh=None):
         super().__init__(dests, output_batch_size)
@@ -437,32 +466,36 @@ class KeyedDeviceStageEmitter(Emitter):
         return i - (1 << 32) if i >= (1 << 31) else i
 
     def emit(self, item, ts, wm, shared=False):
-        d = self._key32(self.key_extractor(item)) % len(self.dests)
-        self._inner[d].emit(item, ts, wm)
+        # scalar splitmix64 (bit-identical to the native/columnar path) —
+        # pure int ops, no per-tuple FFI or array allocation
+        h = splitmix64_int(self._key32(self.key_extractor(item)))
+        self._inner[h % len(self.dests)].emit(item, ts, wm)
 
     def emit_columns(self, cols, tss, wm, row_wms=None):
+        from windflow_tpu import native
         n = len(self.dests)
-        dest = None
+        keys = None
         try:
             # Vectorized: per-record key fns are elementwise field math, so
             # they usually apply directly to the SoA columns.
-            keys = np.asarray(self.key_extractor(cols))
-            if keys.shape == (len(tss),):
-                # int64→int32→int64: the device's int32 truncation, then a
-                # non-negative floor-mod for the partition index
-                dest = keys.astype(np.int64).astype(
-                    np.int32).astype(np.int64) % n
+            k = np.asarray(self.key_extractor(cols))
+            if k.shape == (len(tss),):
+                # int64→int32: the device's int32 truncation first, so
+                # routing collapses exactly the keys the state collapses
+                keys = k.astype(np.int64).astype(np.int32).astype(np.int64)
         except Exception:
             pass
-        if dest is None:
+        if keys is None:
             # Non-elementwise or scalar-returning extractor: per-row path.
-            dest = np.array(
+            keys = np.array(
                 [self._key32(self.key_extractor(
-                    {k: v[i].item() for k, v in cols.items()})) % n
-                 for i in range(len(tss))])
+                    {k: v[i].item() for k, v in cols.items()}))
+                 for i in range(len(tss))], np.int64)
+        # native C hash+count partition (wf_host.cpp wf_keyby_partition)
+        dest, counts = native.keyby_partition(keys, n)
         for d in range(n):
-            idx = np.nonzero(dest == d)[0]
-            if len(idx):
+            if counts[d]:
+                idx = np.nonzero(dest == d)[0]
                 # the row frontier is global (covers rows of every
                 # partition up to that point), so slicing it per partition
                 # keeps each channel's stamps valid
@@ -487,7 +520,9 @@ class KeyedDeviceStageEmitter(Emitter):
 class DeviceKeyByEmitter(Emitter):
     """TPU→TPU KEYBY edge (reference GPU→GPU ``KeyBy_Emitter_GPU``,
     ``keyby_emitter_gpu.hpp:519-583``): one compiled program splits the batch
-    into ``num_dests`` order-preserving compactions by ``key % num_dests``.
+    into ``num_dests`` order-preserving compactions by
+    ``splitmix64(key) % num_dests`` (the same placement as the host-side
+    keyed staging emitter).
     The reference builds per-key index chains with sort kernels; the XLA
     expression is a stable argsort per partition.  Empty partitions still
     ship (a masked all-invalid batch) — skipping them would force a host
@@ -510,7 +545,11 @@ class DeviceKeyByEmitter(Emitter):
             def split(payload, ts, valid, keys):
                 if keys is None:
                     keys = jax.vmap(key_fn)(payload).astype(jnp.int32)
-                dest = jnp.where(valid, keys % n, jnp.int32(n))
+                # splitmix64 placement, bit-identical to the host staging
+                # emitter's — a keyed operator fed by both a host edge and
+                # a device edge must see each key on ONE replica
+                h = (_splitmix64_dev(keys) % jnp.uint64(n)).astype(jnp.int32)
+                dest = jnp.where(valid, h, jnp.int32(n))
                 outs = []
                 for d in range(n):
                     mask = dest == d

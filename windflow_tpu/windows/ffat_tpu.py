@@ -51,12 +51,8 @@ from windflow_tpu.windows.ffat_kernels import (_masked_reduce_last,
 
 
 class FfatTPUReplica(_TPUReplica):
-    def process_device_batch(self, batch):
-        out = self.op._step(batch, self.index)
-        self.stats.device_programs_launched += 1
-        if out is not None:
-            self.stats.outputs_sent += out.known_size or 0
-            self.emitter.emit_device_batch(out)
+    def _op_step(self, batch):
+        return self.op._step(batch, self.index)
 
     def on_eos(self):
         if self.op.is_tb and self.op._per_replica_state:
@@ -131,6 +127,16 @@ class FfatWindowsTPU(Operator):
             # reach every window over in-ring data (ffat_kernels docstring)
             raise WindFlowError(
                 "pane_capacity must be at least 2*win/gcd panes")
+        if self.is_tb and key_extractor is None and parallelism > 1:
+            # FORWARD round-robin at parallelism > 1 would interleave
+            # batches into the shared ring in replica-drain order, not
+            # arrival order — a later-frontier batch on one replica could
+            # fire windows before an earlier batch on a sibling is placed.
+            # Keyed routing (withKeyBy) is the scaling path, exactly as the
+            # reference scales windows by key partitioning.
+            raise WindFlowError(
+                "non-keyed time-based FfatWindowsTPU requires "
+                "parallelism == 1; use withKeyBy to scale")
         if overflow_policy not in ("drop", "count", "error"):
             raise WindFlowError(
                 f"unknown overflow policy '{overflow_policy}' "
