@@ -1,0 +1,162 @@
+"""Basic definitions: enums, defaults, and small shared helpers.
+
+TPU-native re-design of the reference's basic definitions
+(``/root/reference/wf/basic.hpp:78-87`` execution/time/window/routing enums,
+``:189-206`` default knobs).  Where the reference configures everything through
+compile-time macros, this framework uses a runtime :class:`Config` layer
+(SURVEY.md §5.6 calls this out as a required replacement).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+import os
+import time
+
+
+class ExecutionMode(enum.Enum):
+    """How replicas treat out-of-order inputs (reference ``basic.hpp:78``).
+
+    * DEFAULT        – out-of-order processing gated by watermarks.
+    * DETERMINISTIC  – inputs re-ordered by id/timestamp before processing, so
+                       every run produces the same sequence of outputs.
+    * PROBABILISTIC  – approximate ordering with an adaptive K-slack buffer;
+                       tuples later than the slack are dropped (and counted).
+    """
+
+    DEFAULT = "default"
+    DETERMINISTIC = "deterministic"
+    PROBABILISTIC = "probabilistic"
+
+
+class TimePolicy(enum.Enum):
+    """Timestamping policy (reference ``basic.hpp:84``).
+
+    * INGRESS – timestamps/watermarks assigned by the source shipper at entry.
+    * EVENT   – timestamps supplied by the user (``push_with_timestamp``);
+                watermarks are still monotonized by the shipper.
+    """
+
+    INGRESS = "ingress"
+    EVENT = "event"
+
+
+class WinType(enum.Enum):
+    """Window domain (reference ``basic.hpp:80``): count-based or time-based."""
+
+    CB = "count"
+    TB = "time"
+
+
+class RoutingMode(enum.Enum):
+    """How an emitter distributes outputs (reference ``basic.hpp:87``)."""
+
+    NONE = "none"
+    FORWARD = "forward"
+    KEYBY = "keyby"
+    BROADCAST = "broadcast"
+    REBALANCING = "rebalancing"
+
+
+class WindowRole(enum.Enum):
+    """Role of a window stage inside compound window operators
+    (reference ``basic.hpp:219``): plain sequential, pane-level query,
+    window-level query, map stage, reduce stage."""
+
+    SEQ = "seq"
+    PLQ = "plq"
+    WLQ = "wlq"
+    MAP = "map"
+    REDUCE = "reduce"
+
+
+class WindowEvent(enum.Enum):
+    """Classification of a tuple w.r.t. one window
+    (reference ``window_structure.hpp:49-115`` triggerer outcomes)."""
+
+    OLD = "old"
+    IN = "in"
+    FIRED = "fired"
+
+
+@dataclasses.dataclass
+class Config:
+    """Runtime configuration.  Replaces the reference's compile-time macro set
+    (``WF_DEFAULT_VECTOR_CAPACITY``, ``WF_DEFAULT_WM_INTERVAL_USEC``,
+    ``WF_DEFAULT_WM_AMOUNT``, ``WF_GPU_*`` — SURVEY.md §5.6) with values that
+    can be set per-process or per-graph.
+    """
+
+    # Default device batch capacity (tuples per compiled step).  The TPU
+    # analogue of the reference's GPU batch size: large enough to keep the
+    # VPU/MXU busy, small enough to bound latency.
+    default_batch_size: int = 4096
+    # Punctuation (watermark flush) cadence for idle emitters, microseconds
+    # (reference default 100 ms, basic.hpp:195).
+    punctuation_interval_usec: int = 100_000
+    # Punctuation cadence in number of inputs (reference default 1000,
+    # basic.hpp:195).  0 disables the count trigger: a punctuation flushes
+    # open/staged batches (the watermark must never overtake buffered data),
+    # and unlike the reference — whose batches are at most a few hundred
+    # tuples — TPU staging batches run to 10^5+ lanes, where a count cadence
+    # below the batch capacity would chronically ship padded batches.  The
+    # interval cadence above is what keeps idle streams firing.
+    punctuation_amount: int = 0
+    # Cap on outstanding device batches per operator before the host driver
+    # throttles source ticks (reference: in-transit counter +
+    # WF_GPU_FREE_MEMORY_LIMIT, recycling_gpu.hpp:88-126).  Each queued
+    # DeviceBatch pins ~capacity x payload-width bytes of HBM, so this bounds
+    # device memory the way the reference's FullGPUMemoryException retry does.
+    max_inflight_batches: int = 8
+    # Cap on total queued messages per replica inbox (host batches included)
+    # before source throttling — the runtime analogue of the reference's
+    # FF_BOUNDED_BUFFER bounded queues (README.md:36-39).
+    max_inbox_messages: int = 8192
+    # Tuples pulled from each live source per scheduler sweep; 0 means
+    # "one staged batch worth" (the source's output_batch_size, or 256).
+    source_tick_chunk: int = 0
+    # Messages one replica may process per scheduler sweep; bounding this
+    # interleaves sibling replicas fairly (the cooperative-loop analogue of
+    # the reference's thread-parallel arrival order, which matters for the
+    # KSlack collector's adaptive slack).
+    sweep_drain_limit: int = 16
+    # Directory where per-operator stats JSON logs are dumped at wait_end
+    # (reference WF_LOG_DIR, basic_operator.hpp:297-303).
+    log_dir: str = os.environ.get("WF_TPU_LOG_DIR", "log")
+    # Dashboard endpoint (reference WF_DASHBOARD_MACHINE/PORT,
+    # monitoring.hpp:184-196).
+    dashboard_host: str = os.environ.get("WF_TPU_DASHBOARD_HOST", "localhost")
+    dashboard_port: int = int(os.environ.get("WF_TPU_DASHBOARD_PORT", "20207"))
+    # Enable runtime tracing (reference compile-time -DWF_TRACING_ENABLED).
+    tracing_enabled: bool = bool(int(os.environ.get("WF_TPU_TRACING", "0")))
+    # Multi-chip execution: a jax.sharding.Mesh with ("data", "key") axes
+    # (see windflow_tpu.parallel.mesh.make_mesh).  When set, staging emitters
+    # lay batches out data-sharded across the mesh and mesh-aware TPU
+    # operators (FfatWindowsTPU, ReduceTPU) compile their sharded variants —
+    # the mesh takes the role the reference fills with operator replication
+    # over threads (SURVEY.md §2.6 item 10).  Requires output_batch_size
+    # divisible by the data-axis extent and max_keys divisible by the
+    # key-axis extent.  Typed Any so importing this module never imports jax.
+    mesh: object = None
+
+
+#: Process-wide default configuration; graphs copy it at construction so later
+#: mutation does not affect running graphs.
+default_config = Config()
+
+
+def current_time_usecs() -> int:
+    """Monotonic-ish wall clock in microseconds (reference
+    ``basic.hpp`` ``current_time_usecs``)."""
+    return time.time_ns() // 1_000
+
+
+#: Sentinel key used by non-keyed stateful operators
+#: (reference ``empty_key_t``, basic.hpp:306-318).
+EMPTY_KEY = 0
+
+
+class WindFlowError(RuntimeError):
+    """Raised for user/API misuse.  The reference aborts the process with a
+    colored message (``basic_operator.hpp:269-272``); a library should raise."""

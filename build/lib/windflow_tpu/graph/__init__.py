@@ -1,0 +1,2 @@
+from windflow_tpu.graph.multipipe import MultiPipe
+from windflow_tpu.graph.pipegraph import PipeGraph

@@ -1,0 +1,527 @@
+"""Fluent operator builders (reference ``/root/reference/wf/builders.hpp:57-127``
+and the GPU variants in ``builders_gpu.hpp:54-673``).
+
+Method names keep the reference's camelCase (``withParallelism``,
+``withKeyBy``, ``withOutputBatchSize``) so a WindFlow user can transliterate
+their program; TPU builders mirror the ``*GPU_Builder`` family.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+from windflow_tpu.basic import RoutingMode, WindFlowError
+from windflow_tpu.ops.filter_op import Filter
+from windflow_tpu.ops.flatmap_op import FlatMap
+from windflow_tpu.ops.map_op import Map
+from windflow_tpu.ops.reduce_op import Reduce
+from windflow_tpu.ops.sink import Sink
+from windflow_tpu.ops.source import Source
+from windflow_tpu.ops.tpu import FilterTPU, MapTPU, ReduceTPU
+from windflow_tpu.ops.tpu_stateful import StatefulFilterTPU, StatefulMapTPU
+
+
+class _BuilderBase:
+    _default_name = "op"
+
+    def __init__(self) -> None:
+        self._name = self._default_name
+        self._parallelism = 1
+        self._output_batch_size = 0
+        self._key_extractor: Optional[Callable] = None
+
+    def withName(self, name: str):
+        self._name = name
+        return self
+
+    def withParallelism(self, parallelism: int):
+        self._parallelism = parallelism
+        return self
+
+    def withOutputBatchSize(self, size: int):
+        self._output_batch_size = size
+        return self
+
+    def withKeyBy(self, key_extractor: Callable[[Any], Any]):
+        self._key_extractor = key_extractor
+        return self
+
+    def withRebalancing(self):
+        """Round-robin input distribution even after an upstream KEYBY
+        (reference REBALANCING routing, ``basic.hpp:87`` / builders
+        ``withRebalancing``).  Mutually exclusive with withKeyBy."""
+        self._rebalancing = True
+        return self
+
+    def _routing(self) -> RoutingMode:
+        if getattr(self, "_rebalancing", False):
+            if self._key_extractor is not None:
+                raise WindFlowError(
+                    "withRebalancing and withKeyBy are mutually exclusive")
+            return RoutingMode.REBALANCING
+        return (RoutingMode.KEYBY if self._key_extractor is not None
+                else RoutingMode.FORWARD)
+
+
+class Source_Builder(_BuilderBase):
+    _default_name = "source"
+
+    def __init__(self, gen_fn: Callable) -> None:
+        super().__init__()
+        self._gen_fn = gen_fn
+        self._ts_extractor = None
+
+    def withTimestampExtractor(self, fn: Callable[[Any], int]):
+        """EVENT-time sources: extract the event timestamp (µs) from each
+        generated item (reference: ``Source_Shipper::pushWithTimestamp``)."""
+        self._ts_extractor = fn
+        return self
+
+    def withKeyBy(self, *_):
+        raise WindFlowError("a Source has no input to key by")
+
+    def withRebalancing(self):
+        raise WindFlowError("a Source has no input to rebalance")
+
+    def build(self) -> Source:
+        return Source(self._gen_fn, name=self._name,
+                      parallelism=self._parallelism,
+                      output_batch_size=self._output_batch_size,
+                      ts_extractor=self._ts_extractor)
+
+
+class Map_Builder(_BuilderBase):
+    _default_name = "map"
+
+    def __init__(self, fn: Callable) -> None:
+        super().__init__()
+        self._fn = fn
+
+    def build(self) -> Map:
+        return Map(self._fn, name=self._name, parallelism=self._parallelism,
+                   routing=self._routing(),
+                   output_batch_size=self._output_batch_size,
+                   key_extractor=self._key_extractor)
+
+
+class Filter_Builder(_BuilderBase):
+    _default_name = "filter"
+
+    def __init__(self, fn: Callable) -> None:
+        super().__init__()
+        self._fn = fn
+
+    def build(self) -> Filter:
+        return Filter(self._fn, name=self._name,
+                      parallelism=self._parallelism,
+                      routing=self._routing(),
+                      output_batch_size=self._output_batch_size,
+                      key_extractor=self._key_extractor)
+
+
+class FlatMap_Builder(_BuilderBase):
+    _default_name = "flatmap"
+
+    def __init__(self, fn: Callable) -> None:
+        super().__init__()
+        self._fn = fn
+
+    def build(self) -> FlatMap:
+        return FlatMap(self._fn, name=self._name,
+                       parallelism=self._parallelism,
+                       routing=self._routing(),
+                       output_batch_size=self._output_batch_size,
+                       key_extractor=self._key_extractor)
+
+
+class Reduce_Builder(_BuilderBase):
+    _default_name = "reduce"
+
+    def __init__(self, fn: Callable, initial_state: Any) -> None:
+        super().__init__()
+        self._fn = fn
+        self._initial_state = initial_state
+
+    def withRebalancing(self):
+        raise WindFlowError(
+            "Reduce routes by key (or runs non-replicated); REBALANCING "
+            "does not apply")
+
+    def build(self) -> Reduce:
+        return Reduce(self._fn, self._initial_state, name=self._name,
+                      parallelism=self._parallelism,
+                      key_extractor=self._key_extractor,
+                      output_batch_size=self._output_batch_size)
+
+
+class Sink_Builder(_BuilderBase):
+    _default_name = "sink"
+
+    def __init__(self, fn: Callable) -> None:
+        super().__init__()
+        self._fn = fn
+        self._columnar = False
+        self._columnar_defer = 2
+
+    def withColumnarSink(self, defer: int = 2):
+        """Deliver TPU→Sink batches as SoA numpy columns (``SinkColumns``)
+        instead of per-record dicts — one bulk device→host copy, zero
+        per-tuple Python (egress twin of the columnar ingest path).
+        ``defer`` batches are held before conversion so the device→host
+        transfer overlaps later batches' compute (0 = convert eagerly)."""
+        self._columnar = True
+        self._columnar_defer = defer
+        return self
+
+    def build(self) -> Sink:
+        return Sink(self._fn, name=self._name, parallelism=self._parallelism,
+                    routing=self._routing(),
+                    key_extractor=self._key_extractor,
+                    columnar=self._columnar,
+                    columnar_defer=self._columnar_defer)
+
+
+# ---------------------------------------------------------------------------
+# TPU builders (reference MapGPU_Builder / FilterGPU_Builder /
+# ReduceGPU_Builder, builders_gpu.hpp:54-673)
+# ---------------------------------------------------------------------------
+
+class _StatefulTPUMixin:
+    """Stateful knobs shared by MapTPU/FilterTPU builders (reference:
+    stateful ``MapGPU_Builder``/``FilterGPU_Builder`` variants are selected
+    by the functor's (tuple, state) signature, ``builders_gpu.hpp:54-673``;
+    here the per-key initial state is explicit)."""
+
+    _initial_state = None
+    _num_key_slots = 4096
+    _dense_keys = False
+    _assoc = None
+
+    def withInitialState(self, state):
+        """Per-key initial state prototype — switches the operator to the
+        stateful keyed path (requires ``withKeyBy``)."""
+        self._initial_state = state
+        return self
+
+    def withNumKeySlots(self, n: int):
+        """Capacity of the dense device state table (max distinct keys)."""
+        self._num_key_slots = n
+        return self
+
+    def withDenseKeys(self):
+        """Declare that the key extractor already returns dense slot ids in
+        [0, num_key_slots): host-side key interning is skipped, so every
+        batch is one fully-asynchronous device program (no per-batch D2H
+        sync).  Out-of-range keys are masked invalid, as in FfatWindowsTPU."""
+        self._dense_keys = True
+        return self
+
+    def withAssociativeUpdate(self, lift, comb, project):
+        """Declare the state update associative:
+        ``state' = comb(state, lift(record))`` and the output is
+        ``project(record, state_including_this_record)`` (for filters,
+        project returns the keep bool).  The operator then runs a log-depth
+        segmented scan instead of the rank wavefront, so a single hot key
+        costs the same as uniform keys.  The plain fn passed to the builder
+        is ignored."""
+        self._assoc = (lift, comb, project)
+        return self
+
+
+class MapTPU_Builder(_StatefulTPUMixin, _BuilderBase):
+    _default_name = "map_tpu"
+
+    def __init__(self, fn: Callable, batch_fn: bool = False) -> None:
+        super().__init__()
+        self._fn = fn
+        self._batch_fn = batch_fn
+
+    def build(self):
+        if self._initial_state is not None:
+            if self._batch_fn:
+                raise WindFlowError(
+                    "batch_fn is not supported for stateful MapTPU: the "
+                    "stateful function operates per record as "
+                    "fn(record, state) -> (record, state)")
+            if getattr(self, "_rebalancing", False):
+                raise WindFlowError(
+                    "stateful TPU operators route by key; REBALANCING "
+                    "does not apply")
+            return StatefulMapTPU(self._fn, self._initial_state,
+                                  name=self._name,
+                                  parallelism=self._parallelism,
+                                  key_extractor=self._key_extractor,
+                                  num_key_slots=self._num_key_slots,
+                                  dense_keys=self._dense_keys,
+                                  assoc=self._assoc)
+        return MapTPU(self._fn, name=self._name,
+                      parallelism=self._parallelism,
+                      batch_fn=self._batch_fn, routing=self._routing(),
+                      key_extractor=self._key_extractor)
+
+
+class FilterTPU_Builder(_StatefulTPUMixin, _BuilderBase):
+    _default_name = "filter_tpu"
+
+    def __init__(self, fn: Callable) -> None:
+        super().__init__()
+        self._fn = fn
+
+    def build(self):
+        if self._initial_state is not None:
+            if getattr(self, "_rebalancing", False):
+                raise WindFlowError(
+                    "stateful TPU operators route by key; REBALANCING "
+                    "does not apply")
+            return StatefulFilterTPU(self._fn, self._initial_state,
+                                     name=self._name,
+                                     parallelism=self._parallelism,
+                                     key_extractor=self._key_extractor,
+                                     num_key_slots=self._num_key_slots,
+                                     dense_keys=self._dense_keys,
+                                     assoc=self._assoc)
+        return FilterTPU(self._fn, name=self._name,
+                         parallelism=self._parallelism,
+                         routing=self._routing(),
+                         key_extractor=self._key_extractor)
+
+
+class ReduceTPU_Builder(_BuilderBase):
+    _default_name = "reduce_tpu"
+
+    def __init__(self, comb: Callable) -> None:
+        super().__init__()
+        self._comb = comb
+        self._max_keys = None
+        self._sum_like = False
+
+    def withRebalancing(self):
+        raise WindFlowError(
+            "ReduceTPU routes by key (or reduces globally); REBALANCING "
+            "does not apply")
+
+    def withMaxKeys(self, n: int):
+        """Mesh execution only: bound of the dense key space [0, n) used by
+        the cross-chip partial tables (Config.mesh; single-chip reduces sort
+        arbitrary int32 keys and ignore this)."""
+        self._max_keys = int(n)
+        return self
+
+    def withSumCombiner(self):
+        """Declare the combiner sum-like (zero-absorbing on every leaf), so
+        the cross-chip combine can ride ``lax.psum`` instead of
+        all_gather + fold.  Mesh execution only."""
+        self._sum_like = True
+        return self
+
+    def build(self) -> ReduceTPU:
+        return ReduceTPU(self._comb, name=self._name,
+                         parallelism=self._parallelism,
+                         key_extractor=self._key_extractor,
+                         max_keys=self._max_keys, sum_like=self._sum_like)
+
+
+# ---------------------------------------------------------------------------
+# Window builders (reference Keyed_Windows_Builder / Parallel_Windows_Builder /
+# Paned_Windows_Builder / MapReduce_Windows_Builder / Ffat_Windows_Builder /
+# Ffat_WindowsGPU_Builder, builders.hpp + builders_gpu.hpp:576)
+# ---------------------------------------------------------------------------
+
+from windflow_tpu.basic import WinType  # noqa: E402
+from windflow_tpu.meta import _positional_arity  # noqa: E402
+from windflow_tpu.windows.engine import WindowSpec  # noqa: E402
+from windflow_tpu.windows.ops import (KeyedWindows, MapReduceWindows,  # noqa: E402
+                                      PanedWindows, ParallelWindows)
+from windflow_tpu.windows.ffat_op import FfatWindows  # noqa: E402
+from windflow_tpu.windows.ffat_tpu import FfatWindowsTPU  # noqa: E402
+
+
+class _WindowBuilderBase(_BuilderBase):
+    def withRebalancing(self):
+        raise WindFlowError(
+            "window operators route by key / broadcast; REBALANCING does "
+            "not apply")
+
+    def __init__(self):
+        super().__init__()
+        self._win_type = None
+        self._win_len = 0
+        self._slide = 0
+        self._lateness = 0
+
+    def withCBWindows(self, win_len: int, slide: int):
+        self._win_type = WinType.CB
+        self._win_len, self._slide = int(win_len), int(slide)
+        return self
+
+    def withTBWindows(self, win_usec: int, slide_usec: int):
+        self._win_type = WinType.TB
+        self._win_len, self._slide = int(win_usec), int(slide_usec)
+        return self
+
+    def withLateness(self, lateness_usec: int):
+        self._lateness = int(lateness_usec)
+        return self
+
+    def _spec(self) -> WindowSpec:
+        if self._win_type is None:
+            raise WindFlowError(
+                "window operator needs withCBWindows or withTBWindows")
+        if self._win_len <= 0 or self._slide <= 0:
+            raise WindFlowError("window length and slide must be > 0")
+        return WindowSpec(self._win_type, self._win_len, self._slide,
+                          self._lateness)
+
+
+def _detect_incremental(fn) -> bool:
+    """Non-incremental window logic takes the item list (arity 1);
+    incremental logic takes (tuple, accumulator) (arity 2) — the Python
+    analogue of the reference's type-based dispatch (meta.hpp)."""
+    return _positional_arity(fn) == 2
+
+
+class Keyed_Windows_Builder(_WindowBuilderBase):
+    _default_name = "keyed_windows"
+
+    def __init__(self, fn):
+        super().__init__()
+        self._fn = fn
+
+    def build(self) -> KeyedWindows:
+        return KeyedWindows(
+            self._fn, self._spec(), name=self._name,
+            parallelism=self._parallelism, key_extractor=self._key_extractor,
+            incremental=_detect_incremental(self._fn),
+            output_batch_size=self._output_batch_size)
+
+
+class Parallel_Windows_Builder(_WindowBuilderBase):
+    _default_name = "parallel_windows"
+
+    def __init__(self, fn):
+        super().__init__()
+        self._fn = fn
+
+    def build(self) -> ParallelWindows:
+        return ParallelWindows(
+            self._fn, self._spec(), name=self._name,
+            parallelism=self._parallelism, key_extractor=self._key_extractor,
+            incremental=_detect_incremental(self._fn),
+            output_batch_size=self._output_batch_size)
+
+
+class Paned_Windows_Builder(_WindowBuilderBase):
+    _default_name = "paned_windows"
+
+    def __init__(self, plq_fn, wlq_fn):
+        super().__init__()
+        self._plq_fn = plq_fn
+        self._wlq_fn = wlq_fn
+        self._wlq_parallelism = 1
+
+    def withParallelisms(self, plq: int, wlq: int):
+        self._parallelism = plq
+        self._wlq_parallelism = wlq
+        return self
+
+    def build(self) -> PanedWindows:
+        return PanedWindows(
+            self._plq_fn, self._wlq_fn, self._spec(),
+            name=self._name,
+            plq_parallelism=self._parallelism,
+            wlq_parallelism=self._wlq_parallelism,
+            key_extractor=self._key_extractor,
+            plq_incremental=_detect_incremental(self._plq_fn),
+            wlq_incremental=_detect_incremental(self._wlq_fn),
+            output_batch_size=self._output_batch_size)
+
+
+class MapReduce_Windows_Builder(_WindowBuilderBase):
+    _default_name = "mapreduce_windows"
+
+    def __init__(self, map_fn, reduce_fn):
+        super().__init__()
+        self._map_fn = map_fn
+        self._reduce_fn = reduce_fn
+        self._reduce_parallelism = 1
+
+    def withParallelisms(self, map_p: int, reduce_p: int):
+        self._parallelism = map_p
+        self._reduce_parallelism = reduce_p
+        return self
+
+    def build(self) -> MapReduceWindows:
+        return MapReduceWindows(
+            self._map_fn, self._reduce_fn, self._spec(),
+            name=self._name,
+            map_parallelism=self._parallelism,
+            reduce_parallelism=self._reduce_parallelism,
+            key_extractor=self._key_extractor,
+            map_incremental=_detect_incremental(self._map_fn),
+            reduce_incremental=_detect_incremental(self._reduce_fn),
+            output_batch_size=self._output_batch_size)
+
+
+class Ffat_Windows_Builder(_WindowBuilderBase):
+    _default_name = "ffat_windows"
+
+    def __init__(self, lift_fn, comb_fn):
+        super().__init__()
+        self._lift = lift_fn
+        self._comb = comb_fn
+
+    def build(self) -> FfatWindows:
+        return FfatWindows(
+            self._lift, self._comb, self._spec(),
+            name=self._name,
+            parallelism=self._parallelism, key_extractor=self._key_extractor,
+            lateness=self._lateness,
+            output_batch_size=self._output_batch_size)
+
+
+class Ffat_WindowsTPU_Builder(_WindowBuilderBase):
+    """Reference ``Ffat_WindowsGPU_Builder`` (builders_gpu.hpp:576); the
+    ``withNumWinPerBatch`` knob is unnecessary here — every window a batch
+    completes is computed in the one fused program.  Supports both CB
+    windows (rank panes) and TB windows (time-quantum panes + watermark
+    firing; lateness applies)."""
+
+    _default_name = "ffat_windows_tpu"
+
+    def __init__(self, lift_fn, comb_fn):
+        super().__init__()
+        self._lift = lift_fn
+        self._comb = comb_fn
+        self._max_keys = 1
+        self._pane_capacity = None
+        self._overflow_policy = "drop"
+
+    def withMaxKeys(self, n: int):
+        """Size of the dense device key space [0, n)."""
+        self._max_keys = int(n)
+        return self
+
+    def withPaneCapacity(self, n: int):
+        """TB only: length of the on-device pane ring (window span panes
+        plus slack for the time spread of in-flight batches; default
+        ``max(2*R, R+64)``)."""
+        self._pane_capacity = int(n)
+        return self
+
+    def withOverflowPolicy(self, policy: str):
+        """TB ring-overflow behavior: ``"drop"`` (default — suppress windows
+        that lost data panes, count them in Windows_dropped_on_overflow),
+        ``"count"`` (fire them over surviving panes only; wrong aggregates,
+        surfaced via Pane_cells_evicted), or ``"error"`` (raise at the next
+        host checkpoint)."""
+        self._overflow_policy = policy
+        return self
+
+    def build(self) -> FfatWindowsTPU:
+        return FfatWindowsTPU(
+            self._lift, self._comb, self._spec(), max_keys=self._max_keys,
+            name=self._name,
+            parallelism=self._parallelism,
+            key_extractor=self._key_extractor,
+            pane_capacity=self._pane_capacity,
+            overflow_policy=self._overflow_policy)

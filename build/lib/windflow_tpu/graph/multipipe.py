@@ -1,0 +1,173 @@
+"""MultiPipe: a linear (then split/merged) composition of operators.
+
+Re-design of the reference ``MultiPipe`` (``/root/reference/wf/multipipe.hpp``).
+The reference implements composition with nested FastFlow all-to-all building
+blocks ("matrioskas", ``multipipe.hpp:502-514``); here a MultiPipe simply
+records the operator sequence and routing, and the PipeGraph wires replica
+inboxes/emitters at ``run()`` — the dataflow structure is metadata for a host
+driver, not a thread topology.
+
+Operator chaining (reference ``chain_operator``, ``multipipe.hpp:553-569``,
+thread fusion) maps to program fusion: chained TPU operators compose their
+traced functions into one XLA program (see ``windflow_tpu.ops.chained``), which
+is strictly cheaper than the reference's same-thread fusion — XLA fuses the
+loops themselves.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+from windflow_tpu.basic import RoutingMode, WindFlowError
+from windflow_tpu.ops.base import Operator
+from windflow_tpu.ops.sink import Sink
+from windflow_tpu.ops.source import Source
+
+
+class MultiPipe:
+    def __init__(self, graph: "PipeGraph", source: Source) -> None:
+        self.graph = graph
+        self.operators: List[Operator] = [source]
+        self.has_sink = False
+        self.has_source = True
+        self.merged_into: Optional["MultiPipe"] = None
+        self.split_children: List["MultiPipe"] = []
+        self.split_fn = None
+        self.split_parent: Optional["MultiPipe"] = None
+        self.merge_parents: List["MultiPipe"] = []
+        # Edges are (upstream_op, downstream_op, routing) triples resolved at
+        # wiring time; intra-pipe edges are implicit in `operators` order.
+
+    @classmethod
+    def _empty(cls, graph: "PipeGraph") -> "MultiPipe":
+        """A source-less pipe: a split branch or a merge result."""
+        mp = cls.__new__(cls)
+        mp.graph = graph
+        mp.operators = []
+        mp.has_sink = False
+        mp.has_source = False
+        mp.merged_into = None
+        mp.split_children = []
+        mp.split_fn = None
+        mp.split_parent = None
+        mp.merge_parents = []
+        return mp
+
+    # -- composition ---------------------------------------------------------
+    def _check_open(self):
+        if self.has_sink:
+            raise WindFlowError("cannot extend a MultiPipe after its sink")
+        if self.split_children:
+            raise WindFlowError("cannot extend a split MultiPipe directly; "
+                                "extend its branches")
+        if self.merged_into is not None:
+            raise WindFlowError("cannot extend a merged MultiPipe")
+
+    def add(self, op: Operator) -> "MultiPipe":
+        """Append an operator with a shuffle/forward connection (reference
+        ``MultiPipe::add``, ``multipipe.hpp:936-1027``)."""
+        if hasattr(op, "stages"):
+            # composite window operators expand into their pipeline stages
+            # (reference adds PLQ+WLQ / MAP+REDUCE as two operators,
+            # multipipe.hpp:965-999)
+            for stage in op.stages():
+                self.add(stage)
+            return self
+        self._check_open()
+        if isinstance(op, Source):
+            raise WindFlowError("a Source can only start a MultiPipe")
+        for prev in self._upstream_ops():
+            if op.is_tpu and prev.output_batch_size <= 0 and not prev.is_tpu:
+                raise WindFlowError(
+                    f"TPU operator '{op.name}' must be preceded by an "
+                    "operator with output batch size > 0 (reference "
+                    "multipipe.hpp:441-444)")
+        self.operators.append(op)
+        return self
+
+    def _upstream_ops(self) -> List[Operator]:
+        """Operators feeding the next appended operator: the pipe's own tail,
+        or — for a fresh split branch / merged pipe — the tails of the parent
+        pipes (the reference resolves these via the Application Tree,
+        ``pipegraph.hpp:268-464``)."""
+        if self.operators:
+            return [self.operators[-1]]
+        if self.split_parent is not None:
+            return self.split_parent._upstream_ops()
+        if self.merge_parents:
+            return [p.operators[-1] for p in self.merge_parents
+                    if p.operators]
+        return []
+
+    def chain(self, op: Operator) -> "MultiPipe":
+        """Fuse ``op`` with the previous stage when possible: same parallelism
+        and FORWARD routing (reference conditions, ``multipipe.hpp:553``);
+        otherwise falls back to ``add`` exactly like the reference."""
+        from windflow_tpu.ops.reduce_op import Reduce
+        if hasattr(op, "stages") or isinstance(op, Reduce) \
+                or not self.operators:
+            # composites and Reduce cannot be chained (multipipe.hpp:1042-1045);
+            # a fresh split branch / merged pipe has nothing to fuse with
+            return self.add(op)
+        prev = self.operators[-1]
+        can_fuse = (op.routing == RoutingMode.FORWARD
+                    and op.parallelism == prev.parallelism
+                    and not isinstance(prev, Source)
+                    and prev.is_tpu == op.is_tpu
+                    and type(prev).__name__ in _FUSABLE
+                    and type(op).__name__ in _FUSABLE)
+        if can_fuse:
+            from windflow_tpu.ops.chained import fuse
+            self.operators[-1] = fuse(prev, op)
+            return self
+        return self.add(op)
+
+    def add_sink(self, sink: Sink) -> "MultiPipe":
+        self.add(sink)
+        self.has_sink = True
+        return self
+
+    def chain_sink(self, sink: Sink) -> "MultiPipe":
+        self.chain(sink)
+        self.has_sink = True
+        return self
+
+    # -- DAG composition (reference multipipe.hpp:1158-1303) -----------------
+    def split(self, split_fn, n_branches: int) -> "MultiPipe":
+        """Split this MultiPipe into ``n_branches`` children; ``split_fn(item)``
+        returns a destination index or an iterable of indexes."""
+        self._check_open()
+        if not self.operators:
+            raise WindFlowError(
+                "cannot split an empty MultiPipe — add an operator to this "
+                "branch first")
+        self.split_fn = split_fn
+        for _ in range(n_branches):
+            child = MultiPipe._empty(self.graph)
+            child.split_parent = self
+            self.split_children.append(child)
+        self.graph._register_split(self)
+        return self
+
+    def select(self, index: int) -> "MultiPipe":
+        if not self.split_children:
+            raise WindFlowError("select() on a MultiPipe that was not split")
+        return self.split_children[index]
+
+    def merge(self, *others: "MultiPipe") -> "MultiPipe":
+        """Merge this MultiPipe with others into a new one (reference
+        ``MultiPipe::merge`` + PipeGraph LCA logic)."""
+        pipes = [self, *others]
+        for p in pipes:
+            p._check_open()
+        merged = MultiPipe._empty(self.graph)
+        merged.merge_parents = pipes
+        for p in pipes:
+            p.merged_into = merged
+        self.graph._register_merge(merged)
+        return merged
+
+
+#: Operator type names that participate in chain fusion.
+_FUSABLE = {"Map", "Filter", "FlatMap", "ChainedHost",
+            "MapTPU", "FilterTPU", "ChainedTPU"}

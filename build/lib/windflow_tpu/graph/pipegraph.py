@@ -1,0 +1,318 @@
+"""PipeGraph: application container, wiring, and the host driver loop.
+
+Re-design of the reference ``PipeGraph`` (``/root/reference/wf/pipegraph.hpp``).
+``run()`` in the reference spawns one OS thread per replica/collector through
+FastFlow (``pipegraph.hpp:614-697``); here it wires replica inboxes, emitters
+and collectors, then drives everything from a **single cooperative dispatch
+loop**.  On TPU the host's only job is to keep compiled programs and transfers
+enqueued — JAX dispatch is asynchronous, so while the device crunches batch N
+the loop is already staging N+1; thread-per-replica would add contention, not
+parallelism (SURVEY.md §7 design stance; and see parallel/mesh.py for how
+replication maps to chips instead).
+
+End of run mirrors ``PipeGraph::wait_end`` (``pipegraph.hpp:703-768``): EOS
+punctuations cascade, window state flushes, and per-operator stats JSON is
+dumped when tracing is enabled.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from typing import List, Optional
+
+from windflow_tpu.basic import (Config, ExecutionMode, RoutingMode, TimePolicy,
+                                WindFlowError, default_config)
+from windflow_tpu.graph.multipipe import MultiPipe
+from windflow_tpu.ops.base import Operator
+from windflow_tpu.ops.source import Source, SourceReplica
+from windflow_tpu.parallel.collectors import KSlackCollector, create_collector
+from windflow_tpu.parallel.emitters import SplittingEmitter, create_emitter
+
+
+def _rss_kb() -> float:
+    """Resident set size in KiB (reference ``get_MemUsage``,
+    ``monitoring.hpp:52-70``)."""
+    try:
+        with open("/proc/self/statm") as f:
+            resident_pages = int(f.read().split()[1])
+        return resident_pages * (os.sysconf("SC_PAGE_SIZE") / 1024.0)
+    except (OSError, ValueError, IndexError):
+        return 0.0
+
+
+class PipeGraph:
+    def __init__(self, name: str = "app",
+                 mode: ExecutionMode = ExecutionMode.DEFAULT,
+                 time_policy: TimePolicy = TimePolicy.INGRESS,
+                 config: Optional[Config] = None) -> None:
+        self.name = name
+        self.mode = mode
+        self.time_policy = time_policy
+        self.config = config or dataclasses.replace(default_config)
+        self.pipes: List[MultiPipe] = []
+        self._splits: List[MultiPipe] = []
+        self._merges: List[MultiPipe] = []
+        self._started = False
+        self._collectors = []
+        self._all_replicas = []
+        self._source_replicas: List[SourceReplica] = []
+        self._operators: List[Operator] = []
+        self._monitor = None
+        # backpressure telemetry (high-water marks + throttle count)
+        self._throttle_events = 0
+        self._max_inbox_seen = 0
+        self._max_inflight_device_seen = 0
+
+    # -- construction --------------------------------------------------------
+    def add_source(self, source: Source) -> MultiPipe:
+        if self._started:
+            raise WindFlowError("cannot add sources to a running PipeGraph")
+        mp = MultiPipe(self, source)
+        self.pipes.append(mp)
+        return mp
+
+    def _register_split(self, mp: MultiPipe) -> None:
+        self._splits.append(mp)
+
+    def _register_merge(self, mp: MultiPipe) -> None:
+        self._merges.append(mp)
+        self.pipes.append(mp)
+
+    # -- wiring --------------------------------------------------------------
+    def _all_pipes(self):
+        """Every MultiPipe in the graph, including transitive split branches
+        (the single traversal used by both replica construction and edge
+        wiring, so the two can never diverge)."""
+        out = []
+
+        def collect(mp: MultiPipe):
+            out.append(mp)
+            for child in mp.split_children:
+                collect(child)
+
+        for mp in self.pipes:
+            collect(mp)
+        return out
+
+    def _edges(self):
+        """Yield (src_op, dst_op_or_split, routing) for every graph edge, in
+        topological order of the MultiPipe DAG."""
+        edges = []
+        for mp in self._all_pipes():
+            ops = mp.operators
+            for a, b in zip(ops, ops[1:]):
+                edges.append(("op", a, b))
+            if mp.split_children:
+                edges.append(("split", mp))
+        for merged in self._merges:
+            for parent in merged.merge_parents:
+                src = parent.operators[-1] if parent.operators else None
+                if src is None:
+                    raise WindFlowError("cannot merge an empty MultiPipe")
+                edges.append(("op", src, merged.operators[0]))
+        return edges
+
+    def _build(self) -> None:
+        # 1. instantiate replicas
+        seen = set()
+        for mp in self._all_pipes():
+            for op in mp.operators:
+                if id(op) not in seen:
+                    seen.add(id(op))
+                    self._operators.append(op)
+                    op.mesh = self.config.mesh
+                    op.build_replicas(self.mode, self.time_policy)
+        for op in self._operators:
+            self._all_replicas.extend(op.replicas)
+            if isinstance(op, Source):
+                self._source_replicas.extend(op.replicas)
+        for rep in self._all_replicas:
+            rep.config = self.config
+
+        # 2. wire edges: emitters on sources of the edge, collectors +
+        #    channels on destinations
+        def wire_edge(src_op: Operator, dst_op: Operator):
+            emitters = []
+            for src_rep in src_op.replicas:
+                dests = [(dst_rep, dst_rep.add_channel())
+                         for dst_rep in dst_op.replicas]
+                em = create_emitter(
+                    dst_op.routing, dests, src_op.output_batch_size,
+                    src_is_tpu=src_op.is_tpu, dst_is_tpu=dst_op.is_tpu,
+                    key_extractor=dst_op.key_extractor,
+                    mesh=self.config.mesh)
+                emitters.append(em)
+            return emitters
+
+        for edge in self._edges():
+            if edge[0] == "op":
+                _, a, b = edge
+                for rep, em in zip(a.replicas, wire_edge(a, b)):
+                    rep.emitter = em
+            else:  # split point
+                _, mp = edge
+                src_op = mp.operators[-1]
+                branch_heads = [child.operators[0]
+                                for child in mp.split_children]
+                per_src_branch_emitters = [
+                    wire_edge(src_op, head) for head in branch_heads]
+                # transpose: one SplittingEmitter per source replica
+                for i, rep in enumerate(src_op.replicas):
+                    branches = [per_src_branch_emitters[b_idx][i]
+                                for b_idx in range(len(branch_heads))]
+                    rep.emitter = SplittingEmitter(mp.split_fn, branches)
+
+        # 3. collectors: one per replica with input channels
+        for rep in self._all_replicas:
+            if rep.num_channels > 0:
+                rep.collector = create_collector(self.mode, rep.num_channels)
+                self._collectors.append(rep.collector)
+
+        # sanity: every non-sink replica must have an emitter
+        for op in self._operators:
+            for rep in op.replicas:
+                if rep.emitter is None and not op.is_terminal:
+                    raise WindFlowError(
+                        f"operator '{op.name}' has no downstream consumer — "
+                        "every MultiPipe must end in a Sink")
+
+    # -- execution -----------------------------------------------------------
+    def run(self) -> "PipeGraph":
+        """Build, then drive the whole graph to completion (the reference's
+        ``run()`` + ``wait_end()`` pair collapsed into one call; a streaming
+        deployment would call :meth:`step` from its own loop)."""
+        self.start()
+        while not self.is_done():
+            if not self.step():
+                raise WindFlowError(
+                    "PipeGraph stalled: no replica made progress but the "
+                    "graph has not terminated (routing bug?)")
+        self._finalize()
+        return self
+
+    def start(self) -> None:
+        if self._started:
+            raise WindFlowError("PipeGraph already started")
+        self._started = True
+        self._build()
+        if self.config.tracing_enabled:
+            # reference: tracing spawns a MonitoringThread at run()
+            # (pipegraph.hpp:676-678)
+            from windflow_tpu.monitoring.monitor import MonitoringThread
+            self._monitor = MonitoringThread(self)
+            self._monitor.start()
+        for sr in self._source_replicas:
+            sr.start()
+
+    def step(self) -> bool:
+        """One scheduler sweep: pull a chunk from each live source (unless
+        backpressured), then drain every replica in topological order.
+        Returns True on any progress."""
+        progress = False
+        throttled = self._backpressured()
+        if throttled:
+            # Source ticks are deferred this sweep: downstream inboxes are at
+            # the in-transit cap (reference: allocateBatch_GPU_t blocks on
+            # FullGPUMemoryException, recycling_gpu.hpp:88-126).  Draining
+            # below continues, so the graph keeps moving.
+            self._throttle_events += 1
+        for sr in self._source_replicas:
+            if not sr.exhausted and not throttled:
+                if sr.tick(self._tick_chunk(sr)):
+                    progress = True
+                # Cadence punctuation keeps watermarks advancing on idle
+                # streams.  Skipped while throttled: a punctuation flushes
+                # the emitter's open batch first (the watermark must never
+                # overtake buffered data), which would ship a data batch
+                # into inboxes already at the cap.  Under backpressure data
+                # is in flight anyway, so watermarks advance with it.
+                sr.maybe_punctuate()
+        limit = self.config.sweep_drain_limit
+        for rep in self._all_replicas:
+            if rep.drain(limit):
+                progress = True
+        if not progress:
+            # Sources were deferred but nothing drained (e.g. limit=0 edge
+            # cases): force one tick so the graph cannot deadlock on its own
+            # throttle.
+            for sr in self._source_replicas:
+                if not sr.exhausted and sr.tick(self._tick_chunk(sr)):
+                    progress = True
+        return progress
+
+    def _tick_chunk(self, sr) -> int:
+        return self.config.source_tick_chunk \
+            or sr.op.output_batch_size or 256
+
+    def _backpressured(self) -> bool:
+        """True when any replica inbox is at the in-transit cap.  Also folds
+        the high-water marks reported by :meth:`stats`."""
+        cfg = self.config
+        hit = False
+        for rep in self._all_replicas:
+            depth = len(rep.inbox)
+            if depth > self._max_inbox_seen:
+                self._max_inbox_seen = depth
+            if rep.inflight_device > self._max_inflight_device_seen:
+                self._max_inflight_device_seen = rep.inflight_device
+            if rep.inflight_device >= cfg.max_inflight_batches \
+                    or depth >= cfg.max_inbox_messages:
+                hit = True
+        return hit
+
+    def is_done(self) -> bool:
+        return all(r.done for r in self._all_replicas)
+
+    def _finalize(self) -> None:
+        if self._monitor is not None:
+            self._monitor.stop()
+            self._monitor = None
+        if self.config.tracing_enabled:
+            self.dump_stats()
+
+    # -- introspection (reference pipegraph.hpp:721-789) ---------------------
+    def get_num_dropped_tuples(self) -> int:
+        return sum(c.num_dropped for c in self._collectors) \
+            + sum(op.num_dropped_tuples() for op in self._operators)
+
+    def to_dot(self) -> str:
+        """Graphviz DOT diagram of the graph (reference
+        ``pipegraph.hpp:560-576``)."""
+        from windflow_tpu.monitoring.diagram import to_dot
+        return to_dot(self)
+
+    def stats(self) -> dict:
+        """Stats report; schema follows the reference's dashboard JSON
+        (``pipegraph.hpp:468-526``).  The fixed reference fields describe the
+        FastFlow runtime; here they describe the host driver equivalents."""
+        return {
+            "PipeGraph_name": self.name,
+            "Mode": self.mode.value,
+            # in-transit batch throttling (see _backpressured): source ticks
+            # are deferred while any inbox is at the cap
+            "Backpressure": f"ON (max_inflight_batches="
+                            f"{self.config.max_inflight_batches}, "
+                            f"max_inbox_messages="
+                            f"{self.config.max_inbox_messages})",
+            "Backpressure_throttle_events": self._throttle_events,
+            "Max_inbox_depth_seen": self._max_inbox_seen,
+            "Max_inflight_device_batches_seen":
+                self._max_inflight_device_seen,
+            "Non_blocking": "ON",     # async XLA dispatch
+            "Thread_pinning": "OFF",  # single dispatch loop, no pinning
+            "Dropped_tuples": self.get_num_dropped_tuples(),
+            "Operator_number": len(self._operators),
+            "Thread_number": 1 + (1 if self._monitor is not None else 0),
+            "rss_size_kb": _rss_kb(),
+            "Operators": [op.dump_stats() for op in self._operators],
+        }
+
+    def dump_stats(self, log_dir: Optional[str] = None) -> str:
+        d = log_dir or self.config.log_dir
+        os.makedirs(d, exist_ok=True)
+        path = os.path.join(d, f"{self.name}_stats.json")
+        with open(path, "w") as f:
+            json.dump(self.stats(), f, indent=2)
+        return path

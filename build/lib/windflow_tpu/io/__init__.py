@@ -1,0 +1,3 @@
+"""Bulk IO: native-parsed ingestion sources (the framework's data loaders)."""
+
+from windflow_tpu.io.frames import FrameSource

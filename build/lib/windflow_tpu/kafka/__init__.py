@@ -1,0 +1,12 @@
+"""Kafka integration (reference ``/root/reference/wf/kafka/`` — SURVEY.md
+§2.7): Kafka_Source / Kafka_Sink operators, KafkaRuntimeContext, fluent
+builders, and a client layer with an in-process broker for tests plus a
+gated adapter for real clusters."""
+
+from windflow_tpu.kafka.builders_kafka import (KafkaSink_Builder,
+                                               KafkaSource_Builder)
+from windflow_tpu.kafka.client import (ConsumerClient, InMemoryBroker,
+                                       KafkaMessage, ProducerClient)
+from windflow_tpu.kafka.kafka_context import KafkaRuntimeContext
+from windflow_tpu.kafka.kafka_sink import KafkaSink, KafkaSinkMessage
+from windflow_tpu.kafka.kafka_source import KafkaSource

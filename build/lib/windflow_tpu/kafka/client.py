@@ -1,0 +1,364 @@
+"""Kafka client layer: message type, abstract consumer/producer, an
+in-process broker for tests/local runs, and a gated adapter for a real
+client library.
+
+The reference binds directly to librdkafka (``/root/reference/wf/kafka/
+kafka_source.hpp:57-123`` consumer + rebalance callback, ``kafka_sink.hpp:86``
+per-replica producer).  Here the operators talk to a small client interface
+so the same operator code runs against:
+
+* :class:`InMemoryBroker` — an in-process broker with topics, partitions and
+  consumer groups (partition assignment + cooperative rebalance), used by
+  the test suite exactly as the reference's Kafka tests use a live local
+  broker;
+* ``confluent_kafka`` — when the library is installed (it is not baked into
+  this image, so the adapter import-gates; see ``make_consumer``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+import threading
+from typing import Any, Dict, Iterable, List, Optional, Sequence, Tuple
+
+from windflow_tpu.basic import WindFlowError, current_time_usecs
+
+
+@dataclasses.dataclass
+class KafkaMessage:
+    """One consumed record (reference ``RdKafka::Message`` surface the user
+    deserializer touches: topic/partition/offset/key/payload/timestamp)."""
+    topic: str
+    partition: int
+    offset: int
+    key: Optional[bytes]
+    value: Any
+    timestamp_usec: int
+
+
+class ConsumerClient:
+    def subscribe(self, topics: Sequence[str], group_id: str,
+                  offsets: Optional[Sequence[int]] = None) -> None:
+        raise NotImplementedError
+
+    def poll(self, max_msgs: int) -> List[KafkaMessage]:
+        raise NotImplementedError
+
+    def assignment(self) -> List[Tuple[str, int]]:
+        raise NotImplementedError
+
+    def close(self) -> None:
+        raise NotImplementedError
+
+
+class ProducerClient:
+    def produce(self, topic: str, value: Any, key: Optional[bytes] = None,
+                partition: Optional[int] = None,
+                timestamp_usec: Optional[int] = None) -> None:
+        raise NotImplementedError
+
+    def flush(self) -> None:
+        raise NotImplementedError
+
+    def close(self) -> None:
+        raise NotImplementedError
+
+
+# ---------------------------------------------------------------------------
+# In-process broker
+# ---------------------------------------------------------------------------
+
+class _Partition:
+    __slots__ = ("log",)
+
+    def __init__(self) -> None:
+        self.log: List[KafkaMessage] = []
+
+
+class InMemoryBroker:
+    """Topics × partitions with consumer-group assignment.
+
+    Rebalance model: joining or leaving a group recomputes the round-robin
+    assignment of every subscribed (topic, partition) over the group's
+    members in join order; read positions live with the *group* (per
+    topic-partition), so a partition handed to another member resumes where
+    the previous owner stopped — the in-process analogue of the reference's
+    cooperative incremental rebalance (``kafka_source.hpp:77-123``)."""
+
+    def __init__(self) -> None:
+        self._topics: Dict[str, List[_Partition]] = {}
+        self._groups: Dict[str, "_Group"] = {}
+        self._lock = threading.Lock()
+        self._rr = itertools.count()
+
+    # -- admin ---------------------------------------------------------------
+    def create_topic(self, name: str, num_partitions: int = 1) -> None:
+        with self._lock:
+            if name in self._topics:
+                if len(self._topics[name]) != num_partitions:
+                    raise WindFlowError(
+                        f"topic '{name}' already exists with "
+                        f"{len(self._topics[name])} partitions")
+                return
+            self._topics[name] = [_Partition()
+                                  for _ in range(num_partitions)]
+            self._rebalance_subscribers(name)
+
+    def _rebalance_subscribers(self, topic: str) -> None:
+        """New topic (explicit or auto-created by produce): groups already
+        subscribed to it must pick up its partitions, like a metadata
+        refresh on a real broker.  Caller holds the lock."""
+        for g in self._groups.values():
+            if any(topic in m._topics for m in g.members):
+                g.rebalance(self)
+
+    def partitions(self, topic: str) -> int:
+        with self._lock:
+            if topic not in self._topics:
+                raise WindFlowError(f"unknown topic '{topic}'")
+            return len(self._topics[topic])
+
+    def topic_size(self, topic: str) -> int:
+        with self._lock:
+            return sum(len(p.log) for p in self._topics.get(topic, ()))
+
+    # -- produce -------------------------------------------------------------
+    def _append(self, topic: str, value: Any, key: Optional[bytes],
+                partition: Optional[int], ts: Optional[int]) -> None:
+        with self._lock:
+            parts = self._topics.get(topic)
+            if parts is None:
+                parts = self._topics[topic] = [_Partition()]
+                self._rebalance_subscribers(topic)
+            if partition is None:
+                if key is not None:
+                    partition = hash(key) % len(parts)
+                else:
+                    partition = next(self._rr) % len(parts)
+            if not 0 <= partition < len(parts):
+                raise WindFlowError(
+                    f"partition {partition} out of range for '{topic}'")
+            p = parts[partition]
+            p.log.append(KafkaMessage(
+                topic=topic, partition=partition, offset=len(p.log), key=key,
+                value=value,
+                timestamp_usec=ts if ts is not None else current_time_usecs()))
+
+    # -- clients -------------------------------------------------------------
+    def producer(self) -> "InMemoryProducer":
+        return InMemoryProducer(self)
+
+    def consumer(self) -> "InMemoryConsumer":
+        return InMemoryConsumer(self)
+
+
+class _Group:
+    def __init__(self) -> None:
+        self.members: List["InMemoryConsumer"] = []
+        # group-held read positions: (topic, partition) -> next offset
+        self.positions: Dict[Tuple[str, int], int] = {}
+
+    def rebalance(self, broker: InMemoryBroker) -> None:
+        tps: List[Tuple[str, int]] = []
+        topics = sorted({t for m in self.members for t in m._topics})
+        for t in topics:
+            for p in range(len(broker._topics.get(t, ()))):
+                tps.append((t, p))
+        for m in self.members:
+            m._assignment = []
+        for i, tp in enumerate(tps):
+            owners = [m for m in self.members if tp[0] in m._topics]
+            if owners:
+                owners[i % len(owners)]._assignment.append(tp)
+
+
+class InMemoryProducer(ProducerClient):
+    def __init__(self, broker: InMemoryBroker) -> None:
+        self._broker = broker
+        self.produced = 0
+
+    def produce(self, topic, value, key=None, partition=None,
+                timestamp_usec=None):
+        self._broker._append(topic, value, key, partition, timestamp_usec)
+        self.produced += 1
+
+    def flush(self) -> None:
+        pass  # appends are synchronous
+
+    def close(self) -> None:
+        pass
+
+
+class InMemoryConsumer(ConsumerClient):
+    def __init__(self, broker: InMemoryBroker) -> None:
+        self._broker = broker
+        self._group: Optional[_Group] = None
+        self._group_id: Optional[str] = None
+        self._topics: List[str] = []
+        self._assignment: List[Tuple[str, int]] = []
+        self._next_part = 0
+        self._closed = False
+
+    def subscribe(self, topics, group_id, offsets=None):
+        with self._broker._lock:
+            self._topics = list(topics)
+            self._group_id = group_id
+            g = self._broker._groups.setdefault(group_id, _Group())
+            self._group = g
+            if self not in g.members:
+                g.members.append(self)
+            # explicit starting offsets: one per topic, -1 = keep current
+            # (reference rebalance-callback offset override,
+            # kafka_source.hpp:81-91)
+            if offsets:
+                for t, off in zip(topics, offsets):
+                    if off is not None and off > -1:
+                        for p in range(len(self._broker._topics.get(t, ()))):
+                            g.positions[(t, p)] = off
+            g.rebalance(self._broker)
+
+    def poll(self, max_msgs: int) -> List[KafkaMessage]:
+        if self._group is None:
+            raise WindFlowError("poll before subscribe")
+        out: List[KafkaMessage] = []
+        with self._broker._lock:
+            n_parts = len(self._assignment)
+            for _ in range(n_parts):
+                if len(out) >= max_msgs:
+                    break
+                tp = self._assignment[self._next_part % n_parts]
+                self._next_part += 1
+                t, p = tp
+                log = self._broker._topics[t][p].log
+                pos = self._group.positions.get(tp, 0)
+                take = min(max_msgs - len(out), len(log) - pos)
+                if take > 0:
+                    out.extend(log[pos:pos + take])
+                    self._group.positions[tp] = pos + take
+        return out
+
+    def assignment(self) -> List[Tuple[str, int]]:
+        return list(self._assignment)
+
+    def close(self) -> None:
+        if self._closed or self._group is None:
+            return
+        self._closed = True
+        with self._broker._lock:
+            self._group.members.remove(self)
+            self._group.rebalance(self._broker)
+
+
+# ---------------------------------------------------------------------------
+# Real-client adapters (gated: confluent_kafka is not in this image)
+# ---------------------------------------------------------------------------
+
+def _require_confluent():
+    try:
+        import confluent_kafka  # noqa: F401
+        return confluent_kafka
+    except ImportError as e:
+        raise WindFlowError(
+            "connecting to a real Kafka broker requires the "
+            "'confluent_kafka' package, which is not installed; pass an "
+            "InMemoryBroker for in-process streaming") from e
+
+
+class ConfluentConsumer(ConsumerClient):
+    """Thin adapter over confluent_kafka.Consumer (librdkafka underneath —
+    the same library the reference binds)."""
+
+    def __init__(self, brokers: str) -> None:
+        self._ck = _require_confluent()
+        self._brokers = brokers
+        self._consumer = None
+
+    def subscribe(self, topics, group_id, offsets=None):
+        conf = {"bootstrap.servers": self._brokers,
+                "group.id": group_id,
+                "auto.offset.reset": "earliest",
+                "partition.assignment.strategy": "cooperative-sticky"}
+        self._consumer = self._ck.Consumer(conf)
+        if offsets:
+            tp = self._ck.TopicPartition
+
+            def on_assign(consumer, partitions):
+                for part in partitions:
+                    try:
+                        off = offsets[topics.index(part.topic)]
+                    except (ValueError, IndexError):
+                        continue
+                    if off is not None and off > -1:
+                        part.offset = off
+                consumer.incremental_assign(partitions)
+
+            self._consumer.subscribe(list(topics), on_assign=on_assign)
+        else:
+            self._consumer.subscribe(list(topics))
+
+    def poll(self, max_msgs: int) -> List[KafkaMessage]:
+        out = []
+        for _ in range(max_msgs):
+            msg = self._consumer.poll(0)
+            if msg is None:
+                break
+            if msg.error():
+                continue
+            ts_type, ts_ms = msg.timestamp()
+            out.append(KafkaMessage(
+                topic=msg.topic(), partition=msg.partition(),
+                offset=msg.offset(), key=msg.key(), value=msg.value(),
+                timestamp_usec=ts_ms * 1000 if ts_type else
+                current_time_usecs()))
+        return out
+
+    def assignment(self):
+        return [(p.topic, p.partition)
+                for p in self._consumer.assignment()]
+
+    def close(self):
+        if self._consumer is not None:
+            self._consumer.close()
+
+
+class ConfluentProducer(ProducerClient):
+    def __init__(self, brokers: str) -> None:
+        self._ck = _require_confluent()
+        self._producer = self._ck.Producer({"bootstrap.servers": brokers})
+
+    def produce(self, topic, value, key=None, partition=None,
+                timestamp_usec=None):
+        kwargs = {}
+        if partition is not None:
+            kwargs["partition"] = partition
+        if timestamp_usec is not None:
+            kwargs["timestamp"] = timestamp_usec // 1000
+        while True:
+            try:
+                self._producer.produce(topic, value=value, key=key, **kwargs)
+                break
+            except BufferError:
+                # librdkafka's delivery queue is full: service callbacks
+                # until there is room (sustained backpressure can take
+                # several poll rounds)
+                self._producer.poll(1.0)
+        self._producer.poll(0)  # service delivery callbacks as we go
+
+    def flush(self):
+        self._producer.flush()
+
+    def close(self):
+        self.flush()
+
+
+def make_consumer(brokers) -> ConsumerClient:
+    if isinstance(brokers, InMemoryBroker):
+        return brokers.consumer()
+    return ConfluentConsumer(str(brokers))
+
+
+def make_producer(brokers) -> ProducerClient:
+    if isinstance(brokers, InMemoryBroker):
+        return brokers.producer()
+    return ConfluentProducer(str(brokers))

@@ -1,0 +1,66 @@
+"""Kafka_Sink operator (reference ``/root/reference/wf/kafka/
+kafka_sink.hpp:71,229``): terminal operator producing each tuple to Kafka
+through a per-replica producer (``kafka_sink.hpp:86,123-132``).
+
+The user serializer runs per tuple:
+``fn(item[, kafka_ctx]) -> KafkaSinkMessage | None`` — ``None`` drops the
+tuple (produces nothing); otherwise the returned message names the topic,
+payload and optional partition/key (reference serializer returns
+topic+payload, ``kafka_sink.hpp:179-182``).  The producer is flushed at EOS.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Optional
+
+from windflow_tpu.basic import RoutingMode
+from windflow_tpu.kafka.client import make_producer
+from windflow_tpu.kafka.kafka_context import KafkaRuntimeContext
+from windflow_tpu.meta import adapt
+from windflow_tpu.ops.base import Operator, Replica
+
+
+@dataclasses.dataclass
+class KafkaSinkMessage:
+    """What the serializer returns (reference ``wf_kafka_sink_msg``)."""
+    topic: str
+    payload: Any
+    partition: Optional[int] = None
+    key: Optional[bytes] = None
+
+
+class KafkaSinkReplica(Replica):
+    def __init__(self, op: "KafkaSink", index: int) -> None:
+        super().__init__(op, index)
+        self._fn = adapt(op.ser_fn, 1)
+        self._producer = make_producer(op.brokers)
+        self.context = KafkaRuntimeContext(
+            op.parallelism, index, op.name, producer=self._producer)
+
+    def process_single(self, item, ts, wm):
+        msg = self._fn(item, self.context)
+        if msg is None:
+            return
+        self.stats.outputs_sent += 1
+        self._producer.produce(msg.topic, msg.payload, key=msg.key,
+                               partition=msg.partition,
+                               timestamp_usec=ts)
+
+    def on_eos(self):
+        self._producer.flush()
+        self._producer.close()
+
+
+class KafkaSink(Operator):
+    replica_class = KafkaSinkReplica
+    is_terminal = True
+
+    def __init__(self, ser_fn: Callable, brokers,
+                 name: str = "kafka_sink", parallelism: int = 1,
+                 routing: RoutingMode = RoutingMode.FORWARD,
+                 key_extractor=None) -> None:
+        super().__init__(name, parallelism, routing=routing,
+                         key_extractor=key_extractor)
+        self.ser_fn = ser_fn
+        self.brokers = brokers

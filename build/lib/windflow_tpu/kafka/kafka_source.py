@@ -1,0 +1,122 @@
+"""Kafka_Source operator (reference ``/root/reference/wf/kafka/
+kafka_source.hpp:127,355``).
+
+Each replica owns one consumer joined to the operator's consumer group, so
+topic partitions spread across replicas and rebalance when replicas come
+and go — exactly the reference's per-replica ``KafkaConsumer`` with the
+cooperative rebalance callback (``kafka_source.hpp:57-123``).
+
+The user deserializer runs per consumed message:
+``fn(msg: KafkaMessage | None, shipper[, kafka_ctx]) -> bool | None`` —
+``None`` msg means the consumer has been idle for ``idle_time_usec``
+(reference ``consume(idleTime)`` timeout path); returning ``False`` stops
+this replica (its EOS then flows through the graph).  Any other return
+continues.  The shipper mirrors ``Source_Shipper``: ``push`` (ingress
+timestamping) and ``pushWithTimestamp`` (event time).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional, Sequence
+
+from windflow_tpu.basic import WindFlowError, current_time_usecs
+from windflow_tpu.batch import WM_NONE
+from windflow_tpu.kafka.client import make_consumer
+from windflow_tpu.kafka.kafka_context import KafkaRuntimeContext
+from windflow_tpu.meta import adapt
+from windflow_tpu.ops.source import Source, SourceReplica
+
+
+class KafkaShipper:
+    """Push interface handed to the deserializer (reference
+    ``Source_Shipper``, ``source_shipper.hpp:59-``)."""
+
+    __slots__ = ("_replica",)
+
+    def __init__(self, replica: "KafkaSourceReplica") -> None:
+        self._replica = replica
+
+    def push(self, item: Any) -> None:
+        r = self._replica
+        ts = current_time_usecs()
+        if ts <= r._last_ts:
+            ts = r._last_ts + 1
+        self.pushWithTimestamp(item, ts)
+
+    def pushWithTimestamp(self, item: Any, ts: int) -> None:
+        r = self._replica
+        r._last_ts = max(r._last_ts, int(ts))
+        r._advance_wm(r._last_ts)
+        r.stats.outputs_sent += 1
+        r.emitter.emit(item, int(ts), r.current_wm)
+        r._count_toward_punctuation(1)
+
+
+class KafkaSourceReplica(SourceReplica):
+    def __init__(self, op: "KafkaSource", index: int) -> None:
+        super().__init__(op, index)
+        self._fn = adapt(op.deser_fn, 2)
+        self._shipper = KafkaShipper(self)
+        self._consumer = None
+        self._last_activity = 0
+
+    def start(self) -> None:
+        self._consumer = make_consumer(self.op.brokers)
+        self._consumer.subscribe(self.op.topics, self.op.group_id,
+                                 self.op.offsets)
+        # riched deserializers see a KafkaRuntimeContext (reference passes
+        # KafkaRuntimeContext instead of RuntimeContext, kafka_source.hpp:134)
+        self.context = KafkaRuntimeContext(
+            self.op.parallelism, self.index, self.op.name,
+            consumer=self._consumer)
+        self._last_activity = current_time_usecs()
+
+    def tick(self, max_items: int) -> bool:
+        if self._exhausted:
+            return False
+        msgs = self._consumer.poll(max_items)
+        run = True
+        if msgs:
+            self._last_activity = current_time_usecs()
+            for msg in msgs:
+                ret = self._fn(msg, self._shipper, self.context)
+                self.stats.inputs_received += 1
+                if ret is False:
+                    run = False
+                    break
+        else:
+            now = current_time_usecs()
+            if now - self._last_activity >= self.op.idle_time_usec:
+                self._last_activity = now
+                ret = self._fn(None, self._shipper, self.context)
+                if ret is False:
+                    run = False
+        if not run:
+            self._exhausted = True
+            self._consumer.close()
+            self._terminate()
+            return True  # termination (EOS cascade) is progress
+        return True
+
+
+class KafkaSource(Source):
+    replica_class = KafkaSourceReplica
+
+    def __init__(self, deser_fn: Callable, brokers, topics: Sequence[str],
+                 group_id: str = "windflow",
+                 offsets: Optional[Sequence[int]] = None,
+                 idle_time_usec: int = 100_000,
+                 name: str = "kafka_source", parallelism: int = 1,
+                 output_batch_size: int = 0) -> None:
+        if not topics:
+            raise WindFlowError("Kafka_Source needs at least one topic")
+        # bypass Source.__init__'s generator plumbing; Operator init only
+        super().__init__(gen_fn=lambda: iter(()), name=name,
+                         parallelism=parallelism,
+                         output_batch_size=output_batch_size)
+        self.deser_fn = deser_fn
+        self.brokers = brokers
+        self.topics = list(topics)
+        self.group_id = group_id
+        self.offsets = list(offsets) if offsets is not None else None
+        self.idle_time_usec = idle_time_usec

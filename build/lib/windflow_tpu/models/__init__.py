@@ -1,0 +1,5 @@
+"""Example streaming applications built on windflow_tpu — the application
+set the reference's evaluation papers benchmark (DSPBench-style WordCount,
+SpikeDetection) plus the flagship TPU FFAT analytics pipeline."""
+
+from windflow_tpu.models import ffat_analytics, spike_detection, wordcount

@@ -1,0 +1,62 @@
+"""FFAT analytics: the flagship TPU pipeline (the north-star benchmark
+shape, BASELINE.md) packaged as a reusable application.
+
+``Source → MapTPU → FilterTPU → FfatWindowsTPU → Sink``: staged columnar
+batches, bf16-friendly elementwise transform and predicate fused on device,
+and per-key sliding-window aggregation over the on-device FlatFAT pane tree
+— every fired window of every key computed in one XLA program per batch.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Iterable, List, Optional, Tuple
+
+import windflow_tpu as wf
+
+
+def build(records: Iterable[dict],
+          on_window: Optional[Callable] = None,
+          *, win_len: int = 1024, slide: int = 128, max_keys: int = 1024,
+          batch: int = 4096,
+          transform: Optional[Callable] = None,
+          predicate: Optional[Callable] = None,
+          lift: Optional[Callable] = None,
+          comb: Optional[Callable] = None) -> wf.PipeGraph:
+    """Records are dicts of scalars with an int ``k`` key field and a float
+    ``v`` value field (arbitrary extra lanes ride along)."""
+    transform = transform or (
+        lambda t: {"k": t["k"], "v": t["v"] * 1.5 + 1.0})
+    predicate = predicate or (lambda t: (t["k"] & 7) != 7)
+    lift = lift or (lambda t: t["v"])
+    comb = comb or (lambda a, b: a + b)
+
+    def emit(res, ctx=None):
+        if res is not None and on_window is not None:
+            on_window(res)
+
+    src = (wf.Source_Builder(lambda: iter(records)).withName("ingest")
+           .withOutputBatchSize(batch).build())
+    mp = wf.MapTPU_Builder(transform).withName("transform").build()
+    flt = wf.FilterTPU_Builder(predicate).withName("select").build()
+    ffat = (wf.Ffat_WindowsTPU_Builder(lift, comb)
+            .withName("ffat")
+            .withCBWindows(win_len, slide)
+            .withKeyBy(lambda t: t["k"])
+            .withMaxKeys(max_keys).build())
+    sink = wf.Sink_Builder(emit).withName("windows_out").build()
+
+    g = wf.PipeGraph("ffat_analytics", wf.ExecutionMode.DEFAULT)
+    pipe = g.add_source(src)
+    pipe.chain(mp)          # chained TPU stages fuse into one XLA program
+    pipe.chain(flt)
+    pipe.add(ffat).add_sink(sink)
+    return g
+
+
+def run(records: Iterable[dict], **kwargs) -> List[dict]:
+    """Run to completion; returns window records
+    ``{"key": int, "wid": int, "value": float}``."""
+    results: List[dict] = []
+    g = build(records, on_window=results.append, **kwargs)
+    g.run()
+    return results

@@ -1,0 +1,57 @@
+"""WordCount: the canonical streaming benchmark application (used by the
+reference's evaluation papers, DSPBench suite).
+
+``Source(lines) → FlatMap(split) → keyed Reduce(count) → Sink`` — exercises
+FlatMap shipping, KEYBY routing and rolling keyed state.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, Iterable, Optional
+
+import windflow_tpu as wf
+
+
+def build(lines: Iterable[str],
+          on_count: Optional[Callable[[str, int], None]] = None,
+          source_parallelism: int = 1,
+          splitter_parallelism: int = 1,
+          counter_parallelism: int = 2,
+          batch: int = 0) -> wf.PipeGraph:
+    """Build the WordCount graph.  ``on_count(word, count)`` observes every
+    updated (word, count) pair leaving the counter."""
+
+    def split(line, shipper):
+        for w in line.split():
+            shipper.push(w.lower())
+
+    def count(word, state):
+        state["word"] = word
+        state["n"] = state.get("n", 0) + 1
+
+    def emit(state, ctx=None):
+        if state is not None and on_count is not None:
+            on_count(state["word"], state["n"])
+
+    src = (wf.Source_Builder(lambda: iter(lines)).withName("line_source")
+           .withParallelism(source_parallelism)
+           .withOutputBatchSize(batch).build())
+    splitter = (wf.FlatMap_Builder(split).withName("splitter")
+                .withParallelism(splitter_parallelism)
+                .withOutputBatchSize(batch).build())
+    counter = (wf.Reduce_Builder(count, dict).withName("counter")
+               .withParallelism(counter_parallelism)
+               .withKeyBy(lambda w: w).build())
+    sink = wf.Sink_Builder(emit).withName("count_sink").build()
+
+    g = wf.PipeGraph("wordcount", wf.ExecutionMode.DEFAULT)
+    g.add_source(src).add(splitter).add(counter).add_sink(sink)
+    return g
+
+
+def run(lines: Iterable[str], **kwargs) -> Dict[str, int]:
+    """Run WordCount to completion; returns the final word→count table."""
+    counts: Dict[str, int] = {}
+    g = build(lines, on_count=lambda w, n: counts.__setitem__(w, n), **kwargs)
+    g.run()
+    return counts

@@ -1,0 +1,112 @@
+"""Monitoring thread speaking the reference dashboard protocol.
+
+Wire-compatible re-implementation of the reference ``MonitoringThread``
+(``/root/reference/wf/monitoring.hpp:160-295``): a background thread samples
+the graph once per second and ships reports to the dashboard server over a
+length-prefixed TCP protocol (default ``localhost:20207``):
+
+* ``NEW_APP``  (type 0): preamble ``[type, len]`` (two big-endian int32) +
+  NUL-terminated SVG diagram; ack ``[status, identifier]``.
+* ``NEW_REPORT`` (type 1): preamble ``[type, identifier, len]`` + NUL-
+  terminated JSON stats; ack ``[status, _]``.
+* ``END_APP`` (type 2): same framing as NEW_REPORT, sent once at the end.
+
+Like the reference (``monitoring.hpp:197-200``), the thread switches itself
+off quietly if the dashboard is unreachable or any send fails — monitoring
+must never take the pipeline down.
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import struct
+import threading
+import time
+
+SAMPLE_INTERVAL_SEC = 1.0
+TYPE_NEW_APP = 0
+TYPE_NEW_REPORT = 1
+TYPE_END_APP = 2
+
+
+def recv_exact(sock: socket.socket, n: int) -> bytes:
+    """Read exactly ``n`` bytes (shared by both protocol ends)."""
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("peer closed the connection")
+        buf += chunk
+    return buf
+
+
+class MonitoringThread:
+    def __init__(self, graph) -> None:
+        self.graph = graph
+        self.identifier = -1
+        self._sock = None
+        self._thread = None
+        self._stop = threading.Event()
+        self.active = False
+
+    # -- protocol ------------------------------------------------------------
+    def _register_app(self) -> None:
+        from windflow_tpu.monitoring.diagram import to_svg
+        payload = to_svg(self.graph).encode() + b"\0"
+        self._sock.sendall(struct.pack(">ii", TYPE_NEW_APP, len(payload)))
+        self._sock.sendall(payload)
+        status, ident = struct.unpack(">ii", recv_exact(self._sock, 8))
+        if status != 0:
+            raise ConnectionError(f"dashboard rejected NEW_APP: {status}")
+        self.identifier = ident
+
+    def _send_report(self, msg_type: int) -> None:
+        payload = json.dumps(self.graph.stats()).encode() + b"\0"
+        self._sock.sendall(struct.pack(">iii", msg_type, self.identifier,
+                                          len(payload)))
+        self._sock.sendall(payload)
+        status, _ = struct.unpack(">ii", recv_exact(self._sock, 8))
+        if status != 0:
+            raise ConnectionError(f"dashboard rejected report: {status}")
+
+    # -- thread --------------------------------------------------------------
+    def _run(self) -> None:
+        try:
+            self._sock = socket.create_connection(
+                (self.graph.config.dashboard_host,
+                 self.graph.config.dashboard_port), timeout=2.0)
+            self._register_app()
+        except OSError:
+            self.active = False
+            return  # reference: "Monitoring thread switched off"
+        self.active = True
+        try:
+            last = time.monotonic()
+            # Check ~20×/s: fine-grained enough for END_APP latency without
+            # stealing GIL time from the dispatch loop (the reference's
+            # usleep(100) spin is cheap only because its poll is off-GIL).
+            while not self._stop.wait(0.05) and not self.graph.is_done():
+                now = time.monotonic()
+                if now - last >= SAMPLE_INTERVAL_SEC:
+                    self._send_report(TYPE_NEW_REPORT)
+                    last = now
+            self._send_report(TYPE_END_APP)
+        except OSError:
+            pass
+        finally:
+            self.active = False
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="wf-monitoring")
+        self._thread.start()
+
+    def stop(self, timeout: float = 5.0) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout)

@@ -1,0 +1,63 @@
+"""Per-replica statistics (reference ``/root/reference/wf/stats_record.hpp:47-165``).
+
+The reference records inputs/outputs/bytes and service times per replica, plus
+GPU kernel-launch counts and H2D/D2H byte counts for device replicas
+(``stats_record.hpp:80-82,152-160``).  The TPU equivalents map one-to-one:
+compiled-program dispatches for kernel launches, stage/fetch bytes for the
+transfer counters.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+
+from windflow_tpu.basic import current_time_usecs
+
+
+@dataclasses.dataclass
+class StatsRecord:
+    operator_name: str = ""
+    replica_index: int = 0
+    is_tpu: bool = False
+    start_time_usec: int = dataclasses.field(default_factory=current_time_usecs)
+    inputs_received: int = 0
+    inputs_ignored: int = 0   # e.g. late tuples at window operators
+    outputs_sent: int = 0
+    # Service-time accounting (reference startStatsRecording/endStatsRecording,
+    # basic_operator.hpp:133-158).
+    service_time_usec: float = 0.0
+    num_service_samples: int = 0
+    # Device-side counters (reference GPU extensions of Stats_Record).
+    device_programs_launched: int = 0
+    h2d_bytes: int = 0
+    d2h_bytes: int = 0
+    _t0: float = 0.0
+
+    def start_sample(self) -> None:
+        self._t0 = time.perf_counter()
+
+    def end_sample(self) -> None:
+        self.service_time_usec += (time.perf_counter() - self._t0) * 1e6
+        self.num_service_samples += 1
+
+    def avg_service_time_usec(self) -> float:
+        if self.num_service_samples == 0:
+            return 0.0
+        return self.service_time_usec / self.num_service_samples
+
+    def to_json(self) -> dict:
+        """Schema kept close to the reference's per-replica JSON dump
+        (``basic_operator.hpp:292-317``) for dashboard compatibility."""
+        return {
+            "Replica_id": self.replica_index,
+            "Starting_time_usec": self.start_time_usec,
+            "Inputs_received": self.inputs_received,
+            "Inputs_ignored": self.inputs_ignored,
+            "Outputs_sent": self.outputs_sent,
+            "Service_time_usec": round(self.avg_service_time_usec(), 3),
+            "Is_terminated": True,
+            "Device_programs_launched": self.device_programs_launched,
+            "Bytes_H2D": self.h2d_bytes,
+            "Bytes_D2H": self.d2h_bytes,
+        }

@@ -1,0 +1,141 @@
+"""Single-page dashboard UI (reference: React SPA under
+``dashboard/web_client/src/Pages/Dashboard.js`` — app list, graph view,
+per-operator charts).  Served by :mod:`windflow_tpu.monitoring.dashboard`
+at ``GET /`` as one static page of vanilla HTML+JS polling the JSON
+endpoints; no build step, no external assets (works offline)."""
+
+INDEX_HTML = """<!DOCTYPE html>
+<html>
+<head>
+<meta charset="utf-8">
+<title>windflow_tpu dashboard</title>
+<style>
+  body { font-family: system-ui, sans-serif; margin: 0; display: flex;
+         height: 100vh; color: #222; }
+  #apps { width: 220px; border-right: 1px solid #ddd; padding: 12px;
+          overflow-y: auto; }
+  #apps h2, #main h2 { font-size: 15px; margin: 4px 0 10px; }
+  .app { padding: 6px 8px; border-radius: 6px; cursor: pointer;
+         margin-bottom: 4px; font-size: 13px; }
+  .app:hover { background: #f0f4ff; }
+  .app.sel { background: #dbe7ff; }
+  .dead { color: #999; }
+  #main { flex: 1; padding: 14px 18px; overflow-y: auto; }
+  table { border-collapse: collapse; font-size: 12px; margin-top: 6px; }
+  td, th { border: 1px solid #e3e3e3; padding: 3px 8px; text-align: right; }
+  th { background: #f7f7f7; }
+  td:first-child, th:first-child { text-align: left; }
+  .spark { vertical-align: middle; }
+  #meta { font-size: 12px; color: #555; margin-bottom: 8px; }
+  pre { background: #f7f7f7; padding: 8px; font-size: 11px;
+        overflow-x: auto; }
+  details { margin-top: 12px; }
+</style>
+</head>
+<body>
+<div id="apps"><h2>Applications</h2><div id="applist">loading…</div></div>
+<div id="main"><h2 id="title">select an application</h2>
+  <div id="meta"></div>
+  <div id="ops"></div>
+  <details><summary>graph diagram</summary><div id="diagram"></div></details>
+</div>
+<script>
+let sel = null;
+
+// every server-supplied string passes through esc() before innerHTML:
+// app names, operator names, and diagrams arrive from arbitrary TCP
+// clients and must never execute as markup in the viewer's browser
+function esc(s) {
+  return String(s).replace(/&/g, "&amp;").replace(/</g, "&lt;")
+                  .replace(/>/g, "&gt;").replace(/"/g, "&quot;");
+}
+
+function spark(values, w, h) {
+  if (values.length < 2) return "";
+  const max = Math.max(...values, 1e-9);
+  const pts = values.map((v, i) =>
+    `${(i / (values.length - 1) * w).toFixed(1)},` +
+    `${(h - v / max * (h - 2)).toFixed(1)}`).join(" ");
+  return `<svg class="spark" width="${w}" height="${h}">` +
+         `<polyline points="${pts}" fill="none" stroke="#4169e1" ` +
+         `stroke-width="1.5"/></svg>`;
+}
+
+async function poll() {
+  try {
+    const apps = await (await fetch("/apps")).json();
+    const el = document.getElementById("applist");
+    el.innerHTML = apps.map(a =>
+      `<div class="app ${a.id === sel ? "sel" : ""} ${a.alive ? "" : "dead"}"
+            onclick="select(${a.id})">#${a.id} ${esc(a.name)}` +
+      `${a.alive ? "" : " (ended)"}<br><small>${a.num_reports} reports` +
+      `</small></div>`).join("") || "no applications yet";
+    if (sel !== null) await render(sel);
+  } catch (e) { /* server restarting */ }
+  setTimeout(poll, 1000);
+}
+
+function select(id) { sel = id; render(id); loadDiagram(id); }
+
+async function render(id) {
+  const app = await (await fetch(`/apps/${id}`)).json();
+  const reports = app.reports || [];
+  document.getElementById("title").textContent =
+    `#${id} ${app.name} — ${reports.length} reports`;  // textContent: safe
+  if (!reports.length) return;
+  const last = reports[reports.length - 1];
+  document.getElementById("meta").textContent =
+    `mode=${last.Mode}  operators=${last.Operator_number}  ` +
+    `dropped=${last.Dropped_tuples}  rss=${last.rss_size_kb} kB  ` +
+    `throttle_events=${last.Backpressure_throttle_events}`;
+  // per-operator throughput history: delta Outputs_sent between reports
+  const hist = {};
+  let prev = null;
+  for (const r of reports) {
+    const byOp = {};
+    for (const op of (r.Operators || [])) {
+      let out = 0;
+      for (const rep of (op.Replicas || [])) out += rep.Outputs_sent || 0;
+      byOp[op.Operator_name || op.Name || "?"] = out;
+    }
+    if (prev) {
+      for (const [name, out] of Object.entries(byOp)) {
+        (hist[name] = hist[name] || []).push(
+          Math.max(0, out - (prev[name] || 0)));
+      }
+    }
+    prev = byOp;
+  }
+  const lastOps = reports[reports.length - 1].Operators || [];
+  document.getElementById("ops").innerHTML =
+    `<table><tr><th>operator</th><th>replicas</th><th>outputs</th>` +
+    `<th>ignored</th><th>throughput (tuples/report)</th></tr>` +
+    lastOps.map(op => {
+      const name = op.Operator_name || op.Name || "?";
+      const reps = (op.Replicas || []);
+      const outs = reps.reduce((s, r) => s + (r.Outputs_sent || 0), 0);
+      const ign = reps.reduce((s, r) => s + (r.Inputs_ignored || 0), 0);
+      const h = hist[name] || [];
+      const cur = h.length ? h[h.length - 1] : 0;
+      return `<tr><td>${esc(name)}</td><td>${reps.length}</td>` +
+             `<td>${outs}</td><td>${ign}</td>` +
+             `<td>${spark(h.slice(-60), 160, 26)} ${cur}</td></tr>`;
+    }).join("") + "</table>";
+}
+
+async function loadDiagram(id) {
+  const txt = await (await fetch(`/apps/${id}/diagram`)).text();
+  const el = document.getElementById("diagram");
+  if (txt.trimStart().startsWith("<svg")) {
+    // embed via <img>: SVG in an img element never runs scripts
+    el.innerHTML = `<img src="/apps/${id}/diagram" alt="graph">`;
+  } else {
+    el.innerHTML = `<pre>${esc(txt)}</pre>`;   // DOT source
+  }
+}
+
+poll();
+</script>
+</body>
+</html>
+"""

@@ -1,0 +1,9 @@
+from windflow_tpu.ops.base import Operator, Replica
+from windflow_tpu.ops.filter_op import Filter
+from windflow_tpu.ops.flatmap_op import FlatMap, Shipper
+from windflow_tpu.ops.map_op import Map
+from windflow_tpu.ops.reduce_op import Reduce
+from windflow_tpu.ops.sink import Sink
+from windflow_tpu.ops.source import Source
+from windflow_tpu.ops.tpu import FilterTPU, MapTPU, ReduceTPU
+from windflow_tpu.ops.tpu_stateful import StatefulFilterTPU, StatefulMapTPU

@@ -1,0 +1,230 @@
+"""Operator and replica base classes.
+
+Re-design of the reference's ``Basic_Operator`` / ``Basic_Replica``
+(``/root/reference/wf/basic_operator.hpp:54-235,246-381``).  The structural
+difference is the execution vehicle: a reference replica is a FastFlow node
+with its own OS thread (``svc()`` called by the runtime); here a replica is a
+plain object whose ``drain()`` is called by the host driver's cooperative
+scheduler (graph/pipegraph.py).  On TPU the heavy lifting happens inside
+compiled XLA programs, so dedicating host threads per replica buys nothing —
+one dispatch loop keeps the chip fed (SURVEY.md §7 design stance).
+
+End-of-stream follows the reference protocol (``eosnotify`` cascade,
+``basic_operator.hpp:180-189``): an EOS punctuation per input channel; when
+all channels have delivered EOS, the replica flushes operator state, flushes
+its emitter, forwards EOS, and terminates.
+"""
+
+from __future__ import annotations
+
+import copy
+from collections import deque
+from typing import Any, Callable, List, Optional
+
+from windflow_tpu.basic import (ExecutionMode, RoutingMode, TimePolicy,
+                                WindFlowError, default_config)
+from windflow_tpu.batch import DeviceBatch, HostBatch, Punctuation, WM_MAX, WM_NONE
+from windflow_tpu.context import RuntimeContext
+from windflow_tpu.monitoring.stats import StatsRecord
+from windflow_tpu.parallel.collectors import Collector
+from windflow_tpu.parallel.emitters import Emitter
+
+
+class Replica:
+    """One logical replica of an operator (reference ``Basic_Replica``)."""
+
+    #: replicas whose user function may mutate its input copy shared
+    #: (multicast) tuples before processing (reference ``copyOnWrite``,
+    #: ``map.hpp:57-215``)
+    copy_on_shared = False
+
+    def __init__(self, op: "Operator", index: int) -> None:
+        self.op = op
+        self.index = index
+        self.context = RuntimeContext(op.parallelism, index, op.name)
+        self.inbox: deque = deque()
+        #: outstanding device batches in this inbox — the per-operator
+        #: in-transit count the host driver throttles against (reference
+        #: ``inTransit_counter``, ``recycling_gpu.hpp:88-126``)
+        self.inflight_device = 0
+        self.collector: Optional[Collector] = None  # wired by the graph
+        self.emitter: Optional[Emitter] = None      # wired by the graph
+        self.config = default_config                # PipeGraph overrides
+        self.num_channels = 0
+        self._eos_channels = set()
+        self.done = False
+        self.current_wm = WM_NONE
+        self._hooked_wm = WM_NONE   # last watermark passed to on_watermark
+        self.stats = StatsRecord(operator_name=op.name, replica_index=index,
+                                 is_tpu=op.is_tpu)
+        self.mode = ExecutionMode.DEFAULT
+        self.time_policy = TimePolicy.INGRESS
+
+    # -- wiring -------------------------------------------------------------
+    def add_channel(self) -> int:
+        ch = self.num_channels
+        self.num_channels += 1
+        return ch
+
+    # -- runtime ------------------------------------------------------------
+    def receive(self, channel: int, msg) -> None:
+        self.inbox.append((channel, msg))
+        if isinstance(msg, DeviceBatch):
+            self.inflight_device += 1
+
+    def drain(self, limit: int = 0) -> bool:
+        """Process pending inbox messages (at most ``limit`` when > 0; the
+        driver bounds per-sweep work so sibling replicas interleave fairly,
+        approximating the reference's thread-parallel arrival order).
+        Returns True if any progress was made."""
+        progressed = False
+        n = 0
+        while self.inbox:
+            if limit and n >= limit:
+                break
+            n += 1
+            channel, msg = self.inbox.popleft()
+            if isinstance(msg, DeviceBatch):
+                self.inflight_device -= 1
+            progressed = True
+            if isinstance(msg, Punctuation) and msg.is_eos:
+                self._handle_channel_eos(channel)
+                continue
+            for ready in self.collector.on_message(channel, msg):
+                self._dispatch(ready)
+        return progressed
+
+    def _handle_channel_eos(self, channel: int) -> None:
+        if channel in self._eos_channels:
+            return
+        self._eos_channels.add(channel)
+        for ready in self.collector.on_channel_eos(channel):
+            self._dispatch(ready)
+        if len(self._eos_channels) == self.num_channels:
+            self._terminate()
+
+    def _terminate(self) -> None:
+        if self.done:
+            return
+        self.on_eos()
+        if self.emitter is not None:
+            self.emitter.flush(self.current_wm)
+            self.emitter.propagate_punctuation(WM_MAX)
+        self.done = True
+
+    def _dispatch(self, msg) -> None:
+        if isinstance(msg, Punctuation):
+            self._advance_wm(msg.watermark)
+            self._maybe_hook_wm()
+            if self.emitter is not None:
+                self.emitter.propagate_punctuation(self.current_wm)
+            return
+        self.stats.start_sample()
+        if isinstance(msg, DeviceBatch):
+            self._advance_wm(msg.watermark)
+            self.stats.inputs_received += msg.known_size or 0
+            self.process_device_batch(msg)
+        else:
+            assert isinstance(msg, HostBatch)
+            self._advance_wm(msg.watermark)
+            self.stats.inputs_received += len(msg)
+            # Copy-on-write: a multicast batch is shared by sibling replicas;
+            # an in-place-capable operator must mutate a private copy
+            # (reference ``copyOnWrite``, ``map.hpp:57-215``).
+            cow = msg.shared and self.copy_on_shared
+            for item, ts in zip(msg.items, msg.tss):
+                if cow:
+                    item = copy.deepcopy(item)
+                self.context._set_context(ts, msg.watermark)
+                self.process_single(item, ts, msg.watermark)
+        self._maybe_hook_wm()
+        self.stats.end_sample()
+
+    def _maybe_hook_wm(self) -> None:
+        # only invoke the (potentially O(open windows)) hook on a real advance
+        if self.current_wm != self._hooked_wm:
+            self._hooked_wm = self.current_wm
+            self.on_watermark(self.current_wm)
+
+    def _advance_wm(self, wm: int) -> None:
+        if wm != WM_NONE and wm > self.current_wm:
+            self.current_wm = wm
+
+    # -- operator logic (overridden by concrete replicas) --------------------
+    def process_single(self, item: Any, ts: int, wm: int) -> None:
+        raise WindFlowError(
+            f"operator '{self.op.name}' cannot consume host tuples")
+
+    def process_device_batch(self, batch: DeviceBatch) -> None:
+        raise WindFlowError(
+            f"operator '{self.op.name}' cannot consume device batches; "
+            "insert a host stage or mark the upstream edge for staging")
+
+    def on_eos(self) -> None:
+        """Flush hook: window firing, sink finalization, etc."""
+
+    def on_watermark(self, wm: int) -> None:
+        """Watermark-advance hook (fires time windows past the frontier)."""
+
+
+class Operator:
+    """Descriptor for one operator in the graph (reference
+    ``Basic_Operator``): name, parallelism, input routing mode, output batch
+    size, and whether its compute runs on TPU."""
+
+    #: subclasses set this to their replica class
+    replica_class = Replica
+    #: terminal operators (sinks) have no emitter / downstream consumer
+    is_terminal = False
+
+    def __init__(self, name: str, parallelism: int,
+                 routing: RoutingMode = RoutingMode.FORWARD,
+                 output_batch_size: int = 0,
+                 is_tpu: bool = False,
+                 key_extractor: Optional[Callable] = None) -> None:
+        if parallelism < 1:
+            raise WindFlowError(
+                f"operator '{name}' must have parallelism >= 1")
+        self.name = name
+        self.parallelism = parallelism
+        self.routing = routing
+        self.output_batch_size = output_batch_size
+        self.is_tpu = is_tpu
+        self.key_extractor = key_extractor
+        self.replicas: List[Replica] = []
+        #: jax Mesh for multi-chip execution; set by PipeGraph._build from
+        #: Config.mesh.  Mesh-aware operators compile sharded programs when
+        #: this is not None (parallel/mesh.py).
+        self.mesh = None
+
+    @property
+    def is_keyed(self) -> bool:
+        return self.routing == RoutingMode.KEYBY
+
+    def build_replicas(self, mode: ExecutionMode,
+                       time_policy: TimePolicy) -> List[Replica]:
+        if self.is_tpu and mode != ExecutionMode.DEFAULT:
+            # Parity: reference builders reject GPU operators outside DEFAULT
+            # mode (SURVEY.md §2.5 structural invariants).
+            raise WindFlowError(
+                f"TPU operator '{self.name}' requires DEFAULT execution mode")
+        self.replicas = [self.replica_class(self, i)
+                        for i in range(self.parallelism)]
+        for r in self.replicas:
+            r.mode = mode
+            r.time_policy = time_policy
+        return self.replicas
+
+    def num_dropped_tuples(self) -> int:
+        """Tuples this operator dropped beyond collector-level drops (e.g.
+        out-of-range keys on the mesh reduce, late tuples on TB windows);
+        folded into PipeGraph.get_num_dropped_tuples."""
+        return 0
+
+    def dump_stats(self) -> dict:
+        return {
+            "Operator_name": self.name,
+            "Operator_type": type(self).__name__,
+            "Parallelism": self.parallelism,
+            "Replicas": [r.stats.to_json() for r in self.replicas],
+        }

@@ -1,0 +1,168 @@
+"""Operator chaining (fusion).
+
+The reference fuses same-parallelism FORWARD operators into one thread
+(``/root/reference/wf/multipipe.hpp:553-569`` via ``combine_with_laststage``) to
+save queue hops.  Here fusion has two forms, both cheaper than thread fusion:
+
+* Host operators compose into one :class:`ChainedHost` replica — a closure
+  pipeline with zero intermediate batching.
+* TPU operators compose into one :class:`ChainedTPU` whose stages trace into a
+  **single XLA program**, so map/filter chains fuse into one pass over HBM —
+  the TPU analogue the reference cannot express (each CUDA op is a separate
+  kernel launch even when chained).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, List, Tuple
+
+import jax
+
+from windflow_tpu.basic import RoutingMode, WindFlowError
+from windflow_tpu.batch import DeviceBatch
+from windflow_tpu.meta import adapt
+from windflow_tpu.ops.base import Operator, Replica
+from windflow_tpu.ops.filter_op import Filter
+from windflow_tpu.ops.flatmap_op import FlatMap
+from windflow_tpu.ops.map_op import Map
+from windflow_tpu.ops.tpu import FilterTPU, MapTPU, _TPUReplica
+
+
+# ---------------------------------------------------------------------------
+# Host-side fusion
+# ---------------------------------------------------------------------------
+
+def _host_specs(op) -> List[Tuple[str, Callable]]:
+    if isinstance(op, ChainedHost):
+        return op.specs
+    if isinstance(op, Map):
+        return [("map", adapt(op.fn, 1))]
+    if isinstance(op, Filter):
+        return [("filter", adapt(op.fn, 1))]
+    if isinstance(op, FlatMap):
+        return [("flatmap", adapt(op.fn, 2))]
+    raise WindFlowError(f"cannot chain operator type {type(op).__name__}")
+
+
+class _ChainShipper:
+    __slots__ = ("call", "ts", "wm", "ctx")
+
+    def __init__(self):
+        self.call = None
+        self.ts = 0
+        self.wm = 0
+        self.ctx = None
+
+    def push(self, item):
+        self.call(item, self.ts, self.wm, self.ctx)
+
+
+class ChainedHostReplica(Replica):
+    copy_on_shared = True  # fused map/filter stages may mutate in place
+
+    def __init__(self, op: "ChainedHost", index: int) -> None:
+        super().__init__(op, index)
+
+        def tail(item, ts, wm, ctx):
+            self.stats.outputs_sent += 1
+            self.emitter.emit(item, ts, wm)
+
+        call = tail
+        for kind, fn in reversed(op.specs):
+            call = self._make_stage(kind, fn, call)
+        self._head = call
+
+    def _make_stage(self, kind, fn, nxt):
+        if kind == "map":
+            def stage(item, ts, wm, ctx):
+                out = fn(item, ctx)
+                nxt(out if out is not None else item, ts, wm, ctx)
+        elif kind == "filter":
+            def stage(item, ts, wm, ctx):
+                if fn(item, ctx):
+                    nxt(item, ts, wm, ctx)
+        else:  # flatmap
+            shipper = _ChainShipper()
+            shipper.call = nxt
+
+            def stage(item, ts, wm, ctx):
+                shipper.ts = ts
+                shipper.wm = wm
+                shipper.ctx = ctx
+                fn(item, shipper, ctx)
+        return stage
+
+    def process_single(self, item, ts, wm):
+        self._head(item, ts, wm, self.context)
+
+
+class ChainedHost(Operator):
+    replica_class = ChainedHostReplica
+
+    def __init__(self, specs, name, parallelism, routing, output_batch_size,
+                 key_extractor):
+        super().__init__(name, parallelism, routing=routing,
+                         output_batch_size=output_batch_size,
+                         key_extractor=key_extractor)
+        self.specs = specs
+
+
+# ---------------------------------------------------------------------------
+# TPU-side fusion: one XLA program for the whole chain
+# ---------------------------------------------------------------------------
+
+def _tpu_specs(op):
+    if isinstance(op, ChainedTPU):
+        return op.specs
+    if isinstance(op, MapTPU):
+        if op.batch_fn:
+            return [("batch_map", op.fn)]
+        return [("map", op.fn)]
+    if isinstance(op, FilterTPU):
+        return [("filter", op.fn)]
+    raise WindFlowError(f"cannot chain TPU operator type {type(op).__name__}")
+
+
+class ChainedTPUReplica(_TPUReplica):
+    pass
+
+
+class ChainedTPU(Operator):
+    replica_class = ChainedTPUReplica
+
+    def __init__(self, specs, name, parallelism, routing, key_extractor):
+        super().__init__(name, parallelism, routing=routing, is_tpu=True,
+                         key_extractor=key_extractor)
+        self.specs = specs
+        self._has_filter = any(k == "filter" for k, _ in specs)
+
+        @jax.jit
+        def step(payload, valid):
+            for kind, fn in specs:
+                if kind == "map":
+                    payload = jax.vmap(fn)(payload)
+                elif kind == "batch_map":
+                    payload = fn(payload, valid)
+                else:
+                    valid = valid & jax.vmap(fn)(payload)
+            return payload, valid
+
+        self._jit_step = step
+
+    def _step(self, batch: DeviceBatch) -> DeviceBatch:
+        payload, valid = self._jit_step(batch.payload, batch.valid)
+        size = None if self._has_filter else batch.known_size
+        # keys lane not forwarded: edge-scoped metadata (see ops/tpu.py)
+        return DeviceBatch(payload, batch.ts, valid,
+                           watermark=batch.watermark, size=size,
+                           frontier=batch.frontier)
+
+
+def fuse(a: Operator, b: Operator) -> Operator:
+    """Fuse two chainable operators into one stage."""
+    name = f"{a.name}|{b.name}"
+    if a.is_tpu:
+        return ChainedTPU(_tpu_specs(a) + _tpu_specs(b), name, a.parallelism,
+                          a.routing, a.key_extractor)
+    return ChainedHost(_host_specs(a) + _host_specs(b), name, a.parallelism,
+                       a.routing, b.output_batch_size, a.key_extractor)

@@ -1,0 +1,42 @@
+"""Host Map operator (reference ``/root/reference/wf/map.hpp:57-215``).
+
+Supports the reference's two functional styles: transforming (``fn(t) -> out``)
+and in-place (``fn`` returns ``None``, mutating its argument), each optionally
+"riched" with a RuntimeContext trailing parameter.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+from windflow_tpu.basic import RoutingMode
+from windflow_tpu.meta import adapt
+from windflow_tpu.ops.base import Operator, Replica
+
+
+class MapReplica(Replica):
+    copy_on_shared = True  # the in-place variant mutates its input
+
+    def __init__(self, op: "Map", index: int) -> None:
+        super().__init__(op, index)
+        self._fn = adapt(op.fn, 1)
+
+    def process_single(self, item, ts, wm):
+        out = self._fn(item, self.context)
+        if out is None:  # in-place variant: the (mutated) input moves on
+            out = item
+        self.stats.outputs_sent += 1
+        self.emitter.emit(out, ts, wm)
+
+
+class Map(Operator):
+    replica_class = MapReplica
+
+    def __init__(self, fn: Callable[[Any], Any], name: str = "map",
+                 parallelism: int = 1,
+                 routing: RoutingMode = RoutingMode.FORWARD,
+                 output_batch_size: int = 0, key_extractor=None) -> None:
+        super().__init__(name, parallelism, routing=routing,
+                         output_batch_size=output_batch_size,
+                         key_extractor=key_extractor)
+        self.fn = fn

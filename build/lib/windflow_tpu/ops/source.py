@@ -1,0 +1,148 @@
+"""Source operator (reference ``/root/reference/wf/source.hpp:55-309`` and the
+``Source_Shipper`` at ``source_shipper.hpp:59-``).
+
+The reference runs the user's generation function on a dedicated thread which
+pushes tuples through a ``Source_Shipper`` (timestamp + watermark assignment).
+Here a source replica is *pulled* by the host driver: the user supplies a
+generator function returning an iterable, and each scheduler tick pulls a
+bounded chunk so the pipeline stays balanced without threads.  Timestamping
+follows the reference policies: INGRESS assigns arrival time, EVENT uses a
+user timestamp extractor; watermarks are the monotone max of assigned
+timestamps (``source_shipper.hpp`` behavior).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Iterable, Optional
+
+from windflow_tpu.basic import RoutingMode, TimePolicy, WindFlowError, \
+    current_time_usecs
+from windflow_tpu.batch import WM_NONE
+from windflow_tpu.meta import adapt
+from windflow_tpu.ops.base import Operator, Replica
+
+
+class BaseSourceReplica(Replica):
+    """Shared source-replica mechanics: monotone timestamps and the
+    punctuation cadence (reference: emitters multicast watermark punctuations
+    every WF_DEFAULT_WM_INTERVAL_USEC / WM_AMOUNT inputs, basic.hpp:189-206,
+    forward_emitter.hpp:226-262)."""
+
+    def __init__(self, op: Operator, index: int) -> None:
+        super().__init__(op, index)
+        self._last_ts = WM_NONE
+        self._exhausted = False
+        self._since_punct = 0
+        self._last_punct_usec = current_time_usecs()
+
+    @property
+    def exhausted(self) -> bool:
+        return self._exhausted
+
+    def maybe_punctuate(self, now_usec: Optional[int] = None) -> None:
+        """Emit a watermark punctuation if the cadence interval elapsed — the
+        mechanism that keeps time windows firing on a live-but-idle stream
+        (reference ``forward_emitter.hpp:226-262``).  Called by the driver
+        every sweep."""
+        if self._exhausted:
+            return
+        now = now_usec if now_usec is not None else current_time_usecs()
+        if now - self._last_punct_usec >= self.config.punctuation_interval_usec:
+            self.punctuate(now)
+
+    def punctuate(self, now_usec: Optional[int] = None) -> None:
+        now = now_usec if now_usec is not None else current_time_usecs()
+        if self.time_policy == TimePolicy.INGRESS:
+            # Ingress watermarks may ride the wall clock: every future tuple
+            # is stamped >= now, so `now` is a valid frontier even mid-idle.
+            self._advance_wm(now)
+            # keep future tuple timestamps ahead of the advertised frontier
+            self._last_ts = max(self._last_ts, now)
+        # EVENT time: the frontier is the max event timestamp seen; idle
+        # cannot advance it (no oracle for future event times).
+        if self.current_wm == WM_NONE:
+            return
+        self._since_punct = 0
+        self._last_punct_usec = now
+        self.emitter.propagate_punctuation(self.current_wm)
+
+    def _count_toward_punctuation(self, n: int) -> None:
+        amount = self.config.punctuation_amount
+        if amount <= 0:
+            return  # count trigger disabled (interval cadence still runs)
+        self._since_punct += n
+        if self._since_punct >= amount:
+            self.punctuate()
+
+
+class SourceReplica(BaseSourceReplica):
+    def __init__(self, op: "Source", index: int) -> None:
+        super().__init__(op, index)
+        self._iter = None
+        # A source has no input channels; the driver calls tick().
+
+    def start(self) -> None:
+        gen = adapt(self.op.gen_fn, 0)
+        iterable = gen(self.context)
+        if iterable is None:
+            raise WindFlowError(
+                f"source '{self.op.name}' generator returned None")
+        self._iter = iter(iterable)
+
+    def tick(self, max_items: int) -> bool:
+        """Pull up to ``max_items`` tuples; returns True if any progress was
+        made (tuples emitted, an idle yield consumed, or the stream
+        terminated this call)."""
+        if self._exhausted:
+            return False
+        assert self._iter is not None, "source not started"
+        produced = 0
+        while produced < max_items:
+            try:
+                item = next(self._iter)
+            except StopIteration:
+                self._exhausted = True
+                self._terminate()
+                return True
+            if item is None:
+                # Idle yield: the source is live but has nothing right now
+                # (e.g. waiting on an external feed).  Give the scheduler the
+                # sweep back; punctuation cadence keeps watermarks moving
+                # (reference: Source_Shipper emits periodic watermarks on a
+                # live-but-idle stream, forward_emitter.hpp:226-262).
+                return True
+            ts = self._assign_ts(item)
+            self._advance_wm(ts)
+            self.stats.outputs_sent += 1
+            self.emitter.emit(item, ts, self.current_wm)
+            produced += 1
+            self._count_toward_punctuation(1)
+        return produced > 0
+
+    def _assign_ts(self, item: Any) -> int:
+        if self.time_policy == TimePolicy.EVENT:
+            if self.op.ts_extractor is None:
+                raise WindFlowError(
+                    f"source '{self.op.name}': EVENT time policy requires a "
+                    "timestamp extractor (with_timestamp_extractor)")
+            ts = int(self.op.ts_extractor(item))
+        else:
+            ts = current_time_usecs()
+            # Keep timestamps monotone per replica even if the clock stalls
+            # within a microsecond.
+            if ts <= self._last_ts:
+                ts = self._last_ts + 1
+        self._last_ts = max(self._last_ts, ts)
+        return ts
+
+
+class Source(Operator):
+    replica_class = SourceReplica
+
+    def __init__(self, gen_fn: Callable[..., Iterable], name: str = "source",
+                 parallelism: int = 1, output_batch_size: int = 0,
+                 ts_extractor: Optional[Callable[[Any], int]] = None) -> None:
+        super().__init__(name, parallelism, routing=RoutingMode.NONE,
+                         output_batch_size=output_batch_size)
+        self.gen_fn = gen_fn
+        self.ts_extractor = ts_extractor

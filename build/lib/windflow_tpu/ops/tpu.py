@@ -1,0 +1,308 @@
+"""TPU operators: the device compute path.
+
+These replace the reference's CUDA operator set (``/root/reference/wf/map_gpu.hpp``,
+``filter_gpu.hpp``, ``reduce_gpu.hpp``) with XLA programs:
+
+* ``Map_GPU``'s grid-stride elementwise kernel (``map_gpu.hpp:60-76``) becomes
+  ``jax.vmap`` of the user's per-item function over the batch — XLA tiles it
+  onto the VPU/MXU and fuses adjacent elementwise work.
+* ``Filter_GPU``'s predicate + compaction (``filter_gpu.hpp``) becomes a
+  validity-mask update: compaction is deferred (mask-aware consumers) because
+  XLA prefers static shapes; the mask costs one fused elementwise op instead
+  of a gather.
+* ``Reduce_GPU``'s ``sort_by_key`` + ``reduce_by_key`` pipeline
+  (``reduce_gpu.hpp:227-283``) becomes an XLA sort + segmented
+  ``associative_scan`` — the same algorithm Thrust runs, expressed so the
+  compiler can fuse the user combiner into the scan.
+
+Structural invariants kept from the reference (SURVEY.md §2.5): TPU operators
+consume batches only, require an upstream output batch size > 0, and run in
+DEFAULT execution mode only.
+
+User functions must be JAX-traceable, operating on one record (a pytree of
+scalars) with ``jnp`` ops.  They are traced once per batch shape: the staging
+emitter pads every batch to a fixed capacity precisely so each operator
+compiles a single program.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+from windflow_tpu.basic import RoutingMode, WindFlowError
+from windflow_tpu.batch import DeviceBatch
+from windflow_tpu.ops.base import Operator, Replica
+
+
+def _payload_nbytes(tree) -> int:
+    return sum(getattr(l, "nbytes", 0) for l in jax.tree.leaves(tree))
+
+
+class _TPUReplica(Replica):
+    """Shared device-batch plumbing for TPU operator replicas."""
+
+    def _op_step(self, batch: DeviceBatch):
+        """Hook for replicas whose operator step needs the replica index
+        (per-replica state); default ops take the batch alone."""
+        return self.op._step(batch)
+
+    def process_device_batch(self, batch: DeviceBatch) -> None:
+        out = self._op_step(batch)
+        self.stats.device_programs_launched += 1
+        if out is not None:
+            self.stats.outputs_sent += out.known_size or 0
+            self.emitter.emit_device_batch(out)
+
+
+class MapTPUReplica(_TPUReplica):
+    pass
+
+
+class MapTPU(Operator):
+    """Stateless elementwise transform on device (reference stateless
+    ``Map_GPU``, ``map_gpu.hpp:60-76,104-433``).
+
+    ``fn`` maps one record pytree to one record pytree.  With
+    ``batch_fn=True``, ``fn`` instead receives the whole SoA payload (leading
+    dim = capacity) and the validity mask — the escape hatch for
+    batch-granular math (the reference has no equivalent; CUDA kernels are
+    always per-item)."""
+
+    replica_class = MapTPUReplica
+
+    def __init__(self, fn: Callable, name: str = "map_tpu",
+                 parallelism: int = 1, batch_fn: bool = False,
+                 routing: RoutingMode = RoutingMode.FORWARD,
+                 key_extractor=None) -> None:
+        super().__init__(name, parallelism, routing=routing, is_tpu=True,
+                         key_extractor=key_extractor)
+        self.fn = fn
+        self.batch_fn = batch_fn
+
+        @jax.jit
+        def step(payload, valid):
+            if self.batch_fn:
+                return self.fn(payload, valid)
+            return jax.vmap(self.fn)(payload)
+
+        self._jit_step = step
+
+    def _step(self, batch: DeviceBatch) -> DeviceBatch:
+        out_payload = self._jit_step(batch.payload, batch.valid)
+        # keys lane deliberately not forwarded: it is edge-scoped metadata
+        # (valid only for the extractor of the edge that attached it), and a
+        # map may rewrite the key field anyway.
+        return DeviceBatch(out_payload, batch.ts, batch.valid,
+                           watermark=batch.watermark, size=batch._size,
+                           frontier=batch.frontier)
+
+
+class FilterTPUReplica(_TPUReplica):
+    pass
+
+
+class FilterTPU(Operator):
+    """Device predicate filter (reference ``Filter_GPU``): survivors are
+    expressed as a validity-mask intersection rather than a compaction —
+    downstream operators and the TPU→host boundary are mask-aware, so the
+    copy the reference pays on the GPU is avoided entirely."""
+
+    replica_class = FilterTPUReplica
+
+    def __init__(self, fn: Callable, name: str = "filter_tpu",
+                 parallelism: int = 1,
+                 routing: RoutingMode = RoutingMode.FORWARD,
+                 key_extractor=None) -> None:
+        super().__init__(name, parallelism, routing=routing, is_tpu=True,
+                         key_extractor=key_extractor)
+        self.fn = fn
+
+        @jax.jit
+        def step(payload, valid):
+            keep = jax.vmap(self.fn)(payload)
+            return valid & keep
+
+        self._jit_step = step
+
+    def _step(self, batch: DeviceBatch) -> DeviceBatch:
+        new_valid = self._jit_step(batch.payload, batch.valid)
+        return DeviceBatch(batch.payload, batch.ts, new_valid,
+                           watermark=batch.watermark, frontier=batch.frontier,
+                           size=None)  # survivor count unknown until observed
+
+
+def _segmented_reduce(keys, payload, ts, valid, comb, capacity):
+    """Sorted segmented reduce: the XLA expression of the reference's
+    ``Extract_Keys_Kernel`` → ``thrust::sort_by_key`` → ``thrust::reduce_by_key``
+    pipeline (``reduce_gpu.hpp:227-258``).
+
+    Invalid lanes get a sentinel sort key so they sort behind every real
+    segment; the sort lane is int64 so the sentinel lies OUTSIDE the int32
+    key space (an actual key of INT32_MAX must not be mistaken for padding
+    and dropped).  Returns (distinct_keys, combined_payload, seg_ts,
+    out_valid) with the distinct-key results left-compacted to the front of
+    the batch."""
+    sentinel = jnp.int64(1) << 32
+    skeys = jnp.where(valid, keys.astype(jnp.int64), sentinel)
+    order = jnp.argsort(skeys)
+    skeys = skeys[order]
+    spayload = jax.tree.map(lambda a: a[order], payload)
+    sts = ts[order]
+
+    starts = jnp.concatenate([jnp.array([True]), skeys[1:] != skeys[:-1]])
+
+    def op(a, b):
+        # Segmented-scan monoid: if b opens a new segment, the running value
+        # resets to b; otherwise it folds comb(a, b).
+        fa, pa, ta = a
+        fb, pb, tb = b
+        combined = comb(pa, pb)
+        p = jax.tree.map(
+            lambda c, vb: jnp.where(_bshape(fb, c), vb, c), combined, pb)
+        t = jnp.where(fb, tb, jnp.maximum(ta, tb))
+        return (fa | fb, p, t)
+
+    _, scanned_payload, scanned_ts = jax.lax.associative_scan(
+        op, (starts, spayload, sts))
+
+    # segment ends = positions where the next key differs
+    ends = jnp.concatenate([skeys[:-1] != skeys[1:], jnp.array([True])])
+    ends = ends & (skeys != sentinel)
+    # compact segment results to the front
+    dest = jnp.cumsum(ends) - 1
+    n_out = ends.sum()
+    scatter_idx = jnp.where(ends, dest, capacity - 1)
+
+    def compact(a):
+        out = jnp.zeros((capacity,) + a.shape[1:], a.dtype)
+        out = out.at[scatter_idx].set(
+            jnp.where(_bshape(ends, a), a, jnp.zeros_like(a)))
+        return out
+
+    out_payload = jax.tree.map(compact, scanned_payload)
+    out_keys = compact(skeys)
+    out_ts = compact(scanned_ts)
+    out_valid = jnp.arange(capacity) < n_out
+    return out_keys, out_payload, out_ts, out_valid
+
+
+def _bshape(mask, ref):
+    """Broadcast a [B] bool mask against a [B, ...] leaf."""
+    return mask.reshape(mask.shape + (1,) * (ref.ndim - 1))
+
+
+class ReduceTPUReplica(_TPUReplica):
+    pass
+
+
+class ReduceTPU(Operator):
+    """Per-batch associative reduce on device (reference ``Reduce_GPU``,
+    ``reduce_gpu.hpp:107-315``): keyed batches shrink to one combined record
+    per distinct key; non-keyed batches to a single record.  ``comb`` must be
+    associative (the reference requires the same for Thrust).  Cross-batch
+    rolling aggregation is the job of windows, exactly as in the reference
+    where ``Reduce_GPU`` is also per-batch.
+
+    The key extractor of a keyed TPU operator must be JAX-traceable and
+    return an integer: keys are extracted *inside* the compiled program
+    (reference: ``Extract_Keys_Kernel`` runs on device too,
+    ``reduce_gpu.hpp:227``), so the extraction fuses with the sort/scan and
+    works identically whether the batch arrived from a host staging edge or a
+    TPU→TPU edge."""
+
+    replica_class = ReduceTPUReplica
+
+    def __init__(self, comb: Callable[[Any, Any], Any],
+                 name: str = "reduce_tpu", parallelism: int = 1,
+                 key_extractor=None, max_keys: Optional[int] = None,
+                 sum_like: bool = False) -> None:
+        routing = RoutingMode.KEYBY if key_extractor is not None \
+            else RoutingMode.FORWARD
+        super().__init__(name, parallelism, routing=routing, is_tpu=True,
+                         key_extractor=key_extractor)
+        self.comb = comb
+        # Mesh execution only: bound of the dense key space [0, max_keys)
+        # for the cross-chip partial tables (single-chip reduce needs no
+        # bound — it sorts arbitrary int32 keys).  ``sum_like=True`` lets the
+        # cross-chip combine ride lax.psum instead of all_gather + fold.
+        self.max_keys = max_keys
+        self.sum_like = sum_like
+        self._jit_steps = {}
+        # device scalar accumulating mesh-path key drops (tuples whose key
+        # falls outside [0, max_keys) cannot live in the dense cross-chip
+        # tables); read lazily at stats time, never on the step path
+        self._mesh_dropped = None
+
+    def _get_step(self, capacity: int):
+        step = self._jit_steps.get(capacity)
+        if step is None:
+            comb = self.comb
+            key_fn = self.key_extractor
+
+            @jax.jit
+            def step(keys, payload, ts, valid):
+                if keys is None:
+                    if key_fn is not None:
+                        keys = jax.vmap(key_fn)(payload).astype(jnp.int32)
+                    else:
+                        # Non-keyed: one global segment (thrust::reduce path).
+                        keys = jnp.zeros(capacity, dtype=jnp.int32)
+                return _segmented_reduce(keys, payload, ts, valid, comb,
+                                         capacity)
+
+            self._jit_steps[capacity] = step
+        return step
+
+    def _get_sharded_step(self, capacity: int):
+        step = self._jit_steps.get(("mesh", capacity))
+        if step is None:
+            from windflow_tpu.parallel.mesh import (
+                make_sharded_reduce_arbitrary, make_sharded_reduce_step)
+            K = self.max_keys if self.key_extractor is not None else 1
+            if K is None:
+                # Arbitrary int32 keys: hash-shard lanes to their owner
+                # chip with one all_to_all, then per-chip sort/reduce — no
+                # dense table bound, nothing dropped (reference
+                # reduce_gpu.hpp:227-258 arbitrary-key path).  withMaxKeys
+                # remains the faster dense/psum variant for bounded keys.
+                step = make_sharded_reduce_arbitrary(
+                    self.mesh, capacity, self.comb, self.key_extractor)
+            else:
+                step = make_sharded_reduce_step(
+                    self.mesh, capacity, K, self.comb, self.key_extractor,
+                    use_psum=self.sum_like)
+            self._jit_steps[("mesh", capacity)] = step
+        return step
+
+    def num_dropped_tuples(self) -> int:
+        if self._mesh_dropped is None:
+            return 0
+        return int(self._mesh_dropped)  # one device sync, diagnostics only
+
+    def dump_stats(self) -> dict:
+        st = super().dump_stats()
+        if self._mesh_dropped is not None:
+            st["Out_of_range_keys_dropped"] = self.num_dropped_tuples()
+        return st
+
+    def _step(self, batch: DeviceBatch) -> DeviceBatch:
+        if self.mesh is not None:
+            # Sharded variant: dense per-chip partials combined over ICI;
+            # output is a capacity-max_keys batch of distinct-key records.
+            table, ts_out, has, n_drop = self._get_sharded_step(
+                batch.capacity)(batch.payload, batch.ts, batch.valid)
+            self._mesh_dropped = n_drop if self._mesh_dropped is None \
+                else self._mesh_dropped + n_drop
+            return DeviceBatch(table, ts_out, has,
+                               watermark=batch.watermark, size=None,
+                               frontier=batch.frontier)
+        out_keys, out_payload, out_ts, out_valid = \
+            self._get_step(batch.capacity)(batch.keys, batch.payload,
+                                           batch.ts, batch.valid)
+        return DeviceBatch(out_payload, out_ts, out_valid,
+                           watermark=batch.watermark, size=None,
+                           frontier=batch.frontier)

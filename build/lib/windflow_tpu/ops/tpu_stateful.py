@@ -1,0 +1,360 @@
+"""Stateful keyed TPU operators: per-key mutable state on device.
+
+Re-design of the reference's stateful GPU paths:
+
+* ``Map_GPU`` stateful kernel — one CUDA worker per distinct key walks the
+  batch's per-key index chain applying ``fn(tuple, state)`` in arrival order
+  (``map_gpu.hpp:78-102``); state lives in a shared
+  ``tbb::concurrent_unordered_map<key, wrapper_state_t>`` guarded by a
+  spinlock that serializes stateful kernels across replicas
+  (``map_gpu.hpp:114-115,278-295``).
+* ``Filter_GPU`` stateful kernel — same walk, predicate + state update
+  (``filter_gpu.hpp:119``).
+
+TPU mapping (SURVEY.md §7 "hard parts": dense key-slot tables, host-managed
+key→slot assignment):
+
+1. **Key→slot interning on host.**  The state table is a dense pytree of
+   ``[num_key_slots, ...]`` device arrays.  Per batch, the distinct keys are
+   pulled to host (a tiny D2H — the reference does exactly this with
+   ``dist_keys_cpu``, ``keyby_emitter_gpu.hpp:519-583``) and interned into
+   dense slot ids by a Python dict, replacing the reference's device-pointer
+   hash map with index arithmetic XLA can compile.
+2. **Rank-wavefront in-order apply.**  The reference's "one worker per key
+   walks its chain" becomes: stable-sort lanes by slot, compute each lane's
+   *rank* (occurrence index within its key), then loop rank = 0..max_rank.
+   Each wavefront step applies ``vmap(fn)`` to every lane at that rank —
+   lanes at the same rank hold **distinct keys by construction**, so the
+   state gather/scatter is conflict-free and fully parallel.  The loop depth
+   is the max per-key multiplicity in the batch (typically ≪ capacity), the
+   TPU analogue of the CUDA chain-walk's depth.
+3. **Shared state, serialized.**  The table lives on the *operator*, not the
+   replica; the host driver dispatches batches one at a time, so cross-replica
+   state access is serialized by construction — the role of the reference's
+   spinlock.
+
+Stateful function signatures (the in-place C++ references become returns):
+
+* map: ``fn(record, state) -> (new_record, new_state)``
+* filter: ``fn(record, state) -> (keep_bool, new_state)``
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from windflow_tpu.basic import RoutingMode, WindFlowError
+from windflow_tpu.batch import DeviceBatch
+from windflow_tpu.ops.base import Operator
+from windflow_tpu.ops.tpu import _TPUReplica, _bshape
+from windflow_tpu.parallel.emitters import KeyInterner
+
+_KEY_SENTINEL = np.int32(2**31 - 1)
+
+
+def _broadcast_state(proto, num_slots: int):
+    """Materialize the [S, ...] state table from one per-key prototype."""
+    def rep(x):
+        a = jnp.asarray(x)
+        return jnp.repeat(a[None], num_slots, axis=0)
+    return jax.tree.map(rep, proto)
+
+
+def _wavefront_body(fn: Callable, capacity: int,
+                    num_slots: int, is_filter: bool):
+    """Per-batch program body: rank-wavefront stateful apply over resolved
+    dense slot ids (``slots``; lanes with slot >= num_slots are ignored)."""
+
+    def body_fn(state, payload, valid, slots):
+        # Stable sort by slot: arrival order is preserved within each key —
+        # the ordering guarantee of the reference's per-key chain walk.
+        sort_key = jnp.where(valid & (slots < num_slots), slots,
+                             jnp.int32(num_slots))
+        order = jnp.argsort(sort_key, stable=True)
+        s_slots = sort_key[order]
+        s_valid = valid[order]
+        s_payload = jax.tree.map(lambda a: a[order], payload)
+
+        # rank[i] = occurrence index of lane i within its key segment
+        idx = jnp.arange(capacity, dtype=jnp.int32)
+        starts = jnp.concatenate(
+            [jnp.ones(1, bool), s_slots[1:] != s_slots[:-1]])
+        seg_start = jax.lax.associative_scan(
+            jnp.maximum, jnp.where(starts, idx, jnp.int32(0)))
+        rank = idx - seg_start
+        max_rank = jnp.max(jnp.where(s_valid, rank, jnp.int32(0)))
+
+        gather_slots = jnp.clip(s_slots, 0, num_slots - 1)
+
+        # Each lane is applied exactly once (at its own rank), so fn always
+        # reads the ORIGINAL sorted payload; results accumulate into a
+        # separate output carry — whose pytree structure may differ from the
+        # input's (a stateful map may add/drop record fields, unlike the
+        # reference's in-place C++ tuples).
+        if is_filter:
+            out0 = jnp.ones(capacity, bool)
+        else:
+            res_shape, _ = jax.eval_shape(
+                jax.vmap(fn), s_payload,
+                jax.tree.map(lambda a: a[gather_slots], state))
+            out0 = jax.tree.map(
+                lambda sd: jnp.zeros(sd.shape, sd.dtype), res_shape)
+
+        def body(carry):
+            r, st, out = carry
+            mask = (rank == r) & s_valid
+            cur = jax.tree.map(lambda a: a[gather_slots], st)
+            res, new_st = jax.vmap(fn)(s_payload, cur)
+            if is_filter:
+                out = jnp.where(mask, res, out)
+            else:
+                out = jax.tree.map(
+                    lambda o, v: jnp.where(_bshape(mask, o), v, o), out, res)
+            # Conflict-free scatter: within one rank all slots are distinct.
+            # Masked-out lanes scatter to index num_slots → dropped (XLA
+            # drops out-of-bounds scatter updates under jit).
+            scat = jnp.where(mask, s_slots, jnp.int32(num_slots))
+            st = jax.tree.map(lambda a, u: a.at[scat].set(u, mode="drop"),
+                              st, new_st)
+            return r + 1, st, out
+
+        _, state, s_out = jax.lax.while_loop(
+            lambda c: c[0] <= max_rank, body, (jnp.int32(0), state, out0))
+
+        inv = jnp.argsort(order)
+        if is_filter:
+            new_valid = valid & s_out[inv]
+            return state, payload, new_valid
+        out_payload = jax.tree.map(lambda a: a[inv], s_out)
+        return state, out_payload, valid
+
+    return body_fn
+
+
+def _assoc_body(lift: Callable, comb: Callable, project: Callable,
+                capacity: int, num_slots: int, is_filter: bool):
+    """Log-depth alternative to the wavefront for *associative* state
+    updates (``state' = comb(state, lift(record))``): a segmented inclusive
+    scan folds each key's contributions in arrival order, so a single-hot-key
+    batch costs the same as a uniform one — the wavefront's depth equals the
+    max per-key multiplicity, which degrades to ``capacity`` sequential
+    sweeps under skew (reference has no analogue: its per-key CUDA chain
+    walk is inherently sequential, ``map_gpu.hpp:78-102``).
+
+    ``project(record, state_incl)`` sees the state *including* the record's
+    own contribution (rolling-reduce semantics, like the reference's CPU
+    ``Reduce`` emitting the updated state per input, ``reduce.hpp:58-176``);
+    for filters it returns the keep bool."""
+
+    def body_fn(state, payload, valid, slots):
+        sort_key = jnp.where(valid & (slots < num_slots), slots,
+                             jnp.int32(num_slots))
+        order = jnp.argsort(sort_key, stable=True)
+        s_slots = sort_key[order]
+        s_valid = valid[order]
+        s_payload = jax.tree.map(lambda a: a[order], payload)
+
+        lifts = jax.vmap(lift)(s_payload)
+        starts = jnp.concatenate(
+            [jnp.ones(1, bool), s_slots[1:] != s_slots[:-1]])
+
+        # segmented inclusive scan of contributions (invalid lanes are all
+        # in the trailing sentinel segment, so no flags needed)
+        def op(a, b):
+            sa, va = a
+            sb, vb = b
+            combined = comb(va, vb)
+            v = jax.tree.map(
+                lambda c, x: jnp.where(_bshape(sb, c), x, c), combined, vb)
+            return sa | sb, v
+
+        _, prefix = jax.lax.associative_scan(op, (starts, lifts))
+
+        gather_slots = jnp.clip(s_slots, 0, num_slots - 1)
+        init = jax.tree.map(lambda a: a[gather_slots], state)
+        state_incl = comb(init, prefix)
+
+        s_out = jax.vmap(project)(s_payload, state_incl)
+
+        # persist each segment's final state (segment-end lanes of real
+        # slots; the sentinel segment is dropped by the OOB scatter)
+        ends = jnp.concatenate([s_slots[:-1] != s_slots[1:],
+                                jnp.ones(1, bool)])
+        scat = jnp.where(ends & (s_slots < num_slots), s_slots,
+                         jnp.int32(num_slots))
+        state = jax.tree.map(
+            lambda a, u: a.at[scat].set(u, mode="drop"), state, state_incl)
+
+        inv = jnp.argsort(order)
+        if is_filter:
+            return state, payload, valid & s_out[inv]
+        out_payload = jax.tree.map(lambda a: a[inv], s_out)
+        return state, out_payload, valid
+
+    return body_fn
+
+
+class _StatefulTPUBase(Operator):
+    """Shared machinery: state table + interner on the operator (shared by
+    all replicas — reference shares one tbb map across replicas too)."""
+
+    _is_filter = False
+
+    def __init__(self, fn: Callable, initial_state: Any, name: str,
+                 parallelism: int, key_extractor: Callable,
+                 num_key_slots: int = 4096, dense_keys: bool = False,
+                 assoc: Optional[tuple] = None) -> None:
+        if key_extractor is None:
+            raise WindFlowError(
+                f"stateful TPU operator '{name}' requires a key extractor "
+                "(reference: stateful Map_GPU/Filter_GPU are keyed-only)")
+        super().__init__(name, parallelism, routing=RoutingMode.KEYBY,
+                         is_tpu=True, key_extractor=key_extractor)
+        self.fn = fn
+        self.num_key_slots = num_key_slots
+        #: dense_keys: the extractor already returns slot ids in
+        #: [0, num_key_slots) — skip host interning entirely, so the step is
+        #: one fully-async device program with no per-batch D2H sync
+        #: (out-of-range keys are masked invalid, like FfatWindowsTPU)
+        self.dense_keys = dense_keys
+        #: assoc: (lift, comb, project) declares the state update
+        #: associative — the log-depth segmented-scan body replaces the
+        #: wavefront (skew-proof); ``fn`` is ignored when set
+        self.assoc = assoc
+        self._state = _broadcast_state(initial_state, num_key_slots)
+        self._interner = KeyInterner()
+        self._extract = None
+        self._steps = {}   # per-capacity program cache
+
+    # -- host-managed key→slot assignment -----------------------------------
+    def _intern(self, uniq: np.ndarray) -> np.ndarray:
+        interner = self._interner
+        slots = np.empty(len(uniq), np.int32)
+        for i, k in enumerate(uniq):
+            slots[i] = interner.intern(int(k))
+        if len(interner) > self.num_key_slots:
+            raise WindFlowError(
+                f"operator '{self.name}': distinct keys exceed "
+                f"num_key_slots={self.num_key_slots}; raise it via "
+                "withNumKeySlots")
+        return slots
+
+    def _body(self, capacity: int):
+        if self.assoc is not None:
+            lift, comb, project = self.assoc
+            return _assoc_body(lift, comb, project, capacity,
+                               self.num_key_slots, self._is_filter)
+        return _wavefront_body(self.fn, capacity, self.num_key_slots,
+                               self._is_filter)
+
+    def _get_step(self, capacity: int):
+        step = self._steps.get(capacity)
+        if step is None:
+            body = self._body(capacity)
+            key_fn = self.key_extractor
+            S = self.num_key_slots
+            if self.dense_keys:
+                # slot = key, resolved inside the one compiled program: the
+                # whole step is async device work, no host round-trip
+                def step(state, payload, valid, keys):
+                    if keys is None:
+                        keys = jax.vmap(key_fn)(payload).astype(jnp.int32)
+                    ok = valid & (keys >= 0) & (keys < S)
+                    return body(state, payload, ok, keys)
+            else:
+                def step(state, payload, valid, keys, uniq_keys, uniq_slots):
+                    pos = jnp.clip(jnp.searchsorted(uniq_keys, keys),
+                                   0, capacity - 1)
+                    return body(state, payload, valid, uniq_slots[pos])
+            step = jax.jit(step, donate_argnums=(0,))
+            self._steps[capacity] = step
+        return step
+
+    def _stateful_step(self, batch: DeviceBatch):
+        cap = batch.capacity
+        if self._extract is None:
+            key_fn = self.key_extractor
+
+            @jax.jit
+            def extract(payload):
+                return jax.vmap(key_fn)(payload).astype(jnp.int32)
+
+            self._extract = extract
+        if self.dense_keys:
+            # no interning: dispatch stays fully asynchronous
+            return self._get_step(cap)(self._state, batch.payload,
+                                       batch.valid, batch.keys)
+        # Keys are extracted once; the device array feeds the wavefront step
+        # and its host copy drives interning (tiny D2H — parity with the
+        # reference's dist_keys_cpu copy at the keyby boundary).
+        keys_dev = batch.keys if batch.keys is not None \
+            else self._extract(batch.payload)
+        keys_np = np.asarray(keys_dev)
+        valid_np = np.asarray(batch.valid)
+        uniq = np.unique(keys_np[valid_np])
+        uniq_slots = self._intern(uniq)
+        pad = cap - len(uniq)
+        uniq_keys_dev = jnp.asarray(
+            np.concatenate([uniq.astype(np.int32),
+                            np.full(pad, _KEY_SENTINEL, np.int32)]))
+        uniq_slots_dev = jnp.asarray(
+            np.concatenate([uniq_slots,
+                            np.full(pad, self.num_key_slots, np.int32)]))
+        return self._get_step(cap)(self._state, batch.payload, batch.valid,
+                                   keys_dev, uniq_keys_dev, uniq_slots_dev)
+
+
+class StatefulMapTPUReplica(_TPUReplica):
+    pass
+
+
+class StatefulMapTPU(_StatefulTPUBase):
+    """Keyed stateful map on device (reference stateful ``Map_GPU``,
+    ``map_gpu.hpp:78-102,104-433``): ``fn(record, state) -> (record, state)``
+    applied to each key's tuples in arrival order."""
+
+    replica_class = StatefulMapTPUReplica
+    _is_filter = False
+
+    def __init__(self, fn, initial_state, name: str = "map_tpu",
+                 parallelism: int = 1, key_extractor=None,
+                 num_key_slots: int = 4096, dense_keys: bool = False,
+                 assoc=None) -> None:
+        super().__init__(fn, initial_state, name, parallelism, key_extractor,
+                         num_key_slots, dense_keys=dense_keys, assoc=assoc)
+
+    def _step(self, batch: DeviceBatch) -> DeviceBatch:
+        self._state, out_payload, valid = self._stateful_step(batch)
+        return DeviceBatch(out_payload, batch.ts, valid,
+                           watermark=batch.watermark, size=batch._size,
+                           frontier=batch.frontier)
+
+
+class StatefulFilterTPUReplica(_TPUReplica):
+    pass
+
+
+class StatefulFilterTPU(_StatefulTPUBase):
+    """Keyed stateful filter on device (reference stateful ``Filter_GPU``,
+    ``filter_gpu.hpp:119``): ``fn(record, state) -> (keep, state)``; dropped
+    tuples leave the validity mask, state updates still apply in order."""
+
+    replica_class = StatefulFilterTPUReplica
+    _is_filter = True
+
+    def __init__(self, fn, initial_state, name: str = "filter_tpu",
+                 parallelism: int = 1, key_extractor=None,
+                 num_key_slots: int = 4096, dense_keys: bool = False,
+                 assoc=None) -> None:
+        super().__init__(fn, initial_state, name, parallelism, key_extractor,
+                         num_key_slots, dense_keys=dense_keys, assoc=assoc)
+
+    def _step(self, batch: DeviceBatch) -> DeviceBatch:
+        self._state, out_payload, valid = self._stateful_step(batch)
+        return DeviceBatch(out_payload, batch.ts, valid,
+                           watermark=batch.watermark, size=None,
+                           frontier=batch.frontier)

@@ -1,0 +1,31 @@
+"""Routing + distribution plane: emitters, collectors, and multi-chip sharding.
+
+This package is the TPU-native replacement for the reference's communication
+backend (SURVEY.md §5.8): lock-free thread queues + pointer multicast become a
+host driver moving batch handles between stages, and cross-chip distribution
+rides XLA collectives over ICI (``windflow_tpu.parallel.mesh``).
+"""
+
+from windflow_tpu.parallel.emitters import (
+    Emitter, ForwardEmitter, KeyByEmitter, BroadcastEmitter,
+    DeviceStageEmitter, create_emitter,
+)
+from windflow_tpu.parallel.collectors import (
+    Collector, WatermarkCollector, OrderingCollector, KSlackCollector,
+    create_collector,
+)
+_MESH_EXPORTS = (
+    "DATA_AXIS", "KEY_AXIS", "batch_sharding", "make_mesh",
+    "make_sharded_ffat_state", "make_sharded_ffat_step",
+    "make_sharded_keyed_reduce", "replicated", "stage_batch",
+    "state_sharding",
+)
+
+
+def __getattr__(name):
+    # Lazy (PEP 562): mesh pulls in the windows package, which depends on
+    # ops.base, which imports this package — eager import would cycle.
+    if name in _MESH_EXPORTS + ("mesh",):
+        import windflow_tpu.parallel.mesh as _mesh
+        return _mesh if name == "mesh" else getattr(_mesh, name)
+    raise AttributeError(name)

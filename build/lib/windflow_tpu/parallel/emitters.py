@@ -1,0 +1,756 @@
+"""Emitters: the routing plane between operator stages.
+
+Re-design of the reference emitter family (``/root/reference/wf/basic_emitter.hpp``,
+``forward_emitter.hpp``, ``keyby_emitter.hpp``, ``broadcast_emitter.hpp``, and the
+``*_emitter_gpu.hpp`` device variants):
+
+* The reference emitter pushes pointers into lock-free thread queues
+  (``ff_send_out_to``).  Here an emitter appends messages to destination
+  replica inboxes; the host driver (graph/pipegraph.py) drains them.  Because
+  JAX arrays are immutable, broadcast needs no reference-counted multicast
+  (reference ``delete_counter``, ``single_t.hpp:54``) — sharing a DeviceBatch
+  handle is free.
+
+* The CPU→GPU staging emitters (``forward_emitter_gpu.hpp:254-300`` pinned
+  double-buffering) become :class:`DeviceStageEmitter`: host records are
+  accumulated and staged to TPU HBM as one SoA batch.  JAX dispatch is
+  asynchronous, so consecutive staged batches overlap transfer/compute without
+  explicit double buffering.
+
+* The GPU→GPU keyby emitter's sort/unique machinery
+  (``keyby_emitter_gpu.hpp:519-583``) is *not* reproduced at the emitter: keys
+  ride the batch as a dense-id lane and key grouping happens inside the
+  consuming operator with XLA sort/segment ops — the compiler fuses it with
+  the operator body, which a standalone emitter kernel would prevent.
+"""
+
+from __future__ import annotations
+
+import math
+import zlib
+from typing import Any, Callable, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from windflow_tpu.basic import RoutingMode, WindFlowError
+from windflow_tpu.batch import (DeviceBatch, HostBatch, Punctuation, WM_NONE,
+                                columns_to_device, host_to_device)
+
+
+_M64 = (1 << 64) - 1
+
+
+def splitmix64_int(k: int) -> int:
+    """Pure-Python splitmix64, bit-identical to the native ``wf_hash64`` /
+    ``native.hash64`` (keyed routing placement must agree across the
+    per-tuple, columnar-native, and on-device paths)."""
+    x = (k + 0x9E3779B97F4A7C15) & _M64
+    x = ((x ^ (x >> 30)) * 0xBF58476D1CE4E5B9) & _M64
+    x = ((x ^ (x >> 27)) * 0x94D049BB133111EB) & _M64
+    return x ^ (x >> 31)
+
+
+def _splitmix64_dev(k32):
+    """splitmix64 as jnp ops over an int32 key lane (sign-extended to the
+    same int64 the host paths hash) — keeps device-side keyby placement
+    bit-identical to the host staging emitter's."""
+    import jax.numpy as jnp
+    x = k32.astype(jnp.int64).astype(jnp.uint64) \
+        + jnp.uint64(0x9E3779B97F4A7C15)
+    x = (x ^ (x >> jnp.uint64(30))) * jnp.uint64(0xBF58476D1CE4E5B9)
+    x = (x ^ (x >> jnp.uint64(27))) * jnp.uint64(0x94D049BB133111EB)
+    return x ^ (x >> jnp.uint64(31))
+
+
+def stable_hash(key: Any) -> int:
+    """Deterministic key hash (reference uses ``std::hash`` —
+    ``keyby_emitter.hpp:216``).  Python's ``hash`` is salted for str/bytes, so
+    use crc32 there to keep keyby placement reproducible across processes."""
+    if isinstance(key, int):
+        return key
+    if isinstance(key, str):
+        return zlib.crc32(key.encode())
+    if isinstance(key, bytes):
+        return zlib.crc32(key)
+    return hash(key)
+
+
+class KeyInterner:
+    """Host-side mapping from arbitrary user keys to dense int slots.
+
+    The TPU answer to per-key device state without pointer-chasing hash maps
+    (SURVEY.md §7 "hard parts"): the host assigns each distinct key a dense id
+    at the staging boundary; device state lives in dense ``[num_slots, ...]``
+    tables indexed by that id.  Parity: the reference copies distinct keys to
+    host at the keyby boundary anyway (``dist_keys_cpu``,
+    ``keyby_emitter_gpu.hpp:519-583``)."""
+
+    def __init__(self) -> None:
+        self._ids = {}
+
+    def intern(self, key: Any) -> int:
+        i = self._ids.get(key)
+        if i is None:
+            i = len(self._ids)
+            self._ids[key] = i
+        return i
+
+    def __len__(self) -> int:
+        return len(self._ids)
+
+    def keys_by_slot(self) -> list:
+        out = [None] * len(self._ids)
+        for k, i in self._ids.items():
+            out[i] = k
+        return out
+
+
+class Emitter:
+    """Base emitter: owns destination inboxes and per-destination channel ids
+    (reference ``Basic_Emitter``, ``basic_emitter.hpp:62-121``)."""
+
+    def __init__(self, dests: Sequence[Tuple[Any, int]],
+                 output_batch_size: int) -> None:
+        # dests: list of (replica, channel_id on that replica).
+        self.dests = list(dests)
+        self.output_batch_size = output_batch_size
+
+    # -- host-tuple interface ----------------------------------------------
+    def emit(self, item: Any, ts: int, wm: int,
+             shared: bool = False) -> None:
+        """``shared=True`` marks an item whose object is (or may be) also
+        delivered elsewhere (split multicast); it taints the open batch so
+        in-place consumers copy before mutating rather than paying an eager
+        deepcopy per branch."""
+        raise NotImplementedError
+
+    # -- device-batch interface --------------------------------------------
+    def emit_device_batch(self, batch: DeviceBatch) -> None:
+        raise NotImplementedError
+
+    # -- whole-host-batch interface (TPU→host boundary) ---------------------
+    def emit_host_batch(self, hb: HostBatch) -> None:
+        """Route a whole HostBatch (from a device transfer) downstream.
+        Forward/broadcast emitters route at batch granularity — the
+        reference GPU→CPU path also re-ships whole CPU batches
+        (``keyby_emitter_gpu.hpp:594-638``); the default falls back to
+        per-tuple emit for routings that need tuple granularity (keyby)."""
+        for item, ts in zip(hb.items, hb.tss):
+            self.emit(item, ts, hb.watermark, hb.shared)
+
+    # -- columnar interface (bulk sources, windflow_tpu/io) -----------------
+    def emit_columns(self, cols, tss, wm: int, row_wms=None) -> None:
+        """Emit a block of tuples given as SoA numpy columns.  ``wm`` is the
+        frontier after the block's LAST row; ``row_wms`` (optional int64
+        [n]) is the frontier after EACH row — sources that know it (e.g. a
+        cumulative max of event timestamps) let the staging emitter stamp
+        batches that split the block exactly instead of conservatively.
+        The default explodes to per-tuple records (host destinations care
+        about items, not layout); the device staging emitter overrides this
+        with a zero-per-tuple path."""
+        names = list(cols)
+        arrs = [cols[n] for n in names]
+        for i in range(len(tss)):
+            item = {n: a[i].item() for n, a in zip(names, arrs)}
+            self.emit(item, int(tss[i]),
+                      int(row_wms[i]) if row_wms is not None else wm)
+
+    def propagate_punctuation(self, wm: int) -> None:
+        """Flush open batches, then multicast a watermark punctuation
+        (reference ``forward_emitter.hpp:226-262``)."""
+        self.flush(wm)
+        for replica, ch in self.dests:
+            replica.receive(ch, Punctuation(wm))
+
+    def flush(self, wm: int) -> None:
+        """Send any partially-filled batches downstream (EOS / cadence)."""
+
+    # -- helpers ------------------------------------------------------------
+    def _send(self, dest_idx: int, msg) -> None:
+        replica, ch = self.dests[dest_idx]
+        replica.receive(ch, msg)
+
+
+def _concat(arrs):
+    return arrs[0] if len(arrs) == 1 else np.concatenate(arrs)
+
+
+class _OpenBatch:
+    """Accumulates tuples for one destination.
+
+    The watermark folds the MINIMUM frontier, as the reference does
+    (``Batch_CPU_t::addTuple``, ``batch_cpu_t.hpp:51-205``): a downstream
+    host operator may unpack the batch and re-emit singles each carrying the
+    batch stamp, and a max-fold would let the first single's watermark fire
+    windows ahead of its batch-siblings still in flight on the same channel,
+    silently dropping them as late.  The tighter newest frontier travels
+    separately as ``DeviceBatch.frontier`` (see batch.py), valid only for
+    the consuming operator's own place-then-fire step."""
+
+    __slots__ = ("items", "tss", "wm", "shared")
+
+    def __init__(self):
+        self.items: list = []
+        self.tss: list = []
+        self.wm: int = WM_NONE
+        self.shared: bool = False
+
+    def add(self, item, ts, wm, shared=False):
+        self.items.append(item)
+        self.tss.append(ts)
+        self.shared |= shared
+        if wm != WM_NONE:
+            self.wm = wm if self.wm == WM_NONE else min(self.wm, wm)
+
+
+class ForwardEmitter(Emitter):
+    """FORWARD / REBALANCING routing of host tuples: round-robin over
+    destinations, accumulating per-destination batches of ``output_batch_size``
+    (reference ``forward_emitter.hpp:49-285``)."""
+
+    def __init__(self, dests, output_batch_size):
+        super().__init__(dests, output_batch_size)
+        self._open = [_OpenBatch() for _ in dests]
+        self._next = 0
+
+    def emit(self, item, ts, wm, shared=False):
+        d = self._next
+        self._next = (self._next + 1) % len(self.dests)
+        ob = self._open[d]
+        ob.add(item, ts, wm, shared)
+        if len(ob.items) >= max(1, self.output_batch_size):
+            self._flush_dest(d)
+
+    def _flush_dest(self, d):
+        ob = self._open[d]
+        if ob.items:
+            self._send(d, HostBatch(ob.items, ob.tss, ob.wm,
+                                    shared=ob.shared))
+            self._open[d] = _OpenBatch()
+
+    def emit_host_batch(self, hb):
+        # batch-granular round-robin; flush the destination's open batch
+        # first so per-destination arrival order is preserved
+        d = self._next
+        self._next = (self._next + 1) % len(self.dests)
+        self._flush_dest(d)
+        self._send(d, hb)
+
+    def flush(self, wm):
+        for d in range(len(self.dests)):
+            self._flush_dest(d)
+
+
+class KeyByEmitter(Emitter):
+    """KEYBY routing: ``hash(key) % num_dests`` per tuple with per-destination
+    open batches (reference ``keyby_emitter.hpp:216-257``)."""
+
+    def __init__(self, dests, output_batch_size,
+                 key_extractor: Callable[[Any], Any]):
+        super().__init__(dests, output_batch_size)
+        self.key_extractor = key_extractor
+        self._open = [_OpenBatch() for _ in dests]
+
+    def emit(self, item, ts, wm, shared=False):
+        d = stable_hash(self.key_extractor(item)) % len(self.dests)
+        ob = self._open[d]
+        ob.add(item, ts, wm, shared)
+        if len(ob.items) >= max(1, self.output_batch_size):
+            self._flush_dest(d)
+
+    def _flush_dest(self, d):
+        ob = self._open[d]
+        if ob.items:
+            self._send(d, HostBatch(ob.items, ob.tss, ob.wm,
+                                    shared=ob.shared))
+            self._open[d] = _OpenBatch()
+
+    def flush(self, wm):
+        for d in range(len(self.dests)):
+            self._flush_dest(d)
+
+
+class BroadcastEmitter(Emitter):
+    """BROADCAST routing: every destination sees every tuple (reference
+    ``broadcast_emitter.hpp``).  Batches are built once and the same immutable
+    HostBatch object is delivered to all inboxes."""
+
+    def __init__(self, dests, output_batch_size):
+        super().__init__(dests, output_batch_size)
+        self._ob = _OpenBatch()
+
+    def emit(self, item, ts, wm, shared=False):
+        self._ob.add(item, ts, wm, shared)
+        if len(self._ob.items) >= max(1, self.output_batch_size):
+            self.flush(wm)
+
+    def flush(self, wm):
+        if self._ob.items:
+            # one immutable batch object multicast by handle; `shared` makes
+            # in-place consumers copy before mutating (reference pairs the
+            # delete_counter multicast with Map's copyOnWrite,
+            # single_t.hpp:54, map.hpp:57-215)
+            b = HostBatch(self._ob.items, self._ob.tss, self._ob.wm,
+                          shared=len(self.dests) > 1 or self._ob.shared)
+            for d in range(len(self.dests)):
+                self._send(d, b)
+            self._ob = _OpenBatch()
+
+    def emit_host_batch(self, hb):
+        self.flush(hb.watermark)
+        if len(self.dests) > 1:
+            hb = HostBatch(hb.items, hb.tss, hb.watermark, shared=True)
+        for d in range(len(self.dests)):
+            self._send(d, hb)
+
+
+class DeviceStageEmitter(Emitter):
+    """Host→TPU boundary (reference CPU→GPU ``Forward_Emitter_GPU`` /
+    ``KeyBy_Emitter_GPU`` staging paths): accumulates host records, stages one
+    SoA DeviceBatch of fixed capacity ``output_batch_size``, and round-robins
+    destination replicas.
+
+    Keyed destinations need no work here: keyed TPU operators extract their
+    key lane from the payload inside their own compiled program (see
+    ``ops/tpu.py``), identically for staged and device-resident batches.  The
+    fixed capacity keeps every staged batch the same shape, so the
+    destination's compiled program never re-traces.
+    """
+
+    def __init__(self, dests, output_batch_size, mesh=None):
+        if output_batch_size <= 0:
+            # Parity: a device operator must be preceded by batching output
+            # (reference multipipe.hpp:441-444).
+            raise WindFlowError(
+                "a TPU operator requires the upstream operator to set an "
+                "output batch size > 0")
+        super().__init__(dests, output_batch_size)
+        self._ob = _OpenBatch()
+        self._next = 0
+        # Newest watermark seen by this emitter (monotone): staged batches
+        # carry it as DeviceBatch.frontier so the consuming device operator
+        # can fire time windows without the min-fold's one-batch lag — see
+        # _OpenBatch and DeviceBatch.frontier for why the propagated
+        # watermark stays min-folded.
+        self._frontier = WM_NONE
+        # Columnar accumulation: list of (cols dict, tss, per-row-wm)
+        # chunks + row count.  A chunk-level watermark is only valid after
+        # the chunk's LAST row — stamping a head batch of a split chunk
+        # with it would let downstream time windows fire ahead of the
+        # chunk's still-buffered tail rows and drop them as late.  So each
+        # chunk is kept with a per-row frontier lane (given by the source,
+        # or synthesized as last-row-only), and a staged batch is stamped
+        # with the running max at ITS last row.
+        self._col_chunks = []
+        self._col_rows = 0
+        # Multi-chip: lay staged batch lanes out data-sharded over the mesh
+        # so downstream sharded programs consume them without a reshard
+        # (parallel/mesh.py batch_sharding).
+        self._stage_target = None
+        if mesh is not None:
+            from windflow_tpu.parallel.mesh import batch_sharding
+            if output_batch_size % math.prod(mesh.devices.shape):
+                raise WindFlowError(
+                    f"output batch size {output_batch_size} not divisible "
+                    f"by the mesh's {math.prod(mesh.devices.shape)} devices")
+            self._stage_target = batch_sharding(mesh)
+
+    def _advance_frontier(self, wm):
+        if wm != WM_NONE and wm > self._frontier:
+            self._frontier = wm
+
+    def emit(self, item, ts, wm, shared=False):
+        # `shared` is irrelevant here: staging materializes new device arrays
+        # from the record's values, never aliasing the host object.
+        self._advance_frontier(wm)
+        self._ob.add(item, ts, wm)
+        if len(self._ob.items) >= self.output_batch_size:
+            self.flush(wm)
+
+    def emit_columns(self, cols, tss, wm, row_wms=None):
+        """Columnar fast path: accumulate SoA chunks, stage full batches with
+        one concatenate + one transfer (reference pinned staging without the
+        per-tuple fill loop, ``forward_emitter_gpu.hpp:254-300``).  See the
+        ``_col_chunks`` note for the watermark lane."""
+        if row_wms is None:
+            # chunk-level wm: valid only after the last row
+            row_wms = np.full(len(tss), WM_NONE, np.int64)
+            if len(tss) and wm != WM_NONE:
+                row_wms[-1] = wm
+        self._col_chunks.append((cols, tss, row_wms))
+        self._col_rows += len(tss)
+        cap = self.output_batch_size
+        if self._col_rows < cap:
+            return
+        names = list(self._col_chunks[0][0])
+        cat = {n: _concat([c[0][n] for c in self._col_chunks])
+               for n in names}
+        tcat = _concat([c[1] for c in self._col_chunks])
+        wcat = np.maximum.accumulate(
+            _concat([c[2] for c in self._col_chunks]))
+        total = len(tcat)
+        for lo in range(0, total - total % cap, cap):
+            hi = lo + cap
+            bwm = int(wcat[hi - 1])
+            self._advance_frontier(bwm)
+            self._stage_columns(
+                {n: a[lo:lo + cap] for n, a in cat.items()},
+                tcat[lo:lo + cap], bwm)
+        rem = total % cap
+        self._col_chunks = [] if rem == 0 else [
+            ({n: a[total - rem:] for n, a in cat.items()},
+             tcat[total - rem:], wcat[total - rem:])]
+        self._col_rows = rem
+
+    def _stage_columns(self, cols, tss, wm):
+        db = columns_to_device(cols, tss, self.output_batch_size,
+                               watermark=wm, device=self._stage_target,
+                               frontier=self._frontier)
+        d = self._next
+        self._next = (self._next + 1) % len(self.dests)
+        self._send(d, db)
+
+    def flush(self, wm):
+        if self._col_chunks:
+            names = list(self._col_chunks[0][0])
+            cat = {n: _concat([c[0][n] for c in self._col_chunks])
+                   for n in names}
+            tcat = _concat([c[1] for c in self._col_chunks])
+            # everything buffered is fully staged by this batch, so the
+            # newest row frontier applies
+            w = int(max(int(c[2].max()) for c in self._col_chunks))
+            self._col_chunks = []
+            self._col_rows = 0
+            self._advance_frontier(w)
+            self._stage_columns(cat, tcat, w if w != WM_NONE else wm)
+        self._advance_frontier(wm)
+        if not self._ob.items:
+            return
+        hb = HostBatch(self._ob.items, self._ob.tss, self._ob.wm)
+        db = host_to_device(hb, capacity=self.output_batch_size,
+                            device=self._stage_target,
+                            frontier=self._frontier)
+        d = self._next
+        self._next = (self._next + 1) % len(self.dests)
+        self._send(d, db)
+        self._ob = _OpenBatch()
+
+
+class KeyedDeviceStageEmitter(Emitter):
+    """Host→TPU boundary with KEYBY routing (reference CPU→GPU
+    ``KeyBy_Emitter_GPU``, ``keyby_emitter_gpu.hpp:400-476``): tuples are
+    partitioned by ``splitmix64(key) % num_dests`` into per-destination
+    staged batches, so every key's tuples flow through exactly one replica
+    in arrival order — the invariant that makes shared per-key device state
+    (ops/tpu_stateful.py) correct at parallelism > 1, exactly as the
+    reference's keyby routing does for its stateful GPU operators
+    (``std::hash % num_dests``, ``keyby_emitter.hpp:216``).  Hashing (the
+    native ``wf_keyby_partition``) rather than a plain modulo keeps
+    structured key sets (all-even ids, strided ids) from landing on one
+    replica."""
+
+    def __init__(self, dests, output_batch_size, key_extractor, mesh=None):
+        super().__init__(dests, output_batch_size)
+        self.key_extractor = key_extractor
+        # one single-destination staging emitter per partition
+        self._inner = [DeviceStageEmitter([d], output_batch_size, mesh=mesh)
+                       for d in dests]
+
+    @staticmethod
+    def _key32(k) -> int:
+        """Truncate a numeric key to the int32 key space the device operator
+        interns (its extractor output is cast to int32 on device) — routing
+        must collapse exactly the keys the state table collapses, or one
+        logical key would straddle replicas."""
+        i = int(k) & 0xFFFFFFFF
+        return i - (1 << 32) if i >= (1 << 31) else i
+
+    def emit(self, item, ts, wm, shared=False):
+        # scalar splitmix64 (bit-identical to the native/columnar path) —
+        # pure int ops, no per-tuple FFI or array allocation
+        h = splitmix64_int(self._key32(self.key_extractor(item)))
+        self._inner[h % len(self.dests)].emit(item, ts, wm)
+
+    def emit_columns(self, cols, tss, wm, row_wms=None):
+        from windflow_tpu import native
+        n = len(self.dests)
+        keys = None
+        try:
+            # Vectorized: per-record key fns are elementwise field math, so
+            # they usually apply directly to the SoA columns.
+            k = np.asarray(self.key_extractor(cols))
+            if k.shape == (len(tss),):
+                # int64→int32: the device's int32 truncation first, so
+                # routing collapses exactly the keys the state collapses
+                keys = k.astype(np.int64).astype(np.int32).astype(np.int64)
+        except Exception:
+            pass
+        if keys is None:
+            # Non-elementwise or scalar-returning extractor: per-row path.
+            keys = np.array(
+                [self._key32(self.key_extractor(
+                    {k: v[i].item() for k, v in cols.items()}))
+                 for i in range(len(tss))], np.int64)
+        # native C hash+count partition (wf_host.cpp wf_keyby_partition)
+        dest, counts = native.keyby_partition(keys, n)
+        for d in range(n):
+            if counts[d]:
+                idx = np.nonzero(dest == d)[0]
+                # the row frontier is global (covers rows of every
+                # partition up to that point), so slicing it per partition
+                # keeps each channel's stamps valid
+                self._inner[d].emit_columns(
+                    {k: v[idx] for k, v in cols.items()}, tss[idx], wm,
+                    row_wms[idx] if row_wms is not None else None)
+
+    def emit_device_batch(self, batch):
+        raise WindFlowError(
+            "keyed staging emitter received a device batch; TPU→TPU keyed "
+            "edges use DeviceKeyByEmitter")
+
+    def flush(self, wm):
+        for e in self._inner:
+            e.flush(wm)
+
+    def propagate_punctuation(self, wm):
+        for e in self._inner:
+            e.propagate_punctuation(wm)
+
+
+class DeviceKeyByEmitter(Emitter):
+    """TPU→TPU KEYBY edge (reference GPU→GPU ``KeyBy_Emitter_GPU``,
+    ``keyby_emitter_gpu.hpp:519-583``): one compiled program splits the batch
+    into ``num_dests`` order-preserving compactions by
+    ``splitmix64(key) % num_dests`` (the same placement as the host-side
+    keyed staging emitter).
+    The reference builds per-key index chains with sort kernels; the XLA
+    expression is a stable argsort per partition.  Empty partitions still
+    ship (a masked all-invalid batch) — skipping them would force a host
+    sync on the partition counts."""
+
+    def __init__(self, dests, key_extractor):
+        super().__init__(dests, output_batch_size=0)
+        self.key_extractor = key_extractor
+        self._splits = {}
+
+    def _get_split(self, capacity: int):
+        import jax
+        import jax.numpy as jnp
+        split = self._splits.get(capacity)
+        if split is None:
+            n = len(self.dests)
+            key_fn = self.key_extractor
+
+            @jax.jit
+            def split(payload, ts, valid, keys):
+                if keys is None:
+                    keys = jax.vmap(key_fn)(payload).astype(jnp.int32)
+                # splitmix64 placement, bit-identical to the host staging
+                # emitter's — a keyed operator fed by both a host edge and
+                # a device edge must see each key on ONE replica
+                h = (_splitmix64_dev(keys) % jnp.uint64(n)).astype(jnp.int32)
+                dest = jnp.where(valid, h, jnp.int32(n))
+                outs = []
+                for d in range(n):
+                    mask = dest == d
+                    order = jnp.argsort(~mask, stable=True)
+                    pay_d = jax.tree.map(lambda a: a[order], payload)
+                    outs.append((pay_d, ts[order], keys[order],
+                                 jnp.arange(capacity) < jnp.sum(mask)))
+                return outs
+
+            self._splits[capacity] = split
+        return split
+
+    def emit_device_batch(self, batch):
+        outs = self._get_split(batch.capacity)(
+            batch.payload, batch.ts, batch.valid, batch.keys)
+        for d, (pay, ts, keys, valid) in enumerate(outs):
+            self._send(d, DeviceBatch(pay, ts, valid, keys=keys,
+                                      watermark=batch.watermark, size=None,
+                                      frontier=batch.frontier))
+
+
+class DevicePassEmitter(Emitter):
+    """TPU→TPU edge: device batches move by handle (no copies, no transfers).
+
+    Forward/rebalancing round-robins destinations; broadcast shares the handle
+    (immutability makes the reference's ``delete_counter`` multicast protocol
+    unnecessary); keyby passes through — key grouping is resolved inside the
+    consuming operator against the batch's key lane, and across chips by
+    resharding collectives (parallel/mesh.py), not by emitter-side splits."""
+
+    def __init__(self, dests, routing: RoutingMode):
+        super().__init__(dests, output_batch_size=0)
+        self.routing = routing
+        self._next = 0
+
+    def emit_device_batch(self, batch: DeviceBatch):
+        if self.routing == RoutingMode.BROADCAST:
+            for d in range(len(self.dests)):
+                self._send(d, batch)
+        else:
+            d = self._next
+            self._next = (self._next + 1) % len(self.dests)
+            self._send(d, batch)
+
+
+class DeviceToHostEmitter(Emitter):
+    """TPU→host boundary (reference GPU→CPU paths,
+    ``keyby_emitter_gpu.hpp:594-638``): transfers the batch back columnar
+    (``device_to_host`` — one bulk copy per lane) and routes the whole
+    HostBatch through the inner host emitter; only keyby falls back to
+    per-tuple routing, as in the reference's per-dest re-split."""
+
+    def __init__(self, inner: Emitter):
+        super().__init__(inner.dests, inner.output_batch_size)
+        self.inner = inner
+
+    def emit(self, item, ts, wm, shared=False):
+        self.inner.emit(item, ts, wm, shared)
+
+    def emit_device_batch(self, batch: DeviceBatch):
+        from windflow_tpu.batch import device_to_host
+        hb = device_to_host(batch)
+        if hb.items:  # all-invalid batches (post-filter, empty split
+            self.inner.emit_host_batch(hb)  # partitions) carry no data
+
+    def emit_host_batch(self, hb):
+        self.inner.emit_host_batch(hb)
+
+    def propagate_punctuation(self, wm):
+        self.inner.propagate_punctuation(wm)
+
+    def flush(self, wm):
+        self.inner.flush(wm)
+
+
+def create_emitter(routing: RoutingMode,
+                   dests,
+                   output_batch_size: int,
+                   src_is_tpu: bool,
+                   dst_is_tpu: bool,
+                   key_extractor: Optional[Callable] = None,
+                   mesh=None) -> Emitter:
+    """Pick the emitter for an edge from (routing, src-on-TPU, dst-on-TPU),
+    mirroring the reference's dispatch (``multipipe.hpp:236-350``)."""
+    if dst_is_tpu:
+        if routing == RoutingMode.KEYBY and len(dests) > 1 \
+                and key_extractor is not None:
+            # Key-partitioned delivery: each key's tuples always reach the
+            # same replica, preserving per-key arrival order for shared
+            # device state (reference: keyby routing is what makes stateful
+            # Map_GPU/Filter_GPU correct across replicas).
+            if src_is_tpu:
+                return DeviceKeyByEmitter(dests, key_extractor)
+            return KeyedDeviceStageEmitter(dests, output_batch_size,
+                                           key_extractor, mesh=mesh)
+        if src_is_tpu:
+            return DevicePassEmitter(dests, routing)
+        return DeviceStageEmitter(dests, output_batch_size, mesh=mesh)
+    # host destination
+    if src_is_tpu and routing != RoutingMode.KEYBY and dests \
+            and all(getattr(r.op, "columnar", False) for r, _ in dests):
+        # Columnar sinks consume DeviceBatches whole (bulk D2H inside the
+        # sink replica, zero per-tuple Python); keyed columnar sinks still
+        # need per-key routing and take the record path below.
+        return DevicePassEmitter(dests, routing)
+    if routing == RoutingMode.KEYBY:
+        inner = KeyByEmitter(dests, output_batch_size, key_extractor)
+    elif routing == RoutingMode.BROADCAST:
+        inner = BroadcastEmitter(dests, output_batch_size)
+    else:
+        inner = ForwardEmitter(dests, output_batch_size)
+    if src_is_tpu:
+        return DeviceToHostEmitter(inner)
+    return inner
+
+
+class SplittingEmitter(Emitter):
+    """Splitting logic at a MultiPipe split point (reference
+    ``splitting_emitter.hpp:49-``): the user function maps a tuple to one
+    branch index or an iterable of indexes; one inner emitter per branch
+    (reference "tree mode", ``splitting_emitter.hpp:65-70``)."""
+
+    def __init__(self, split_fn: Callable, branch_emitters: Sequence[Emitter]):
+        super().__init__([], output_batch_size=0)
+        self.split_fn = split_fn
+        self.branches = list(branch_emitters)
+        self._device_splits = {}  # capacity -> compiled split or None
+
+    def emit(self, item, ts, wm, shared=False):
+        dest = self.split_fn(item)
+        if isinstance(dest, int):
+            self.branches[dest].emit(item, ts, wm, shared)
+        else:
+            dest = list(dest)
+            # Multicast: every branch sees the same object; mark it shared so
+            # in-place consumers copy lazily before mutating — no eager
+            # per-branch deepcopy (reference pairs multicast with the
+            # consumer-side copyOnWrite, map.hpp:57-215).
+            multi = shared or len(dest) > 1
+            for d in dest:
+                self.branches[d].emit(item, ts, wm, multi)
+
+    def _get_device_split(self, capacity: int, payload):
+        """Compile one masked-compaction split program per capacity
+        (reference ``Splitting_Emitter_GPU`` / ``split_gpu``,
+        ``splitting_emitter_gpu.hpp:53``, ``multipipe.hpp:1244-1281``).
+        Requires a JAX-traceable single-destination split function; falls
+        back to the host per-tuple path (returns None) for Python-level or
+        multicast split functions."""
+        if capacity in self._device_splits:
+            return self._device_splits[capacity]
+        import jax
+        import jax.numpy as jnp
+        n = len(self.branches)
+        split_fn = self.split_fn
+        compiled = None
+        try:
+            shape = jax.eval_shape(lambda p: jax.vmap(split_fn)(p), payload)
+            ok = (getattr(shape, "shape", None) == (capacity,)
+                  and jnp.issubdtype(shape.dtype, jnp.integer))
+        except Exception:
+            ok = False
+        if ok:
+            @jax.jit
+            def compiled(payload, ts, valid):
+                idx = jax.vmap(split_fn)(payload).astype(jnp.int32)
+                dest = jnp.where(valid, idx, jnp.int32(n))
+                outs = []
+                for b in range(n):
+                    mask = dest == b
+                    order = jnp.argsort(~mask, stable=True)
+                    pay_b = jax.tree.map(lambda a: a[order], payload)
+                    outs.append((pay_b, ts[order],
+                                 jnp.arange(capacity) < jnp.sum(mask)))
+                return outs
+
+        self._device_splits[capacity] = compiled
+        return compiled
+
+    def emit_device_batch(self, batch: DeviceBatch):
+        split = self._get_device_split(batch.capacity, batch.payload)
+        if split is not None:
+            # Device-native split: one compiled masked compaction per
+            # branch; empty partitions still ship (all-invalid) — skipping
+            # them would force a host sync on the partition counts.
+            outs = split(batch.payload, batch.ts, batch.valid)
+            for b, (pay, ts, valid) in enumerate(outs):
+                self.branches[b].emit_device_batch(
+                    DeviceBatch(pay, ts, valid, watermark=batch.watermark,
+                                size=None, frontier=batch.frontier))
+            return
+        # Fallback: host-side per-tuple split (Python or multicast split fn).
+        from windflow_tpu.batch import device_to_host
+        hb = device_to_host(batch)
+        for item, ts in zip(hb.items, hb.tss):
+            self.emit(item, ts, hb.watermark)
+
+    def propagate_punctuation(self, wm):
+        for b in self.branches:
+            b.propagate_punctuation(wm)
+
+    def flush(self, wm):
+        for b in self.branches:
+            b.flush(wm)

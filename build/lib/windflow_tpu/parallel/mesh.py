@@ -1,0 +1,395 @@
+"""Multi-chip execution: device meshes, key-sharded window state, and
+collective keyed reduction over ICI.
+
+This is the slot the reference fills with thread replication + emitter routing
+(SURVEY.md §2.6 item 10: "GPU offload batching … This is the slot where the
+TPU backend goes").  Where WindFlow scales an operator by cloning replicas
+onto OS threads and hashing keys across lock-free queues
+(``keyby_emitter.hpp:216``), the TPU design scales by **sharding over a
+device mesh**:
+
+* mesh axes ``("data", "key")`` — ``data`` shards the *tuples* of each staged
+  batch (the analogue of replicating stateless operators), ``key`` shards the
+  *keyed state space* (the analogue of KEYBY partitioning of stateful
+  operators).
+* stateless Map/Filter steps run on data-sharded batches with zero
+  communication.
+* keyed windows (:func:`make_sharded_ffat_step`) keep their dense per-key
+  state sharded along ``key``; each key-shard sees the full batch via an
+  ``all_gather`` over ``data`` (tuples ride ICI once) and updates only the
+  keys it owns.
+* keyed reduction (:func:`make_sharded_keyed_reduce`) computes per-chip
+  dense partial tables and combines them across the mesh with ``psum``
+  (sum-like combiners) or a gather+fold (arbitrary associative combiners) —
+  the ICI expression of the reference's ``thrust::reduce_by_key`` +
+  inter-replica merge.
+
+All collectives are XLA collectives over the mesh (``psum``/``all_gather``);
+on real hardware they ride ICI, multi-host meshes extend over DCN with the
+same program (the driver validates this path on a virtual CPU mesh).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Callable, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from windflow_tpu.basic import WindFlowError
+from windflow_tpu.batch import DeviceBatch, HostBatch, host_to_device
+from windflow_tpu.windows.ffat_kernels import (_b, _masked_reduce_last, _seg_scan,
+                                           make_ffat_state, make_ffat_step,
+                                           make_ffat_tb_state,
+                                           make_ffat_tb_step)
+
+DATA_AXIS = "data"
+KEY_AXIS = "key"
+
+
+def make_mesh(n_devices: Optional[int] = None, data: int = 1,
+              devices: Optional[Sequence] = None) -> Mesh:
+    """Create a ``(data, key)`` mesh over the first ``n_devices`` devices.
+
+    ``data`` fixes the data-parallel extent; the key axis takes the rest.
+    With ``data=1`` the mesh degenerates to pure key sharding (the keyed
+    Reduce/FFAT scaling configuration from BASELINE.json)."""
+    devs = list(devices) if devices is not None else list(jax.devices())
+    if n_devices is not None:
+        if len(devs) < n_devices:
+            raise WindFlowError(
+                f"requested {n_devices} devices, only {len(devs)} visible")
+        devs = devs[:n_devices]
+    n = len(devs)
+    if n % data != 0:
+        raise WindFlowError(f"{n} devices not divisible by data={data}")
+    arr = np.array(devs).reshape(data, n // data)
+    return Mesh(arr, (DATA_AXIS, KEY_AXIS))
+
+
+def batch_sharding(mesh: Mesh) -> NamedSharding:
+    """Sharding for staged batch lanes: tuples split along ``data``,
+    replicated along ``key``."""
+    return NamedSharding(mesh, P(DATA_AXIS))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def state_sharding(mesh: Mesh) -> NamedSharding:
+    """Sharding for dense per-key state tables: split along ``key``."""
+    return NamedSharding(mesh, P(KEY_AXIS))
+
+
+def stage_batch(hb: HostBatch, capacity: int, mesh: Mesh) -> DeviceBatch:
+    """Host→mesh staging: pad to ``capacity`` and lay tuples out data-sharded
+    (the multi-chip form of the reference's pinned-staging H2D path)."""
+    db = host_to_device(hb, capacity=capacity)
+    sh = batch_sharding(mesh)
+    return DeviceBatch(
+        jax.tree.map(lambda a: jax.device_put(a, sh), db.payload),
+        jax.device_put(db.ts, sh), jax.device_put(db.valid, sh),
+        watermark=db.watermark, size=db.known_size)
+
+
+# ---------------------------------------------------------------------------
+# Keyed reduce over the mesh (reference Reduce_GPU + cross-replica merge;
+# BASELINE.json: "keyby-sharded Reduce … linear scaling to 8 chips").
+# ---------------------------------------------------------------------------
+
+def _dense_keyed_partial(keys, vals, valid, comb, K):
+    """Per-chip dense partial table: sort by key, segmented scan, scatter the
+    segment tails into rows of a ``[K, ...]`` table.  The XLA/ICI-friendly
+    replacement for ``thrust::sort_by_key`` + ``reduce_by_key``
+    (``reduce_gpu.hpp:227-258``) producing a *dense* table so cross-chip
+    combination is a collective, not a re-shuffle."""
+    sk = jnp.where(valid & (keys >= 0) & (keys < K), keys, K)
+    order = jnp.argsort(sk)
+    sk_s = sk[order]
+    sv = jax.tree.map(lambda a: a[order], vals)
+    starts = jnp.concatenate([jnp.array([True]), sk_s[1:] != sk_s[:-1]])
+    scanned = _seg_scan(comb, starts, sv)
+    ends = jnp.concatenate([sk_s[:-1] != sk_s[1:], jnp.array([True])])
+    row = jnp.where(ends & (sk_s < K), sk_s, K)
+
+    def scat(leaf):
+        buf = jnp.zeros((K + 1,) + leaf.shape[1:], leaf.dtype)
+        return buf.at[row].set(leaf, mode="drop")[:K]
+
+    table = jax.tree.map(scat, scanned)
+    has = jnp.zeros(K + 1, bool).at[row].set(True)[:K]
+    return table, has
+
+
+def make_sharded_reduce_step(mesh: Mesh, capacity: int, K: int,
+                             comb: Callable, key_fn: Optional[Callable],
+                             use_psum: bool = False):
+    """Sharded ReduceTPU step with the operator's batch contract: returns
+    ``fn(payload, ts, valid) -> (table, ts_out, has, n_dropped)`` where
+    ``table`` is the dense ``[K]`` combined-record table, ``ts_out`` the
+    per-key max input timestamp, ``has`` the occupancy mask — i.e. a
+    DeviceBatch of capacity ``K`` whose valid lanes are the distinct keys —
+    and ``n_dropped`` the count of valid tuples whose key fell outside
+    ``[0, K)`` (the dense tables cannot hold them; the count surfaces in
+    stats rather than vanishing silently).  This is what ``ReduceTPU``
+    compiles when the graph runs on a mesh (Config.mesh): per-chip dense
+    partials over the flattened ``(data, key)`` axes combined with psum
+    (sum-like combiners) or all_gather + log-fold (reference: Reduce_GPU per
+    replica + cross-replica merge, ``reduce_gpu.hpp:227-283``).
+
+    Non-keyed reduces pass ``key_fn=None`` with ``K == 1`` (the
+    ``thrust::reduce`` global path)."""
+    n_total = math.prod(mesh.devices.shape)
+    if capacity % n_total:
+        raise WindFlowError(
+            f"capacity {capacity} not divisible by {n_total} devices")
+    axes = (DATA_AXIS, KEY_AXIS)
+
+    def local(payload, ts, valid):
+        if key_fn is not None:
+            keys = jax.vmap(key_fn)(payload).astype(jnp.int32)
+        else:
+            keys = jnp.zeros(ts.shape[0], jnp.int32)
+        n_drop = jnp.sum(valid & ((keys < 0) | (keys >= K)),
+                         dtype=jnp.int64)
+        n_drop = jax.lax.psum(n_drop, axes)
+        # fold ts with the payload so the segment tails carry max-ts too
+        vals = (payload, ts)
+        comb2 = lambda a, b: (comb(a[0], b[0]), jnp.maximum(a[1], b[1]))
+        (table, ts_t), has = _dense_keyed_partial(keys, vals, valid, comb2, K)
+        if use_psum:
+            z = jax.tree.map(lambda a: jnp.where(_b(has, a), a, 0), table)
+            out = jax.tree.map(lambda a: jax.lax.psum(a, axes), z)
+            ts_out = jax.lax.pmax(jnp.where(has, ts_t, jnp.int64(-1)), axes)
+            any_has = jax.lax.psum(has.astype(jnp.int32), axes) > 0
+            return out, ts_out, any_has, n_drop
+        g_t = jax.tree.map(lambda a: jax.lax.all_gather(a, axes),
+                           (table, ts_t))
+        g_h = jax.lax.all_gather(has, axes)
+        anyf, (folded, ts_f) = _masked_reduce_last(comb2, g_h, g_t, axis=0)
+        return folded, ts_f, anyf, n_drop
+
+    fn = jax.shard_map(local, mesh=mesh,
+                       in_specs=(P(axes), P(axes), P(axes)),
+                       out_specs=(P(), P(), P(), P()), check_vma=False)
+    return jax.jit(fn)
+
+
+def make_sharded_reduce_arbitrary(mesh: Mesh, capacity: int, comb: Callable,
+                                  key_fn: Callable):
+    """Keyed reduce over the mesh for an ARBITRARY int32 key space — no
+    ``withMaxKeys`` bound and no dropped keys (VERDICT r2 item 5).
+
+    Keys are hash-sharded: each chip buckets its local lanes by owner chip
+    (``key mod n`` on the uint32 reinterpretation), one ``all_to_all`` over
+    ICI routes every lane to its owner, and each chip then runs the plain
+    sort + segmented reduce over the keys it owns (the distributed form of
+    the reference's arbitrary-key ``thrust::sort_by_key`` +
+    ``reduce_by_key``, ``reduce_gpu.hpp:227-258``, with the shuffle the
+    reference does between replicas done as one collective).
+
+    Returns ``fn(payload, ts, valid) -> (payload, ts, valid, n_dropped)``;
+    each chip's distinct-key rows are left-compacted into its ``[capacity]``
+    block of the concatenated output (worst case one chip owns every key,
+    so the per-chip block cannot shrink below ``capacity``); ``n_dropped``
+    is always 0 — nothing is out of range by construction."""
+    axes = (DATA_AXIS, KEY_AXIS)
+    n = math.prod(mesh.devices.shape)
+    if capacity % n:
+        raise WindFlowError(
+            f"capacity {capacity} not divisible by {n} devices")
+    local_cap = capacity // n
+
+    def local(payload, ts, valid):
+        from windflow_tpu.ops.tpu import _segmented_reduce
+        keys = jax.vmap(key_fn)(payload).astype(jnp.int32)
+        owner = jnp.where(valid,
+                          (keys.astype(jnp.uint32) % n).astype(jnp.int32),
+                          jnp.int32(n))
+        # group local lanes by owner: rank within the owner run indexes the
+        # outgoing bucket row (a run can never exceed local_cap lanes)
+        order = jnp.argsort(owner, stable=True)
+        so = owner[order]
+        sp = jax.tree.map(lambda a: a[order], payload)
+        st, sv = ts[order], valid[order]
+        pos = jnp.arange(local_cap)
+        starts = jnp.concatenate([jnp.array([True]), so[1:] != so[:-1]])
+        seg_start = jax.lax.associative_scan(
+            jnp.maximum, jnp.where(starts, pos, 0))
+        rank = (pos - seg_start).astype(jnp.int32)
+        row = jnp.where(sv & (so < n), so, n)
+
+        def scat(leaf):
+            buf = jnp.zeros((n + 1, local_cap) + leaf.shape[1:], leaf.dtype)
+            return buf.at[row, rank].set(leaf)[:n]
+        bp = jax.tree.map(scat, sp)
+        bt = scat(st)
+        bmask = jnp.zeros((n + 1, local_cap), bool) \
+            .at[row, rank].set(sv & (so < n))[:n]
+        # one collective: bucket row i of every chip lands on chip i
+        a2a = lambda x: jax.lax.all_to_all(x, axes, split_axis=0,
+                                           concat_axis=0, tiled=True)
+        rp = jax.tree.map(a2a, bp)
+        rt, rm = a2a(bt), a2a(bmask)
+        flat = lambda a: a.reshape((capacity,) + a.shape[2:])
+        rp = jax.tree.map(flat, rp)
+        rt, rm = flat(rt), flat(rm)
+        rkeys = jax.vmap(key_fn)(rp).astype(jnp.int32)
+        _, out_payload, out_ts, out_valid = _segmented_reduce(
+            rkeys, rp, rt, rm, comb, capacity)
+        return out_payload, out_ts, out_valid, jnp.zeros((), jnp.int64)
+
+    fn = jax.shard_map(local, mesh=mesh,
+                       in_specs=(P(axes), P(axes), P(axes)),
+                       out_specs=(P(axes), P(axes), P(axes), P()),
+                       check_vma=False)
+    return jax.jit(fn)
+
+
+def make_sharded_keyed_reduce(mesh: Mesh, capacity: int, K: int,
+                              comb: Callable, key_fn: Callable,
+                              use_psum: bool = False):
+    """Compile a keyed reduce over the whole mesh; thin wrapper over
+    :func:`make_sharded_reduce_step` (one implementation of the collective
+    combine) that drops the timestamp/drop-count outputs.  Returns
+    ``fn(payload, valid) -> (table, has)`` with both outputs replicated on
+    every chip."""
+    step = make_sharded_reduce_step(mesh, capacity, K, comb, key_fn,
+                                    use_psum=use_psum)
+
+    def fn(payload, valid):
+        ts = jnp.zeros(valid.shape[0], jnp.int64)
+        table, _, has, _ = step(payload, ts, valid)
+        return table, has
+
+    return jax.jit(fn)
+
+
+# ---------------------------------------------------------------------------
+# Key-sharded FFAT windows (reference Ffat_Windows_GPU replicas each owning a
+# key subset; here shards of one dense state table own key ranges).
+# ---------------------------------------------------------------------------
+
+def _ffat_shard_layout(mesh: Mesh, capacity: int, K: int):
+    """Shared guards + layout for key-sharded FFAT variants: returns
+    ``(K_local, key_base_fn, gather)`` where ``gather`` replicates the
+    data-sharded batch lanes across the ``data`` axis (one all_gather over
+    ICI; identity on a 1-wide data axis)."""
+    kk = mesh.shape[KEY_AXIS]
+    dd = mesh.shape[DATA_AXIS]
+    if K % kk:
+        raise WindFlowError(f"max_keys {K} not divisible by key axis {kk}")
+    if capacity % dd:
+        raise WindFlowError(
+            f"capacity {capacity} not divisible by data axis {dd}")
+    K_local = K // kk
+    key_base_fn = lambda: jax.lax.axis_index(KEY_AXIS) * K_local
+
+    def gather(payload, ts, valid):
+        if dd == 1:
+            return payload, ts, valid
+        ag = lambda a: jax.lax.all_gather(a, DATA_AXIS, axis=0, tiled=True)
+        return jax.tree.map(ag, payload), ag(ts), ag(valid)
+
+    return K_local, key_base_fn, gather
+
+
+def make_sharded_ffat_step(mesh: Mesh, capacity: int, K: int, Pn: int, R: int,
+                           D: int, lift: Callable, comb: Callable,
+                           key_fn: Optional[Callable]):
+    """Compile one FFAT window step sharded over the mesh.
+
+    State tables are split along ``key`` (chip *i* owns keys
+    ``[i*K/kk, (i+1)*K/kk)``); the staged batch arrives data-sharded and is
+    ``all_gather``-ed across ``data`` inside the program so every key shard
+    sees every tuple exactly once over ICI.  Fired-window outputs come back
+    key-sharded, one row block per chip."""
+    K_local, key_base_fn, gather = _ffat_shard_layout(mesh, capacity, K)
+    step_local = make_ffat_step(capacity, K_local, Pn, R, D, lift, comb,
+                                key_fn, key_base_fn=key_base_fn)
+
+    def local(state, payload, ts, valid):
+        payload, ts, valid = gather(payload, ts, valid)
+        return step_local(state, payload, ts, valid)
+
+    fn = jax.shard_map(
+        local, mesh=mesh,
+        in_specs=(P(KEY_AXIS), P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS)),
+        out_specs=(P(KEY_AXIS), P(KEY_AXIS), P(KEY_AXIS), P(KEY_AXIS)),
+        check_vma=False)
+    return jax.jit(fn, donate_argnums=(0,))
+
+
+def make_sharded_ffat_state(agg_spec, K: int, R: int, mesh: Mesh):
+    """Allocate the dense FFAT state pre-sharded along ``key``."""
+    state = make_ffat_state(agg_spec, K, R)
+    sh = state_sharding(mesh)
+    return jax.tree.map(lambda a: jax.device_put(a, sh), state)
+
+
+# Time-based FFAT on the mesh.  The single-chip TB state keeps scalar pane
+# clocks shared by all keys (ffat_kernels.make_ffat_tb_state); sharded along
+# ``key`` each shard's ring evolves independently — its capacity roll depends
+# on the panes of the keys it owns — so the scalars become one lane per key
+# shard, sharded the same way as the ``[K, NP]`` cells.
+_TB_SCALARS = ("base", "win_next", "max_seen", "n_late", "n_evicted",
+               "n_win_dropped")
+
+
+def make_sharded_ffat_tb_state(agg_spec, K: int, NP: int, mesh: Mesh):
+    """Allocate the TB pane-ring state pre-sharded along ``key``: cells split
+    by key rows, one scalar-clock lane per key shard."""
+    kk = mesh.shape[KEY_AXIS]
+    state = make_ffat_tb_state(agg_spec, K, NP)
+    for name in _TB_SCALARS:
+        state[name] = jnp.broadcast_to(state[name], (kk,))
+    sh = state_sharding(mesh)
+    return jax.tree.map(lambda a: jax.device_put(a, sh), state)
+
+
+def make_sharded_ffat_tb_step(mesh: Mesh, capacity: int, K: int, P_usec: int,
+                              R: int, D: int, NP: int, lift: Callable,
+                              comb: Callable, key_fn: Optional[Callable],
+                              drop_tainted: bool = False):
+    """Compile one time-based FFAT step sharded over the mesh.
+
+    Same layout as the CB variant (:func:`make_sharded_ffat_step`): state
+    split along ``key`` — chip *i* owns keys ``[i*K/kk, (i+1)*K/kk)`` and its
+    own pane-ring clock — the data-sharded batch ``all_gather``-ed across
+    ``data`` so every key shard sees every tuple once over ICI, and the
+    watermark pane frontier passed replicated (it is host metadata, identical
+    on every chip).  Reference: ``Ffat_Windows_GPU`` TB replicas each owning
+    a key subset with quantum panes, ``ffat_replica_gpu.hpp:92-216,438-514``."""
+    K_local, key_base_fn, gather = _ffat_shard_layout(mesh, capacity, K)
+    step_local = make_ffat_tb_step(capacity, K_local, P_usec, R, D, NP,
+                                   lift, comb, key_fn,
+                                   key_base_fn=key_base_fn,
+                                   drop_tainted=drop_tainted)
+
+    def local(state, payload, ts, valid, wm_pane):
+        payload, ts, valid = gather(payload, ts, valid)
+        sstate = {k: (v[0] if k in _TB_SCALARS else v)
+                  for k, v in state.items()}
+        new_state, out, fired, out_ts, n_adv = step_local(
+            sstate, payload, ts, valid, wm_pane)
+        new_state = {k: (v[None] if k in _TB_SCALARS else v)
+                     for k, v in new_state.items()}
+        # Total window advance across key shards (drivers loop flushes on
+        # it).  Along ``data`` the value is already replicated — every data
+        # row of a key shard saw the same gathered batch — so summing over
+        # KEY_AXIS alone keeps it both exact and mesh-replicated.
+        n_adv = jax.lax.psum(n_adv, KEY_AXIS)
+        return new_state, out, fired, out_ts, n_adv
+
+    sspec = {k: P(KEY_AXIS) for k in
+             ("cells", "cell_valid", "horizon") + _TB_SCALARS}
+    fn = jax.shard_map(
+        local, mesh=mesh,
+        in_specs=(sspec, P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS), P()),
+        out_specs=(sspec, P(KEY_AXIS), P(KEY_AXIS), P(KEY_AXIS), P()),
+        check_vma=False)
+    return jax.jit(fn, donate_argnums=(0,))

@@ -1,0 +1,147 @@
+"""Multi-host execution: DCN-aware meshes and process-local staging.
+
+The reference is explicitly single-node ("At the moment WindFlow is for
+single-node execution", ``README.md:15``); its scale-out story ends at
+OS threads + lock-free queues.  The TPU design extends the same two mesh
+axes across hosts:
+
+* **key axis across DCN, data axis within ICI.**  Keyed state (dense
+  per-key tables: windows, reduces) is sharded over the key axis, which is
+  laid out so host boundaries fall along it.  The per-step ``all_gather``
+  of staged tuples happens over the *data* axis — entirely within each
+  host's ICI domain — while only the small dense partial tables (keyed
+  reduce ``psum``) ever cross DCN.  That is the bandwidth hierarchy the
+  scaling recipe prescribes: bulk traffic on ICI, reductions on DCN.
+* Every process runs the same host driver; each stages only its local
+  shard of the batch (``stage_local``), and XLA's collectives do the rest.
+
+``initialize()`` wraps ``jax.distributed.initialize`` (coordinator address /
+process count / process id from arguments or the standard environment
+variables).  On one process everything degenerates to the single-host mesh
+layer (``parallel/mesh.py``) — which is also how the test suite exercises
+this module, by emulating host groups on a virtual CPU mesh.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from windflow_tpu.basic import WindFlowError
+from windflow_tpu.batch import DeviceBatch, HostBatch, host_to_device
+from windflow_tpu.parallel.mesh import DATA_AXIS, KEY_AXIS, batch_sharding
+
+_initialized = False
+
+
+def initialize(coordinator_address: Optional[str] = None,
+               num_processes: Optional[int] = None,
+               process_id: Optional[int] = None) -> None:
+    """Join the multi-host job (no-op when single-process or already
+    joined).  Arguments default to the standard JAX coordinator environment
+    (``JAX_COORDINATOR_ADDRESS`` etc.), exactly as ``jax.distributed``."""
+    global _initialized
+    if _initialized:
+        return
+    if coordinator_address is None and num_processes in (None, 1):
+        _initialized = True  # single-process: nothing to join
+        return
+    jax.distributed.initialize(coordinator_address=coordinator_address,
+                               num_processes=num_processes,
+                               process_id=process_id)
+    _initialized = True
+
+
+def make_multihost_mesh(local_data: int = 1,
+                        devices: Optional[Sequence] = None,
+                        emulate_hosts: Optional[int] = None) -> Mesh:
+    """Build the ``(data, key)`` mesh with host boundaries along the key
+    axis.
+
+    ``local_data`` is the data-parallel extent *within* each host (its
+    devices split ``local_data × local_key``); the key axis concatenates
+    every host's key block, so keyed state shards across hosts and the
+    data-axis ``all_gather`` of staged tuples never leaves a host's ICI
+    domain.
+
+    ``emulate_hosts`` partitions a single process's devices into that many
+    virtual host groups — the testing configuration (virtual CPU mesh); on
+    a real multi-host job leave it None and the actual process topology is
+    used."""
+    if devices is not None:
+        devs = list(devices)
+        groups = _split_groups(devs, emulate_hosts or 1)
+    elif emulate_hosts:
+        devs = list(jax.devices())
+        groups = _split_groups(devs, emulate_hosts)
+    else:
+        devs = list(jax.devices())
+        n_proc = jax.process_count()
+        if n_proc == 1:
+            groups = [devs]
+        else:
+            groups = [[] for _ in range(n_proc)]
+            for d in devs:
+                groups[d.process_index].append(d)
+    local = len(groups[0])
+    if any(len(g) != local for g in groups):
+        raise WindFlowError("hosts expose unequal device counts")
+    if local % local_data:
+        raise WindFlowError(
+            f"{local} devices per host not divisible by "
+            f"local_data={local_data}")
+    local_key = local // local_data
+    arr = np.empty((local_data, len(groups) * local_key), dtype=object)
+    for p, g in enumerate(groups):
+        block = np.array(g, dtype=object).reshape(local_data, local_key)
+        arr[:, p * local_key:(p + 1) * local_key] = block
+    return Mesh(arr, (DATA_AXIS, KEY_AXIS))
+
+
+def _split_groups(devs, n_groups: int):
+    if len(devs) % n_groups:
+        raise WindFlowError(
+            f"{len(devs)} devices not divisible into {n_groups} host groups")
+    per = len(devs) // n_groups
+    return [devs[i * per:(i + 1) * per] for i in range(n_groups)]
+
+
+def stage_local(hb: HostBatch, capacity: int, mesh: Mesh,
+                spec: Optional[P] = None) -> DeviceBatch:
+    """Stage a host batch on a (possibly multi-process) mesh.
+
+    Single-process: plain sharded ``device_put``.  Multi-process: this
+    process contributes only its slice of the global batch —
+    ``capacity`` is the *global* lane count, ``hb`` holds the lanes this
+    process ingested (``capacity / process_count`` of them), and the global
+    array is assembled with ``jax.make_array_from_process_local_data``.
+
+    The default spec shards lanes over every mesh axis (the keyed-reduce
+    ingest layout, where any host may ingest any tuple).  Key-sharded
+    window state instead wants each tuple ingested by the host owning its
+    key — that is upstream KEYBY routing's job (e.g. Kafka partition
+    assignment per host), after which each host group runs the data-axis
+    ``all_gather`` purely inside its own ICI domain."""
+    if spec is None:
+        spec = P((DATA_AXIS, KEY_AXIS))
+    sh = NamedSharding(mesh, spec)
+    if jax.process_count() == 1:
+        db = host_to_device(hb, capacity=capacity)
+        return DeviceBatch(
+            jax.tree.map(lambda a: jax.device_put(a, sh), db.payload),
+            jax.device_put(db.ts, sh), jax.device_put(db.valid, sh),
+            watermark=db.watermark, size=db.known_size)
+    local_cap = capacity // jax.process_count()
+    db = host_to_device(hb, capacity=local_cap)
+
+    def assemble(local_arr):
+        return jax.make_array_from_process_local_data(
+            sh, np.asarray(local_arr), (capacity,) + local_arr.shape[1:])
+
+    return DeviceBatch(
+        jax.tree.map(assemble, db.payload),
+        assemble(db.ts), assemble(db.valid),
+        watermark=db.watermark, size=None)

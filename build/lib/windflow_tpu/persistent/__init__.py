@@ -1,0 +1,21 @@
+"""Persistent operator suite: keyed state in an embedded, durable KV store.
+
+TPU-native re-design of the reference's RocksDB integration
+(``/root/reference/wf/persistent/`` — see SURVEY.md §2.7): the store itself
+is the native log-structured ``wf_kv`` (native/wf_kv.cpp) instead of
+RocksDB, the operators do the same per-input keyed read-modify-write, and
+persistent keyed windows spill archive fragments to the store so window
+state can exceed RAM.
+"""
+
+from windflow_tpu.persistent.builders import (P_Filter_Builder,
+                                              P_FlatMap_Builder,
+                                              P_Keyed_Windows_Builder,
+                                              P_Map_Builder,
+                                              P_Reduce_Builder,
+                                              P_Sink_Builder)
+from windflow_tpu.persistent.db_handle import DBHandle
+from windflow_tpu.persistent.kv import LogKV
+from windflow_tpu.persistent.ops import (PFilter, PFlatMap, PMap, PReduce,
+                                         PSink)
+from windflow_tpu.persistent.p_windows import PKeyedWindows, SpillingArchive

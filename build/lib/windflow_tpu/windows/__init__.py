@@ -1,0 +1,6 @@
+from windflow_tpu.windows.ops import (KeyedWindows, ParallelWindows,
+                                      PanedWindows, MapReduceWindows,
+                                      WindowResult)
+from windflow_tpu.windows.flatfat import FlatFAT
+from windflow_tpu.windows.ffat_op import FfatWindows
+from windflow_tpu.windows.ffat_tpu import FfatWindowsTPU

@@ -1,0 +1,567 @@
+"""Pure FFAT device-program builders (no operator-layer dependencies).
+
+The segmented-scan / pane / window-firing programs shared by the single-chip
+operator (``windows/ffat_tpu.py``) and the multi-chip sharded path
+(``parallel/mesh.py``).  Kept free of ``ops``/``graph`` imports so the
+distribution layer can use them without cycles.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def _seg_scan(comb, flags, values):
+    """Inclusive segmented scan: within each flagged segment, fold ``comb``.
+    ``values`` is a pytree of [B, ...] leaves; ``flags`` [B] marks segment
+    starts."""
+    def op(a, b):
+        fa, va = a
+        fb, vb = b
+        combined = comb(va, vb)
+        v = jax.tree.map(
+            lambda c, nb: jnp.where(_b(fb, c), nb, c), combined, vb)
+        return (fa | fb, v)
+
+    _, scanned = jax.lax.associative_scan(op, (flags, values))
+    return scanned
+
+
+def _masked_reduce_last(comb, flags, values, axis):
+    """Reduce ``values`` along ``axis`` with ``comb``, skipping entries whose
+    flag is False; returns (any_flag, reduction).  Flag-aware monoid:
+    associative, no identity needed."""
+    fc = _flag_comb(comb)
+
+    def op(a, b):
+        return fc(*a, *b)
+
+    f, v = jax.lax.associative_scan(op, (flags, values), axis=axis)
+    take = lambda x: jax.lax.index_in_dim(x, x.shape[axis] - 1, axis,
+                                          keepdims=False)
+    return take(f), jax.tree.map(take, v)
+
+
+def _b(mask, ref):
+    """Broadcast a bool mask against a leaf with trailing dims."""
+    return mask.reshape(mask.shape + (1,) * (ref.ndim - mask.ndim))
+
+
+def _shift_right(flags, values, k: int, axis: int):
+    """Shift along ``axis`` by ``k`` positions (toward higher indices),
+    filling vacated slots with invalid entries."""
+    if k == 0:
+        return flags, values
+
+    def shift_leaf(a):
+        pad = [(0, 0)] * a.ndim
+        pad[axis] = (k, 0)
+        s = [slice(None)] * a.ndim
+        s[axis] = slice(0, a.shape[axis])
+        return jnp.pad(a, pad)[tuple(s)]  # bool pads False = invalid fill
+
+    return shift_leaf(flags), jax.tree.map(shift_leaf, values)
+
+
+def _flag_comb(comb):
+    """Flag-aware combine: invalid operands are skipped (associative monoid
+    without needing an identity element)."""
+    def op(fa, va, fb, vb):
+        both = comb(va, vb)
+        v = jax.tree.map(
+            lambda c, xa, xb: jnp.where(_b(fb, c),
+                                        jnp.where(_b(fa, c), c, xb), xa),
+            both, va, vb)
+        return fa | fb, v
+    return op
+
+
+def _sliding_reduce(comb, flags, values, R: int, axis: int):
+    """``out[i] = fold(comb)`` over the valid entries among positions
+    ``[i-R+1, i]`` along ``axis``.  Dilated doubling: ``log2(R)`` combines
+    build power-of-two window aggregates, then the binary decomposition of
+    ``R`` stitches them — the log-depth trick of the reference's FlatFAT
+    levels (``flatfat_gpu.hpp:60-139``) expressed as shifts instead of a
+    tree, so nothing larger than the pane sequence is ever materialized."""
+    op = _flag_comb(comb)
+    # pow2[j] aggregates windows of width 2^j ending at each position
+    pow2 = [(flags, values)]
+    width = 1
+    while width * 2 <= R:
+        f, v = pow2[-1]
+        fs, vs = _shift_right(f, v, width, axis)
+        pow2.append(op(fs, vs, f, v))
+        width *= 2
+    # stitch R = sum of powers, walking from the window's newest end
+    # backward; each added chunk sits *before* the accumulated suffix, so
+    # it is the left operand of comb (order matters for non-commutative
+    # combiners)
+    res = None
+    offset = 0
+    for j in range(len(pow2) - 1, -1, -1):
+        w = 1 << j
+        if R & w:
+            f, v = _shift_right(*pow2[j], offset, axis)
+            res = (f, v) if res is None else op(f, v, *res)
+            offset += w
+    return res
+
+
+def make_ffat_step(capacity: int, K: int, P: int, R: int, D: int,
+                   lift: Callable, comb: Callable,
+                   key_fn: Optional[Callable],
+                   key_base_fn: Optional[Callable[[], Any]] = None):
+    """Build the (un-jitted) FFAT per-batch program.
+
+    Pure-function form of the operator step so the multi-chip layer
+    (``parallel/mesh.py``) can trace it *inside* ``shard_map`` with a per-shard
+    key base: when ``key_base_fn`` is given, raw keys are rebased by its traced
+    value, so a chip owning keys ``[base, base+K)`` sees them as ``[0, K)`` and
+    out-of-range keys are masked out (the dense-key sharding answer to the
+    reference's per-key device state, ``ffat_replica_gpu.hpp:438-514``).
+
+    The output batch is COMPACTED on device: the worst case for ONE key is
+    the whole batch (``capacity/(P*D)`` windows), but the *total* windows a
+    batch can fire across all keys has the same bound (plus a per-key
+    partial), so the egress batch is ``MAXO ~ capacity/(P*D) + 2K`` rows
+    where a dense per-key grid would hold millions.  Firing is a per-key
+    prefix of window ids, so compaction is pure index arithmetic — a K-long
+    running sum + searchsorted — never a dense-grid scatter (a dense-grid
+    device→host copy per step would dominate any end-to-end pipeline; the
+    reference's ``numWinsPerBatch`` output buffer is likewise sized to
+    fired windows, not the worst case, ``flatfat_gpu.hpp:60-139``)."""
+    NP1 = capacity // P + 2           # pane cells incl. continuation cell
+    # total fired across all keys: sum_k panes_k/D + per-key partials
+    MAXO = capacity // (P * D) + 2 * K + 8
+
+    def step(state, payload, ts, valid):
+        B = capacity
+        kb = key_base_fn() if key_base_fn is not None else None
+        keys = jax.vmap(key_fn)(payload).astype(jnp.int32) \
+            if key_fn is not None else jnp.zeros(B, jnp.int32)
+        if kb is not None:
+            keys = keys - jnp.int32(kb)
+        ok = valid & (keys >= 0) & (keys < K)
+        skey_for_sort = jnp.where(ok, keys, K)
+        order = jnp.argsort(skey_for_sort, stable=True)
+        sk = skey_for_sort[order]
+        slift = jax.tree.map(lambda a: a[order],
+                             jax.vmap(lift)(payload))
+        pos = jnp.arange(B)
+        starts = jnp.concatenate([jnp.array([True]), sk[1:] != sk[:-1]])
+        seg_start_pos = jax.lax.associative_scan(
+            jnp.maximum, jnp.where(starts, pos, 0))
+        rank = pos - seg_start_pos
+
+        n_k = jax.ops.segment_sum(ok[order].astype(jnp.int32), sk,
+                                  num_segments=K + 1)[:K]
+        fill0 = state["cur_fill"][jnp.minimum(sk, K - 1)]
+        pane_rel = ((fill0 + rank) // P).astype(jnp.int32)
+
+        # pane partials: segmented scan over (key, pane) runs
+        pane_starts = starts | jnp.concatenate(
+            [jnp.array([True]), pane_rel[1:] != pane_rel[:-1]])
+        scanned = _seg_scan(comb, pane_starts, slift)
+        ends = jnp.concatenate(
+            [(sk[1:] != sk[:-1]) | (pane_rel[1:] != pane_rel[:-1]),
+             jnp.array([True])])
+        # scatter segment-end partials into dense [K+1, NP1] cells
+        row = jnp.where(ends, sk, K)
+        col = jnp.where(ends, pane_rel, 0)
+        def scat(leaf):
+            buf = jnp.zeros((K + 1, NP1) + leaf.shape[1:], leaf.dtype)
+            return buf.at[row, col].set(
+                jnp.where(_b(ends, leaf), leaf, 0))[:K]
+        cells = jax.tree.map(scat, scanned)
+        cell_has = jnp.zeros((K + 1, NP1), bool) \
+            .at[row, col].set(ends)[:K]
+
+        # merge continuation cell with the carried partial pane
+        def merge0(cur_leaf, cell_leaf):
+            both = comb(cur_leaf, cell_leaf[:, 0])
+            use_cur = state["cur_valid"]
+            use_cell = cell_has[:, 0]
+            v = jnp.where(_b(use_cur & use_cell, both), both,
+                          jnp.where(_b(use_cur, both), cur_leaf,
+                                    cell_leaf[:, 0]))
+            return cell_leaf.at[:, 0].set(v)
+        cells = jax.tree.map(
+            lambda cur_leaf, cell_leaf: merge0(cur_leaf, cell_leaf),
+            state["cur"], cells)
+
+        m_k = ((state["cur_fill"] + n_k) // P).astype(jnp.int32)
+        new_fill = ((state["cur_fill"] + n_k) % P).astype(jnp.int32)
+
+        # full pane sequence: carry (R-1 trailing) + this batch's panes
+        full = jax.tree.map(
+            lambda c, p: jnp.concatenate([c, p], axis=1),
+            state["carry"], cells)
+        col_ix = jnp.arange(NP1)[None, :]
+        pane_valid = col_ix < m_k[:, None]
+        full_valid = jnp.concatenate([state["carry_valid"], pane_valid],
+                                     axis=1)
+
+        # fire windows: key k fires ends e = win_next[k] + j*D while
+        # e <= done[k] — a per-key PREFIX, so no dense [K, MW] firing grid
+        # is ever needed: per-key counts + a searchsorted over their running
+        # sum enumerate the fired (key, window) pairs directly in compacted
+        # order.  The sliding fold (log2(R) dilated combines over the
+        # [K, R-1+NP1] pane sequence) stays dense; window values are
+        # gathered only at the MAXO compacted output slots.
+        done = state["pane_base"] + m_k
+        _, swin = _sliding_reduce(comb, full_valid, full, R, axis=1)
+
+        n_fired = jnp.maximum(
+            jnp.int64(0), (done - state["win_next"]) // D + 1)
+        new_win_next = state["win_next"] + n_fired * D
+
+        # new carry: panes [pane_base+m_k-(R-1), pane_base+m_k)
+        cidx = m_k[:, None] + jnp.arange(R - 1)[None, :]       # [K, R-1]
+        def carry_leaf(a):
+            idx = cidx.reshape(K, R - 1, *([1] * (a.ndim - 2)))
+            idx = jnp.broadcast_to(idx, (K, R - 1) + a.shape[2:])
+            return jnp.take_along_axis(a, idx, axis=1)
+        new_carry = jax.tree.map(carry_leaf, full)
+        new_carry_valid = jnp.take_along_axis(full_valid, cidx, axis=1)
+
+        def cur_leaf(cell_leaf):
+            idx = m_k.reshape(K, 1, *([1] * (cell_leaf.ndim - 2)))
+            idx = jnp.broadcast_to(idx, (K, 1) + cell_leaf.shape[2:])
+            return jnp.take_along_axis(cell_leaf, idx, axis=1)[:, 0]
+        new_cur = jax.tree.map(cur_leaf, cells)
+        new_cur_valid = new_fill > 0
+
+        new_state = {
+            "carry": new_carry,
+            "carry_valid": new_carry_valid,
+            "cur": new_cur,
+            "cur_valid": new_cur_valid,
+            "cur_fill": new_fill,
+            "pane_base": done,
+            "win_next": new_win_next,
+        }
+
+        # output batch (see docstring): compacted slot i belongs to the key
+        # whose fired-count running sum first exceeds i; everything else is
+        # per-slot arithmetic + one gather from the sliding fold.
+        offs = jnp.cumsum(n_fired)                             # [K]
+        n_out = offs[K - 1]
+        i_slot = jnp.arange(MAXO, dtype=jnp.int64)
+        k_out = jnp.searchsorted(offs, i_slot, side="right") \
+            .astype(jnp.int32)                                 # [MAXO]
+        k_c = jnp.minimum(k_out, K - 1)
+        j_out = i_slot - (offs[k_c] - n_fired[k_c])            # rank in key
+        e_out = state["win_next"][k_c] + j_out * D
+        # window value: sliding-fold cell at the window's end pane
+        widx_out = jnp.clip(
+            (e_out - state["pane_base"][k_c] + (R - 2)).astype(jnp.int32),
+            0, R - 1 + NP1 - 1)                                # [MAXO]
+        wvals_out = jax.tree.map(lambda a: a[k_c, widx_out], swin)
+        out = {
+            "key": k_c + (jnp.int32(kb) if kb is not None else 0),
+            "wid": (e_out - R) // D,
+            "value": wvals_out,
+        }
+        out_valid = i_slot < n_out
+        batch_ts = jnp.max(jnp.where(valid, ts, 0))
+        out_ts = jnp.where(out_valid, batch_ts, 0)
+        return new_state, out, out_valid, out_ts
+
+    return step
+
+
+def make_ffat_tb_state(agg_spec, K: int, NP: int):
+    """Dense pane-ring state for time-based FFAT: column ``i`` of ``cells``
+    holds the aggregate of time pane ``base + i`` (pane = ts // P_usec) for
+    each key.  All keys share the pane clock, so ``base``/``win_next`` are
+    scalars — unlike the count-based state, no per-key fill tracking is
+    needed (the TPU re-design of the reference's TB quantum panes,
+    ``ffat_replica_gpu.hpp:92-216``)."""
+    zeros = lambda shape: jax.tree.map(
+        lambda s: jnp.zeros(shape + s.shape, s.dtype), agg_spec)
+    return {
+        "cells": zeros((K, NP)),
+        "cell_valid": jnp.zeros((K, NP), bool),
+        "base": jnp.zeros((), jnp.int64),      # pane index of column 0
+        "win_next": jnp.zeros((), jnp.int64),  # next unfired window id
+        # newest data pane ever placed: windows starting beyond it can never
+        # emit, so firing never advances past it (bounds EOS flush loops)
+        "max_seen": jnp.full((), -(1 << 60), jnp.int64),
+        # per-key overflow taint: one past the newest DATA pane evicted by a
+        # capacity roll before its windows fired; windows starting below it
+        # lost data (the drop-window overflow policy suppresses them)
+        "horizon": jnp.full((K,), -(1 << 60), jnp.int64),
+        "n_late": jnp.zeros((), jnp.int64),    # dropped late tuples
+        "n_evicted": jnp.zeros((), jnp.int64),  # pane cells lost to overflow
+        "n_win_dropped": jnp.zeros((), jnp.int64),  # windows suppressed
+    }
+
+
+def make_ffat_tb_step(capacity: int, K: int, P_usec: int, R: int, D: int,
+                      NP: int, lift: Callable, comb: Callable,
+                      key_fn: Optional[Callable],
+                      key_base_fn: Optional[Callable[[], Any]] = None,
+                      drop_tainted: bool = False):
+    """Time-based FFAT per-batch program.
+
+    Window ``w`` covers panes ``[w*D, w*D + R)`` — times
+    ``[w*slide, w*slide + win)`` — and fires once the (lateness-adjusted)
+    watermark passes the window end; the host passes ``wm_adj`` per batch.
+    The ring holds ``NP`` panes.
+
+    The step fires in passes around placement so a watermark/time jump
+    (an idle gap in the stream) cannot evict fireable windows:
+
+    * pass A, *before* making room for the batch, fires windows complete
+      under ``min(wm, oldest batch pane)`` — the frontier below which no
+      tuple of this batch (nor, by the watermark contract, any future one)
+      can fall, so those windows' data is fully in the ring already.  It
+      runs TWICE: one pass only fires windows whose ends are inside the
+      ring, and with a lagging watermark the ring may hold data whose
+      windows end beyond it — the first pass's roll brings those ends in
+      range, the second fires them (two passes cover all in-ring data
+      because ``NP >= 2R``, enforced by the operator).
+    * the capacity roll then makes room for the batch's newest pane; panes
+      it evicts belong to windows overlapping the batch's own time range —
+      data loss only under a genuinely undersized ring (pane_capacity <
+      window span + batch time spread), surfaced via ``n_evicted``.
+    * pass B, after placement, fires what the batch itself completed —
+      windows ending between the batch's oldest pane and the watermark
+      (routinely non-empty: on an ordered stream these are the windows the
+      batch's own tuples closed).
+
+    Returns ``(state, out, fired, out_ts, n_advanced)``; ``n_advanced``
+    counts windows passed (fired or skipped-as-evicted) so drivers can loop
+    EOS/catch-up flushes until the frontier genuinely stops moving (windows
+    beyond an empty gap would otherwise stall behind a no-emission pass).
+
+    ``drop_tainted`` (the drop-window overflow policy): windows whose span
+    lost a DATA pane to a capacity-roll eviction are suppressed instead of
+    firing a wrong partial aggregate; every suppression increments
+    ``n_win_dropped``.  The reference never fires a wrong window — it
+    grows/blocks instead — so wrong-but-counted is opt-in (``count``).
+    """
+    MW = NP // D + 2
+    N_PASSES = 3                     # A1, A2 (pre-place), B (post-place)
+
+    def roll_left(flags, values, k):
+        # advance the ring by k panes (k is traced); vacated tail = invalid
+        idx = jnp.arange(NP, dtype=jnp.int64) + k
+        inb = idx < NP
+        idxc = jnp.clip(idx, 0, NP - 1).astype(jnp.int32)
+        f = jnp.take(flags, idxc, axis=1) & inb[None, :]
+        v = jax.tree.map(lambda a: jnp.take(a, idxc, axis=1), values)
+        return f, v
+
+    def fire_pass(cells, cell_valid, base, win_next, frontier, max_seen,
+                  horizon):
+        """Fire windows ending <= frontier whose end pane is inside the
+        ring; returns the rolled ring + firing outputs.  Firing is capped to
+        in-ring ends: if the frontier outruns the ring, later windows wait
+        for the next pass/step (the roll brings their ends in range) — every
+        fired fold is exactly over its own panes.  It is also capped to
+        windows starting at or before the newest data pane (``max_seen``):
+        later windows can never emit, so advancing past them would let an
+        infinite-watermark flush loop run forever."""
+        j = jnp.arange(MW, dtype=jnp.int64)
+        w = win_next + j
+        end_local = (w * D + R - 1 - base)                     # [MW]
+        fire = ((w * D + R) <= frontier) & (end_local < NP) \
+            & (w * D <= max_seen)                              # [MW] prefix
+        # end_local < 0 happens only when a capacity roll evicted the whole
+        # window (overload); such windows must not fire with pane-0 data
+        emitable = fire & (end_local >= 0)
+        eidx = jnp.clip(end_local, 0, NP - 1).astype(jnp.int32)
+        n_fired = jnp.sum(fire.astype(jnp.int64))
+
+        def do_fold(_):
+            # the O(K*NP*log R) sliding fold + gathers, only when this pass
+            # actually fires something (on an ordered stream the pre-place
+            # passes usually fire nothing — the previous step's post-place
+            # pass already did their work)
+            sflag, swin = _sliding_reduce(comb, cell_valid, cells, R, axis=1)
+
+            def pick_leaf(a):
+                idx = eidx.reshape(1, MW, *([1] * (a.ndim - 2)))
+                idx = jnp.broadcast_to(idx, (K, MW) + a.shape[2:])
+                return jnp.take_along_axis(a, idx, axis=1)
+            wvals = jax.tree.map(pick_leaf, swin)
+            any_data = jnp.take_along_axis(
+                sflag, jnp.broadcast_to(eidx[None, :], (K, MW)), axis=1)
+            # advance past fully-evicted windows (fire) but never emit them
+            # (emitable): their eidx clips to pane 0, which they don't cover
+            f = emitable[None, :] & any_data
+            n_drop = jnp.zeros((), jnp.int64)
+            if drop_tainted:
+                # suppress windows whose span lost data to an eviction;
+                # count them per tainted key — including windows whose
+                # WHOLE span was evicted (fire & ~emitable), which can
+                # never emit but did lose that key's data
+                clean = (w * D)[None, :] >= horizon[:, None]
+                gone = (fire & ~emitable)[None, :] & ~clean
+                n_drop = jnp.sum((f & ~clean).astype(jnp.int64)) \
+                    + jnp.sum(gone.astype(jnp.int64))
+                f = f & clean
+            return f, wvals, n_drop
+
+        def no_fold(_):
+            zvals = jax.tree.map(
+                lambda a: jnp.zeros((K, MW) + a.shape[2:], a.dtype), cells)
+            return jnp.zeros((K, MW), bool), zvals, jnp.zeros((), jnp.int64)
+
+        fired, wvals, n_drop = jax.lax.cond(n_fired > 0, do_fold, no_fold,
+                                            None)
+        new_next = win_next + n_fired
+        shift = jnp.clip(new_next * D - base, 0, NP)
+        cell_valid, cells = roll_left(cell_valid, cells, shift)
+        return (cells, cell_valid, base + shift, new_next,
+                fired, wvals, w, n_fired, n_drop)
+
+    def step(state, payload, ts, valid, wm_pane):
+        B = capacity
+        kb = key_base_fn() if key_base_fn is not None else None
+        keys = jax.vmap(key_fn)(payload).astype(jnp.int32) \
+            if key_fn is not None else jnp.zeros(B, jnp.int32)
+        if kb is not None:
+            keys = keys - jnp.int32(kb)
+        ok = valid & (keys >= 0) & (keys < K)
+        pane = ts.astype(jnp.int64) // P_usec
+        if D > R:
+            # hopping windows with gaps (slide > win): panes in the
+            # inter-window gap belong to no window — never place or count
+            # them (pane p is covered iff p mod D < R)
+            ok = ok & ((pane % D) < R)
+
+        # 1. pass A (twice): fire everything no tuple of this batch can
+        # touch; the second pass reaches windows whose ends the first
+        # pass's roll brought inside the ring
+        min_pane = jnp.min(jnp.where(ok, pane, jnp.int64(1) << 60))
+        frontier_a = jnp.minimum(wm_pane, min_pane)
+        cells, cell_valid, base, win_next = (
+            state["cells"], state["cell_valid"], state["base"],
+            state["win_next"])
+        a_outs = []
+        n_win_dropped = state["n_win_dropped"]
+        for _ in range(2):
+            (cells, cell_valid, base, win_next,
+             fired_i, wvals_i, w_i, n_i, nd_i) = fire_pass(
+                cells, cell_valid, base, win_next, frontier_a,
+                state["max_seen"], state["horizon"])
+            a_outs.append((fired_i, wvals_i, w_i, n_i))
+            n_win_dropped = n_win_dropped + nd_i
+
+        # 2. capacity roll: make room for this batch's newest pane
+        max_pane = jnp.max(jnp.where(ok, pane, base))
+        max_seen = jnp.maximum(state["max_seen"],
+                               jnp.max(jnp.where(ok, pane, -(1 << 60))))
+        shift_cap = jnp.maximum(jnp.int64(0), max_pane - base - (NP - 1))
+        col = jnp.arange(NP, dtype=jnp.int64)[None, :]
+        evict_mask = cell_valid & (col < shift_cap)
+        evicted = jnp.sum(evict_mask.astype(jnp.int64))
+        # per-key taint horizon: one past the newest data pane lost here
+        horizon = jnp.maximum(
+            state["horizon"],
+            jnp.max(jnp.where(evict_mask, base + col + 1, -(1 << 60)),
+                    axis=1))
+        cell_valid, cells = roll_left(cell_valid, cells, shift_cap)
+        base = base + shift_cap
+
+        # 3. place the batch: sort by (key, pane), fold runs, merge cells
+        rel = pane - base
+        late = ok & (rel < 0)
+        ok = ok & (rel >= 0)
+        rel_c = jnp.clip(rel, 0, NP - 1).astype(jnp.int32)
+        sid = jnp.where(ok, keys.astype(jnp.int64) * NP + rel_c,
+                        jnp.int64(K) * NP)
+        order = jnp.argsort(sid, stable=True)
+        ssid = sid[order]
+        slift = jax.tree.map(lambda a: a[order], jax.vmap(lift)(payload))
+        starts = jnp.concatenate([jnp.array([True]), ssid[1:] != ssid[:-1]])
+        scanned = _seg_scan(comb, starts, slift)
+        ends = jnp.concatenate([ssid[1:] != ssid[:-1], jnp.array([True])])
+        row = jnp.where(ends, ssid // NP, K).astype(jnp.int32)
+        col = jnp.where(ends, ssid % NP, 0).astype(jnp.int32)
+
+        def scat(leaf):
+            buf = jnp.zeros((K + 1, NP) + leaf.shape[1:], leaf.dtype)
+            return buf.at[row, col].set(
+                jnp.where(_b(ends, leaf), leaf, 0))[:K]
+        partial = jax.tree.map(scat, scanned)
+        partial_has = jnp.zeros((K + 1, NP), bool).at[row, col].set(ends)[:K]
+
+        def merge(old_leaf, new_leaf):
+            both = comb(old_leaf, new_leaf)
+            return jnp.where(_b(cell_valid & partial_has, both), both,
+                             jnp.where(_b(partial_has, both), new_leaf,
+                                       old_leaf))
+        cells = jax.tree.map(merge, cells, partial)
+        cell_valid = cell_valid | partial_has
+
+        # 4. pass B: fire what this batch completed under the watermark
+        (cells, cell_valid, base, win_next,
+         fired_b, wvals_b, w_b, n_b, nd_b) = fire_pass(
+            cells, cell_valid, base, win_next, wm_pane, max_seen, horizon)
+        n_win_dropped = n_win_dropped + nd_b
+
+        new_state = {
+            "cells": cells,
+            "cell_valid": cell_valid,
+            "base": base,
+            "win_next": win_next,
+            "max_seen": max_seen,
+            "horizon": horizon,
+            "n_late": state["n_late"] + jnp.sum(late.astype(jnp.int64)),
+            "n_evicted": state["n_evicted"] + evicted,
+            "n_win_dropped": n_win_dropped,
+        }
+        # outputs: pass A1, A2, then B rows, [K, N_PASSES*MW] flattened
+        all_passes = a_outs + [(fired_b, wvals_b, w_b, n_b)]
+        w2 = jnp.concatenate([p[2] for p in all_passes])
+        fired = jnp.concatenate([p[0] for p in all_passes], axis=1)
+        wvals = jax.tree.map(
+            lambda *leaves: jnp.concatenate(leaves, axis=1),
+            *[p[1] for p in all_passes])
+        NM = N_PASSES * MW
+        out_ts = (w2 * D + R) * P_usec - 1                     # end-1 (TB)
+        out = {
+            "key": (jnp.broadcast_to(
+                jnp.arange(K, dtype=jnp.int32)[:, None], (K, NM))
+                + (jnp.int32(kb) if kb is not None else 0)).reshape(-1),
+            "wid": jnp.broadcast_to(w2[None, :], (K, NM)).reshape(-1),
+            "value": jax.tree.map(
+                lambda a: a.reshape((K * NM,) + a.shape[2:]), wvals),
+        }
+        n_adv = sum(p[3] for p in all_passes)
+        return new_state, out, fired.reshape(-1), \
+            jnp.broadcast_to(out_ts[None, :], (K, NM)).reshape(-1), n_adv
+
+    return step
+
+
+def make_ffat_state(agg_spec, K: int, R: int):
+    """Dense per-key FFAT device state over a static key space ``[0, K)``
+    (see :class:`FfatWindowsTPU` for the layout)."""
+    zeros = lambda shape: jax.tree.map(
+        lambda s: jnp.zeros(shape + s.shape, s.dtype), agg_spec)
+    return {
+        "carry": zeros((K, R - 1)),               # trailing R-1 panes
+        "carry_valid": jnp.zeros((K, R - 1), bool),
+        "cur": zeros((K,)),                       # partial pane aggregate
+        "cur_valid": jnp.zeros((K,), bool),
+        "cur_fill": jnp.zeros((K,), jnp.int32),   # tuples in partial pane
+        "pane_base": jnp.zeros((K,), jnp.int64),  # completed panes
+        "win_next": jnp.full((K,), R, jnp.int64),  # next end pane
+    }
+
+
+def agg_spec_for(lift: Callable, payload_tree) -> Any:
+    """Shape/dtype skeleton of one aggregate, from a batch payload pytree."""
+    one = jax.tree.map(
+        lambda a: jax.ShapeDtypeStruct(a.shape[1:], a.dtype), payload_tree)
+    spec = jax.eval_shape(lift, one)
+    return jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype), spec)
+
+

@@ -1,0 +1,398 @@
+"""FfatWindowsTPU: incremental sliding-window aggregation on TPU.
+
+Device equivalent of the reference's ``Ffat_Windows_GPU``
+(``/root/reference/wf/ffat_replica_gpu.hpp:424``, ``flatfat_gpu.hpp:143``),
+re-designed for XLA rather than translated from CUDA:
+
+* The reference lifts tuples into pane aggregates with per-key kernels
+  (``ffat_replica_gpu.hpp:92-216`` lift, ``Aggregate_Panes_Kernel``); here the
+  whole batch is sorted by key once and panes are built with a segmented
+  ``associative_scan`` — the XLA expression of the same reduction.
+* The reference maintains a per-key FlatFAT tree on device and computes
+  ``numWinsPerBatch`` window results per launch (``flatfat_gpu.hpp:60-139``).
+  Here per-key state is **dense over a static key space** [0, max_keys): a
+  carry ring of the trailing R-1 pane aggregates per key plus the current
+  partial pane.  Window results gather their R panes and reduce them with a
+  log-depth scan, for every key and every fired window in one fused program —
+  the "batch many windows per launch" trick (``builders_gpu.hpp:576``
+  ``withNumWinPerBatch``) taken to its TPU conclusion: *all* windows a batch
+  completes, across *all* keys, in one launch.
+* Count-based windows of length W sliding by S decompose into panes of
+  P = gcd(W, S) (same decomposition as the reference's pane logic): R = W/P
+  panes per window, fired every D = S/P panes.
+
+Invariants/contract:
+* key extractor is JAX-traceable and returns ints in [0, max_keys);
+  out-of-range keys are dropped (masked), as are invalid lanes.
+* ``lift`` maps a record pytree to an aggregate pytree; ``comb`` is an
+  associative combiner of aggregates.  No identity element is required.
+* One step processes one fixed-capacity batch; all shapes are static, so the
+  program compiles exactly once per batch capacity.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+from windflow_tpu.basic import RoutingMode, WindFlowError, WinType
+from windflow_tpu.batch import WM_NONE, DeviceBatch
+from windflow_tpu.ops.base import Operator
+from windflow_tpu.ops.tpu import _TPUReplica
+from windflow_tpu.windows.engine import WindowSpec
+from windflow_tpu.windows.ffat_kernels import (_masked_reduce_last,
+                                               agg_spec_for, make_ffat_state,
+                                               make_ffat_step,
+                                               make_ffat_tb_state,
+                                               make_ffat_tb_step)
+
+
+class FfatTPUReplica(_TPUReplica):
+    def _op_step(self, batch):
+        return self.op._step(batch, self.index)
+
+    def on_eos(self):
+        if self.op.is_tb and self.op._per_replica_state:
+            # Keyed TB state is PER REPLICA (each replica owns its key
+            # partition's pane ring and clock — independent partitions'
+            # watermark frontiers must never advance each other's rings),
+            # so every replica flushes its own state at its own EOS.
+            outs = self.op._flush_tb(self.index)
+        elif self.op.is_tb:
+            # FORWARD-routed TB: batches round-robin over replicas into ONE
+            # shared state (no key partition exists to split it by), so the
+            # last replica to terminate flushes it once.
+            self.op._eos_replicas += 1
+            if self.op._eos_replicas < self.op.parallelism:
+                return
+            outs = self.op._flush_tb(0)
+        else:
+            # CB state is operator-level (per-key clock lanes make the one
+            # dense table safe under key partitioning); only the LAST
+            # replica to terminate may flush it — earlier-terminating
+            # siblings' peers might still hold queued data batches whose
+            # tuples belong in the open windows.
+            self.op._eos_replicas += 1
+            if self.op._eos_replicas < self.op.parallelism:
+                return
+            outs = self.op._flush()
+        for out in outs:
+            self.stats.device_programs_launched += 1
+            self.emitter.emit_device_batch(out)
+
+
+class FfatWindowsTPU(Operator):
+    """Count-based windows use the rank/pane decomposition
+    (``make_ffat_step``); time-based windows use quantum panes — pane =
+    ``ts // gcd(win, slide)`` — over a rolling per-key pane ring with
+    watermark-driven firing (``make_ffat_tb_step``; reference TB lift
+    kernels, ``ffat_replica_gpu.hpp:92-216``)."""
+
+    replica_class = FfatTPUReplica
+
+    def __init__(self, lift: Callable, comb: Callable, spec: WindowSpec, *,
+                 max_keys: int, name: str = "ffat_windows_tpu",
+                 parallelism: int = 1,
+                 key_extractor: Optional[Callable] = None,
+                 pane_capacity: Optional[int] = None,
+                 overflow_policy: str = "drop") -> None:
+        routing = (RoutingMode.KEYBY if key_extractor is not None
+                   else RoutingMode.FORWARD)
+        super().__init__(name, parallelism, routing=routing, is_tpu=True,
+                         key_extractor=key_extractor)
+        self.lift = lift
+        self.comb = comb
+        self.spec = spec
+        self.max_keys = max_keys
+        self.P = math.gcd(spec.win_len, spec.slide)
+        self.R = spec.win_len // self.P
+        self.D = spec.slide // self.P
+        self.is_tb = spec.win_type == WinType.TB
+        # TB pane ring contract: the ring must cover the window span, plus
+        # the time spread of any single batch (including idle gaps *inside*
+        # a batch — gaps between batches cost nothing, pre-gap windows fire
+        # before the ring rolls), plus the lateness allowance in panes
+        # (lateness holds windows open, so their panes stay pinned in the
+        # ring).  Exceeding it is overload: panes are evicted and counted
+        # (n_evicted).  When not set via withPaneCapacity, the ring is
+        # auto-sized at the first batch to one batch's worth of panes
+        # (capped at 8192) — keyed partitioning concentrates one key's
+        # tuples, so a partition batch of C tuples can span C panes.
+        self.NP = pane_capacity
+        if self.is_tb and pane_capacity is not None                 and pane_capacity < 2 * self.R:
+            # >= 2R also guarantees the step's two pre-place fire passes
+            # reach every window over in-ring data (ffat_kernels docstring)
+            raise WindFlowError(
+                "pane_capacity must be at least 2*win/gcd panes")
+        if self.is_tb and key_extractor is None and parallelism > 1:
+            # FORWARD round-robin at parallelism > 1 would interleave
+            # batches into the shared ring in replica-drain order, not
+            # arrival order — a later-frontier batch on one replica could
+            # fire windows before an earlier batch on a sibling is placed.
+            # Keyed routing (withKeyBy) is the scaling path, exactly as the
+            # reference scales windows by key partitioning.
+            raise WindFlowError(
+                "non-keyed time-based FfatWindowsTPU requires "
+                "parallelism == 1; use withKeyBy to scale")
+        if overflow_policy not in ("drop", "count", "error"):
+            raise WindFlowError(
+                f"unknown overflow policy '{overflow_policy}' "
+                "(drop | count | error)")
+        #: TB ring-overflow policy: "drop" (default) suppresses windows
+        #: that lost data panes and counts them; "count" fires them over
+        #: the surviving panes only (wrong aggregates, n_evicted counts);
+        #: "error" raises at the next host checkpoint.  The reference never
+        #: fires a wrong window (its FlatFAT grows instead).
+        self.overflow_policy = overflow_policy
+        self._overflow_steps = 0
+        # Device state, created on first batch.  CB: one shared table (key
+        # 0) — per-key clock lanes make it partition-safe.  TB: one state
+        # PER REPLICA index — the ring clocks are shared across a state's
+        # keys, so each key partition needs its own.
+        self._states = {}
+        self._jit_step = None
+        self._jit_flush = None
+        self._capacity = None
+        self._payload_zero = None   # all-invalid batch for TB EOS flush
+        self._flushed = False
+        self._eos_replicas = 0
+
+    # -- state layout --------------------------------------------------------
+    def _init_state(self, agg_spec):
+        if self.mesh is not None:
+            from windflow_tpu.parallel.mesh import (
+                make_sharded_ffat_state, make_sharded_ffat_tb_state)
+            if self.is_tb:
+                return make_sharded_ffat_tb_state(
+                    agg_spec, self.max_keys, self.NP, self.mesh)
+            return make_sharded_ffat_state(agg_spec, self.max_keys, self.R,
+                                           self.mesh)
+        if self.is_tb:
+            return make_ffat_tb_state(agg_spec, self.max_keys, self.NP)
+        return make_ffat_state(agg_spec, self.max_keys, self.R)
+
+    # -- per-batch program ---------------------------------------------------
+    def _build_step(self, capacity: int):
+        if self.mesh is not None:
+            # Multi-chip: key-sharded state, data-sharded batches riding an
+            # all_gather over ICI (parallel/mesh.py make_sharded_ffat_step).
+            # Config.mesh is how the graph API reaches the sharded kernels.
+            from windflow_tpu.parallel.mesh import (make_sharded_ffat_step,
+                                                    make_sharded_ffat_tb_step)
+            if self.is_tb:
+                return make_sharded_ffat_tb_step(
+                    self.mesh, capacity, self.max_keys, self.P, self.R,
+                    self.D, self.NP, self.lift, self.comb,
+                    self.key_extractor,
+                    drop_tainted=self.overflow_policy == "drop")
+            return make_sharded_ffat_step(
+                self.mesh, capacity, self.max_keys, self.P, self.R, self.D,
+                self.lift, self.comb, self.key_extractor)
+        if self.is_tb:
+            step = make_ffat_tb_step(capacity, self.max_keys, self.P,
+                                     self.R, self.D, self.NP,
+                                     self.lift, self.comb,
+                                     self.key_extractor,
+                                     drop_tainted=self.overflow_policy
+                                     == "drop")
+        else:
+            step = make_ffat_step(capacity, self.max_keys, self.P, self.R,
+                                  self.D, self.lift, self.comb,
+                                  self.key_extractor)
+        return jax.jit(step, donate_argnums=(0,))
+
+    # -- operator plumbing ---------------------------------------------------
+    @property
+    def _per_replica_state(self) -> bool:
+        # TB ring clocks are shared across a state's keys, so KEYBY
+        # partitions (disjoint keys, independent watermark frontiers) need
+        # one state per replica; FORWARD round-robin feeds every replica
+        # the same keys and must share one state.
+        return self.is_tb and self.routing == RoutingMode.KEYBY             and self.parallelism > 1
+
+    def _sidx(self, ridx: int) -> int:
+        return ridx if self._per_replica_state else 0
+
+    def _ensure(self, batch: DeviceBatch, sidx: int):
+        if self._capacity is None:
+            self._capacity = batch.capacity
+            if self.NP is None:
+                # auto-size to one batch's worth of panes (a keyed
+                # partition batch of C tuples can span C panes), bounded so
+                # the dense [max_keys, NP] state stays ~O(32 MB)/leaf —
+                # beyond that, size explicitly with withPaneCapacity
+                cap_by_mem = max(64, (1 << 23) // max(1, self.max_keys))
+                self.NP = max(2 * self.R, self.R + 64,
+                              self.R + min(batch.capacity, 8192,
+                                           cap_by_mem) + 2)
+            self._jit_step = self._build_step(batch.capacity)
+            if self.is_tb:
+                self._payload_zero = jax.tree.map(jnp.zeros_like,
+                                                  batch.payload)
+        elif batch.capacity != self._capacity:
+            raise WindFlowError(
+                "FfatWindowsTPU requires a fixed upstream batch capacity "
+                f"({self._capacity}), got {batch.capacity}")
+        if sidx not in self._states:
+            self._states[sidx] = self._init_state(
+                agg_spec_for(self.lift, batch.payload))
+
+    def _wm_pane(self, wm: int) -> int:
+        """Lateness-adjusted watermark in pane units (the host-side firing
+        frontier the device program compares window ends against)."""
+        if wm == WM_NONE:
+            return -(1 << 60)
+        return (wm - self.spec.lateness) // self.P
+
+    def _step(self, batch: DeviceBatch, ridx: int = 0) -> DeviceBatch:
+        sidx = self._sidx(ridx)
+        self._ensure(batch, sidx)
+        if self.is_tb:
+            # Fire on the batch's staging-time frontier, not the min-folded
+            # propagated stamp: the step places every tuple of the batch
+            # before firing, so the newest frontier is safe here and saves
+            # one batch of firing lag (batch.py DeviceBatch.frontier).
+            self._states[sidx], out, fired, out_ts, _ = self._jit_step(
+                self._states[sidx], batch.payload, batch.ts, batch.valid,
+                jnp.int64(self._wm_pane(batch.frontier)))
+            if self.overflow_policy == "error":
+                # periodic host checkpoint (one sync every 64 steps, and at
+                # EOS): fail loudly instead of producing wrong aggregates
+                self._overflow_steps += 1
+                if self._overflow_steps % 64 == 0:
+                    self._check_overflow(sidx)
+        else:
+            self._states[sidx], out, fired, out_ts = self._jit_step(
+                self._states[sidx], batch.payload, batch.ts, batch.valid)
+        return DeviceBatch(out, out_ts, fired,
+                           watermark=batch.watermark, size=None)
+
+    def _flush(self) -> list:
+        """EOS flush of the CB shared state: fire remaining partial windows
+        (reference EOS flush of open windows).  Called once, by the last
+        replica to terminate."""
+        if not self._states or self._flushed:
+            return []
+        self._flushed = True
+        if self._jit_flush is None:
+            self._jit_flush = self._build_flush()
+        out, fired, ts = self._jit_flush(self._states[0])
+        return [DeviceBatch(out, ts, fired, watermark=0, size=None)]
+
+    def _flush_tb(self, ridx: int) -> list:
+        """EOS flush of one TB state: iterate the normal step with an empty
+        batch and an infinite watermark — each pass fires the windows whose
+        ends the ring roll has brought into range, until the window
+        frontier stops advancing.  Keyed TB flushes per replica; FORWARD TB
+        flushes the shared state once (guarded by the caller)."""
+        import numpy as np
+        sidx = self._sidx(ridx)
+        if sidx not in self._states:
+            return []
+        if self.overflow_policy == "error":
+            self._check_overflow(sidx)
+        cap = self._capacity
+        ts0 = jnp.zeros(cap, jnp.int64)
+        invalid = jnp.zeros(cap, bool)
+        outs = []
+        while True:
+            self._states[sidx], out, fired, out_ts, n_adv = self._jit_step(
+                self._states[sidx], self._payload_zero, ts0, invalid,
+                jnp.int64(1 << 60))
+            if bool(np.asarray(fired).any()):
+                outs.append(DeviceBatch(out, out_ts, fired, watermark=0,
+                                        size=None))
+            # loop on ADVANCE, not emission: windows beyond an empty gap
+            # in the pane sequence would stall behind a no-emission pass
+            if int(n_adv) == 0:
+                break
+        return outs
+
+    def _check_overflow(self, sidx: int):
+        if int(jnp.sum(self._states[sidx]["n_evicted"])) > 0:
+            raise WindFlowError(
+                f"{self.name}: TB pane ring overflow (pane_capacity="
+                f"{self.NP} < window span + batch time spread + lateness "
+                "panes); increase withPaneCapacity or choose overflow "
+                "policy 'drop'/'count'")
+
+    def _tb_counter(self, name: str) -> int:
+        # one device sync at read time, never on the step path; summed over
+        # replica states (and over key-shard lanes on a mesh)
+        return sum(int(jnp.sum(st[name])) for st in self._states.values())
+
+    def num_dropped_tuples(self) -> int:
+        if self.is_tb and self._states:
+            return self._tb_counter("n_late")
+        return 0
+
+    def dump_stats(self) -> dict:
+        n_late = None
+        if self.is_tb and self._states:
+            n_late = self._tb_counter("n_late")
+            if self.replicas:
+                self.replicas[0].stats.inputs_ignored = n_late
+        st = super().dump_stats()
+        if n_late is not None:
+            st["Late_tuples_dropped"] = n_late
+            st["Pane_cells_evicted"] = self._tb_counter("n_evicted")
+            st["Windows_dropped_on_overflow"] = \
+                self._tb_counter("n_win_dropped")
+        return st
+
+    def _build_flush(self):
+        K, P, R, D = self.max_keys, self.P, self.R, self.D
+        MWF = R // D + 2
+        comb = self.comb
+
+        def flush(state):
+            # total panes including the partial pane
+            has_cur = state["cur_valid"]
+            total = state["pane_base"] + has_cur.astype(jnp.int64)
+            # available pane history: carry (R-1) + cur  -> [K, R]
+            hist = jax.tree.map(
+                lambda c, cur: jnp.concatenate([c, cur[:, None]], axis=1),
+                state["carry"], state["cur"])
+            hist_valid = jnp.concatenate(
+                [state["carry_valid"], has_cur[:, None]], axis=1)
+            # hist column i holds pane (pane_base - (R-1) + i)
+            j = jnp.arange(MWF, dtype=jnp.int64)
+            e = state["win_next"][:, None] + j[None, :] * D
+            start = e - R
+            fire = start < total[:, None]
+            # gather window panes from hist: local = pane - pane_base + R-1
+            lidx = (start[:, :, None] + jnp.arange(R)[None, None, :]
+                    - state["pane_base"][:, None, None] + (R - 1))
+            inb = (lidx >= 0) & (lidx < R)
+            lidx_c = jnp.clip(lidx, 0, R - 1).astype(jnp.int32)
+            pane_ok = jnp.take_along_axis(
+                jnp.broadcast_to(hist_valid[:, None], (K, MWF, R)),
+                lidx_c, axis=2) & inb
+            # panes must also be < total (cur counts once)
+            pane_abs = start[:, :, None] + jnp.arange(R)[None, None, :]
+            pane_ok = pane_ok & (pane_abs < total[:, None, None]) \
+                & (pane_abs >= 0)
+            def gather_leaf(a):
+                expanded = jnp.broadcast_to(a[:, None], (K, MWF) + a.shape[1:])
+                idx = lidx_c.reshape(K, MWF, R, *([1] * (a.ndim - 2)))
+                idx = jnp.broadcast_to(idx, (K, MWF, R) + a.shape[2:])
+                return jnp.take_along_axis(expanded, idx, axis=2)
+            wpanes = jax.tree.map(gather_leaf, hist)
+            any_ok, wvals = _masked_reduce_last(comb, pane_ok, wpanes, axis=2)
+            fired = fire & any_ok
+            wid = (e - R) // D
+            out = {
+                "key": jnp.broadcast_to(
+                    jnp.arange(K, dtype=jnp.int32)[:, None],
+                    (K, MWF)).reshape(-1),
+                "wid": wid.reshape(-1),
+                "value": jax.tree.map(
+                    lambda a: a.reshape((K * MWF,) + a.shape[2:]), wvals),
+            }
+            ts = jnp.zeros((K * MWF,), jnp.int64)
+            return out, fired.reshape(-1), ts
+
+        return jax.jit(flush)

@@ -1,0 +1,11 @@
+#!/usr/bin/env bash
+# CI entry point: run the whole suite on the CPU backend (the conftest pins
+# JAX to CPU and forces an 8-device virtual mesh so every multi-chip
+# sharding path compiles and executes without TPU hardware), then the
+# multi-chip dry run and a bench smoke on CPU.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+python -m pytest tests/ -q
+python __graft_entry__.py 8
+BENCH_PLATFORM=cpu BENCH_E2E_TUPLES=131072 python bench.py

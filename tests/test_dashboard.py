@@ -77,3 +77,20 @@ def test_dashboard_multiple_apps():
         assert [a["id"] for a in apps] == sorted(a["id"] for a in apps)
     finally:
         server.stop()
+
+
+def test_dashboard_serves_spa():
+    """GET / returns the single-page UI (reference React SPA equivalent):
+    static HTML polling the JSON endpoints."""
+    server = DashboardServer(tcp_port=0, http_port=0).start()
+    try:
+        status, body = _get(server.http_port, "/")
+        assert status == 200
+        html = body.decode()
+        assert "<html" in html
+        assert "/apps" in html          # it polls the JSON API
+        assert "spark" in html          # throughput sparklines
+        status2, body2 = _get(server.http_port, "/index.html")
+        assert status2 == 200 and body2 == body
+    finally:
+        server.stop()
