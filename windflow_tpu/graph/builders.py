@@ -199,7 +199,15 @@ class _StatefulTPUMixin:
 
     def withInitialState(self, state):
         """Per-key initial state prototype — switches the operator to the
-        stateful keyed path (requires ``withKeyBy``)."""
+        stateful keyed path (requires ``withKeyBy``).
+
+        Skew warning: the default stateful kernel applies each key's tuples
+        in order via a rank wavefront — a batch whose hottest key holds r
+        tuples costs r sequential device steps, so ONE key receiving the
+        whole batch degrades to batch-length serialization.  For
+        ASSOCIATIVE updates, ``withAssociativeUpdate`` switches to a
+        log-depth segmented scan that is immune to skew (see
+        ops/tpu_stateful.py)."""
         self._initial_state = state
         return self
 

@@ -520,13 +520,14 @@ class KeyedDeviceStageEmitter(Emitter):
 class DeviceKeyByEmitter(Emitter):
     """TPU→TPU KEYBY edge (reference GPU→GPU ``KeyBy_Emitter_GPU``,
     ``keyby_emitter_gpu.hpp:519-583``): one compiled program splits the batch
-    into ``num_dests`` order-preserving compactions by
-    ``splitmix64(key) % num_dests`` (the same placement as the host-side
-    keyed staging emitter).
-    The reference builds per-key index chains with sort kernels; the XLA
-    expression is a stable argsort per partition.  Empty partitions still
-    ship (a masked all-invalid batch) — skipping them would force a host
-    sync on the partition counts."""
+    into ``num_dests`` masked views by ``splitmix64(key) % num_dests`` (the
+    same placement as the host-side keyed staging emitter).  The reference
+    builds per-key index chains with sort kernels and copies per
+    destination; here every destination shares the SAME immutable device
+    buffers and differs only in its validity mask — consumers are
+    mask-aware, so no sort, gather, or copy happens at the edge at all.
+    Empty partitions still ship (an all-invalid mask) — skipping them
+    would force a host sync on the partition counts."""
 
     def __init__(self, dests, key_extractor):
         super().__init__(dests, output_batch_size=0)
@@ -550,23 +551,22 @@ class DeviceKeyByEmitter(Emitter):
                 # a device edge must see each key on ONE replica
                 h = (_splitmix64_dev(keys) % jnp.uint64(n)).astype(jnp.int32)
                 dest = jnp.where(valid, h, jnp.int32(n))
-                outs = []
-                for d in range(n):
-                    mask = dest == d
-                    order = jnp.argsort(~mask, stable=True)
-                    pay_d = jax.tree.map(lambda a: a[order], payload)
-                    outs.append((pay_d, ts[order], keys[order],
-                                 jnp.arange(capacity) < jnp.sum(mask)))
-                return outs
+                # no per-destination sort or gather: consumers are
+                # mask-aware, so every destination shares the SAME
+                # immutable payload/ts/keys buffers and differs only in
+                # its validity mask — O(capacity) total work instead of
+                # O(capacity * num_dests) sorts+copies
+                return keys, [dest == d for d in range(n)]
 
             self._splits[capacity] = split
         return split
 
     def emit_device_batch(self, batch):
-        outs = self._get_split(batch.capacity)(
+        keys, masks = self._get_split(batch.capacity)(
             batch.payload, batch.ts, batch.valid, batch.keys)
-        for d, (pay, ts, keys, valid) in enumerate(outs):
-            self._send(d, DeviceBatch(pay, ts, valid, keys=keys,
+        for d, mask in enumerate(masks):
+            self._send(d, DeviceBatch(batch.payload, batch.ts, mask,
+                                      keys=keys,
                                       watermark=batch.watermark, size=None,
                                       frontier=batch.frontier))
 
@@ -693,7 +693,7 @@ class SplittingEmitter(Emitter):
                 self.branches[d].emit(item, ts, wm, multi)
 
     def _get_device_split(self, capacity: int, payload):
-        """Compile one masked-compaction split program per capacity
+        """Compile one mask-only split program per capacity
         (reference ``Splitting_Emitter_GPU`` / ``split_gpu``,
         ``splitting_emitter_gpu.hpp:53``, ``multipipe.hpp:1244-1281``).
         Requires a JAX-traceable single-destination split function; falls
@@ -717,14 +717,9 @@ class SplittingEmitter(Emitter):
             def compiled(payload, ts, valid):
                 idx = jax.vmap(split_fn)(payload).astype(jnp.int32)
                 dest = jnp.where(valid, idx, jnp.int32(n))
-                outs = []
-                for b in range(n):
-                    mask = dest == b
-                    order = jnp.argsort(~mask, stable=True)
-                    pay_b = jax.tree.map(lambda a: a[order], payload)
-                    outs.append((pay_b, ts[order],
-                                 jnp.arange(capacity) < jnp.sum(mask)))
-                return outs
+                # mask-only split: every branch shares the same immutable
+                # buffers with its own validity mask (see DeviceKeyByEmitter)
+                return [dest == b for b in range(n)]
 
         self._device_splits[capacity] = compiled
         return compiled
@@ -732,13 +727,15 @@ class SplittingEmitter(Emitter):
     def emit_device_batch(self, batch: DeviceBatch):
         split = self._get_device_split(batch.capacity, batch.payload)
         if split is not None:
-            # Device-native split: one compiled masked compaction per
-            # branch; empty partitions still ship (all-invalid) — skipping
-            # them would force a host sync on the partition counts.
-            outs = split(batch.payload, batch.ts, batch.valid)
-            for b, (pay, ts, valid) in enumerate(outs):
+            # Device-native split: branches share the same immutable
+            # buffers with per-branch validity masks; empty partitions
+            # still ship (all-invalid) — skipping them would force a host
+            # sync on the partition counts.
+            masks = split(batch.payload, batch.ts, batch.valid)
+            for b, mask in enumerate(masks):
                 self.branches[b].emit_device_batch(
-                    DeviceBatch(pay, ts, valid, watermark=batch.watermark,
+                    DeviceBatch(batch.payload, batch.ts, mask,
+                                watermark=batch.watermark,
                                 size=None, frontier=batch.frontier))
             return
         # Fallback: host-side per-tuple split (Python or multicast split fn).
