@@ -182,7 +182,10 @@ def _e2e_graph(cfg: dict, n_tuples: int, chunks, lat_sink):
     snk = wf.Sink_Builder(lat_sink).withColumnarSink(defer=4).build()
     g = wf.PipeGraph("bench_e2e", wf.ExecutionMode.DEFAULT,
                      wf.TimePolicy.INGRESS)
-    g.add_source(src).add(m).add(f).add(w).add_sink(snk)
+    pipe = g.add_source(src)
+    pipe.add(m)
+    pipe.chain(f)        # Map+Filter fuse into ONE XLA program (chaining)
+    pipe.add(w).add_sink(snk)
     return g
 
 
