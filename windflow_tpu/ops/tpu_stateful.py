@@ -244,12 +244,32 @@ class _StatefulTPUBase(Operator):
         return slots
 
     def _body(self, capacity: int):
+        return self._body_factory()(capacity, self.num_key_slots)
+
+    def _body_factory(self):
+        """(capacity, num_slots) -> per-batch body; the mesh layer calls it
+        with the per-shard slot count."""
         if self.assoc is not None:
             lift, comb, project = self.assoc
-            return _assoc_body(lift, comb, project, capacity,
-                               self.num_key_slots, self._is_filter)
-        return _wavefront_body(self.fn, capacity, self.num_key_slots,
-                               self._is_filter)
+            return lambda cap, S: _assoc_body(lift, comb, project, cap, S,
+                                              self._is_filter)
+        return lambda cap, S: _wavefront_body(self.fn, cap, S,
+                                              self._is_filter)
+
+    def _get_sharded_step(self, capacity: int):
+        step = self._steps.get(("mesh", capacity))
+        if step is None:
+            from windflow_tpu.parallel.mesh import (make_sharded_stateful_step,
+                                                    state_sharding)
+            step = make_sharded_stateful_step(
+                self.mesh, capacity, self.num_key_slots,
+                self._body_factory(), self.key_extractor, self.dense_keys,
+                self._is_filter)
+            # shard the state table along the key axis on first use
+            self._state = jax.device_put(self._state,
+                                         state_sharding(self.mesh))
+            self._steps[("mesh", capacity)] = step
+        return step
 
     def _get_step(self, capacity: int):
         step = self._steps.get(capacity)
@@ -276,6 +296,8 @@ class _StatefulTPUBase(Operator):
 
     def _stateful_step(self, batch: DeviceBatch):
         cap = batch.capacity
+        if self.mesh is not None:
+            return self._sharded_stateful_step(batch)
         if self._extract is None:
             key_fn = self.key_extractor
 
@@ -288,9 +310,25 @@ class _StatefulTPUBase(Operator):
             # no interning: dispatch stays fully asynchronous
             return self._get_step(cap)(self._state, batch.payload,
                                        batch.valid, batch.keys)
-        # Keys are extracted once; the device array feeds the wavefront step
-        # and its host copy drives interning (tiny D2H — parity with the
-        # reference's dist_keys_cpu copy at the keyby boundary).
+        keys_dev, uniq_keys_dev, uniq_slots_dev = self._intern_batch(batch)
+        return self._get_step(cap)(self._state, batch.payload, batch.valid,
+                                   keys_dev, uniq_keys_dev, uniq_slots_dev)
+
+    def _intern_batch(self, batch: DeviceBatch):
+        """Shared intern/pad block for the single-chip and mesh paths: keys
+        are extracted once (reusing a keyby edge's attached key lane); the
+        device array feeds the step and its host copy drives interning
+        (tiny D2H — parity with the reference's dist_keys_cpu copy at the
+        keyby boundary)."""
+        cap = batch.capacity
+        if self._extract is None:
+            key_fn = self.key_extractor
+
+            @jax.jit
+            def extract(payload):
+                return jax.vmap(key_fn)(payload).astype(jnp.int32)
+
+            self._extract = extract
         keys_dev = batch.keys if batch.keys is not None \
             else self._extract(batch.payload)
         keys_np = np.asarray(keys_dev)
@@ -304,8 +342,20 @@ class _StatefulTPUBase(Operator):
         uniq_slots_dev = jnp.asarray(
             np.concatenate([uniq_slots,
                             np.full(pad, self.num_key_slots, np.int32)]))
-        return self._get_step(cap)(self._state, batch.payload, batch.valid,
-                                   keys_dev, uniq_keys_dev, uniq_slots_dev)
+        return keys_dev, uniq_keys_dev, uniq_slots_dev
+
+    def _sharded_stateful_step(self, batch: DeviceBatch):
+        """Mesh path: key-sharded state table, data-sharded batch, one
+        psum lane merge (parallel/mesh.py make_sharded_stateful_step)."""
+        cap = batch.capacity
+        step = self._get_sharded_step(cap)
+        if self.dense_keys:
+            dummy = jnp.zeros(cap, jnp.int32)
+            return step(self._state, batch.payload, batch.valid, dummy,
+                        dummy)
+        _, uniq_keys_dev, uniq_slots_dev = self._intern_batch(batch)
+        return step(self._state, batch.payload, batch.valid, uniq_keys_dev,
+                    uniq_slots_dev)
 
 
 class StatefulMapTPUReplica(_TPUReplica):

@@ -331,6 +331,91 @@ def make_sharded_ffat_state(agg_spec, K: int, R: int, mesh: Mesh):
     return jax.tree.map(lambda a: jax.device_put(a, sh), state)
 
 
+def make_sharded_stateful_step(mesh: Mesh, capacity: int, S: int,
+                               body_factory: Callable,
+                               key_fn: Callable, dense: bool,
+                               is_filter: bool):
+    """Key-sharded stateful Map/Filter step (reference stateful ``Map_GPU``
+    whose keyed state is one shared table, ``map_gpu.hpp:114-115``; here the
+    dense ``[num_key_slots, ...]`` table is split along ``key`` so each chip
+    owns a slot range).
+
+    Layout mirrors the FFAT sharding: the data-sharded batch is
+    ``all_gather``-ed across ``data`` so every key shard sees every lane;
+    each shard runs the per-key in-order body over the lanes whose slot it
+    owns (non-owned lanes contribute the body's neutral output), and lane
+    results merge across key shards with one ``psum`` — each lane has
+    exactly one owner, so the sum selects its real result.  Outputs return
+    data-sharded, matching the batch layout downstream stages expect."""
+    kk = mesh.shape[KEY_AXIS]
+    dd = mesh.shape[DATA_AXIS]
+    if S % kk:
+        raise WindFlowError(
+            f"num_key_slots {S} not divisible by key axis {kk}")
+    if capacity % dd:
+        raise WindFlowError(
+            f"capacity {capacity} not divisible by data axis {dd}")
+    S_local = S // kk
+    blk = capacity // dd
+    body = body_factory(capacity, S_local)
+
+    def merge_lanes(leaf, owned):
+        # zero out non-owned lanes, sum across key shards (bool via int32)
+        if leaf.dtype == jnp.bool_:
+            z = jnp.where(_b(owned, leaf), leaf, False)
+            return jax.lax.psum(z.astype(jnp.int32), KEY_AXIS) > 0
+        z = jnp.where(_b(owned, leaf), leaf, jnp.zeros_like(leaf))
+        return jax.lax.psum(z, KEY_AXIS)
+
+    def local(state, payload, valid, uniq_keys, uniq_slots):
+        if dd > 1:
+            ag = lambda a: jax.lax.all_gather(a, DATA_AXIS, axis=0,
+                                              tiled=True)
+            payload = jax.tree.map(ag, payload)
+            valid = ag(valid)
+        keys = jax.vmap(key_fn)(payload).astype(jnp.int32)
+        if dense:
+            slots = keys
+            ok = valid & (keys >= 0) & (keys < S)
+        else:
+            pos = jnp.clip(jnp.searchsorted(uniq_keys, keys),
+                           0, capacity - 1)
+            slots = uniq_slots[pos]
+            ok = valid & (slots < S)
+        base = (jax.lax.axis_index(KEY_AXIS) * S_local).astype(jnp.int32)
+        lslot = slots - base
+        owned = ok & (lslot >= 0) & (lslot < S_local)
+        lslot = jnp.where(owned, lslot, jnp.int32(S_local))
+        new_state, out_payload, out_valid = body(state, payload, owned,
+                                                 lslot)
+        # back to the data-sharded layout FIRST: psum over KEY_AXIS and the
+        # per-data-row block slice commute, and slicing first divides the
+        # collective volume by the data-axis extent
+        d = jax.lax.axis_index(DATA_AXIS) * blk
+        sl = lambda a: jax.lax.dynamic_slice_in_dim(a, d, blk, axis=0)
+        owned_b, valid_b = sl(owned), sl(valid)
+        # a lane is real only if SOME shard owns its slot — out-of-range
+        # keys have no owner and must drop, exactly as on a single chip
+        owned_any = jax.lax.psum(owned_b.astype(jnp.int32), KEY_AXIS) > 0
+        if is_filter:
+            # non-owner shards keep their lanes; the owner's verdict is the
+            # only veto (out_valid from the body is owned & keep)
+            keep = sl(out_valid) | ~owned_b
+            vetoed = jax.lax.psum((~keep).astype(jnp.int32), KEY_AXIS) > 0
+            return (new_state, jax.tree.map(sl, payload),
+                    valid_b & owned_any & ~vetoed)
+        merged_payload = jax.tree.map(
+            lambda l: merge_lanes(sl(l), owned_b), out_payload)
+        return new_state, merged_payload, valid_b & owned_any
+
+    fn = jax.shard_map(
+        local, mesh=mesh,
+        in_specs=(P(KEY_AXIS), P(DATA_AXIS), P(DATA_AXIS), P(), P()),
+        out_specs=(P(KEY_AXIS), P(DATA_AXIS), P(DATA_AXIS)),
+        check_vma=False)
+    return jax.jit(fn, donate_argnums=(0,))
+
+
 # Time-based FFAT on the mesh.  The single-chip TB state keeps scalar pane
 # clocks shared by all keys (ffat_kernels.make_ffat_tb_state); sharded along
 # ``key`` each shard's ring evolves independently — its capacity roll depends
