@@ -84,3 +84,49 @@ def test_ffat_analytics_matches_oracle():
     assert set(got) == set(expected)
     for kk in expected:
         assert abs(got[kk] - expected[kk]) < 1e-3 * max(1, abs(expected[kk]))
+
+
+def test_telemetry_frames_model():
+    """The zero-per-tuple pipeline: binary frames in, TB window columns
+    out, exact vs a python oracle."""
+    import numpy as np
+    from windflow_tpu.models import telemetry_frames
+
+    n, n_keys = 2000, 4
+    rec = np.empty(n, dtype=[("k", "<i8"), ("t", "<i8"), ("v", "<f8")])
+    rec["k"] = np.arange(n) % n_keys
+    rec["t"] = np.arange(n) * 10_000          # 10 ms apart
+    rec["v"] = np.arange(n, dtype=np.float64)
+    blob = rec.tobytes()
+
+    got = {}
+
+    def on_windows(cols):
+        for k, w, v in zip(cols.cols["key"], cols.cols["wid"],
+                           cols.cols["value"]):
+            got[(int(k), int(w))] = float(v)
+
+    g = telemetry_frames.build(
+        lambda: iter([blob[i:i + 7777] for i in range(0, len(blob), 7777)]),
+        on_windows, win_usec=1_000_000, slide_usec=250_000,
+        max_keys=n_keys, batch=256, lateness_usec=0)
+    g.run()
+
+    exp = {}
+    per_key = {}
+    for i in range(n):
+        per_key.setdefault(i % n_keys, []).append((i * 10_000, float(i)))
+    for k, pts in per_key.items():
+        wids = set()
+        for ts, _ in pts:
+            last = ts // 250_000
+            first = max(0, -(-(ts - 1_000_000 + 1) // 250_000))
+            wids.update(range(first, last + 1))
+        for w in wids:
+            vals = [v for ts, v in pts
+                    if w * 250_000 <= ts < w * 250_000 + 1_000_000]
+            if vals:
+                exp[(k, w)] = sum(vals)
+    assert set(got) == set(exp)
+    for kk in exp:
+        assert abs(got[kk] - exp[kk]) < 1e-3

@@ -1,5 +1,7 @@
 """Example streaming applications built on windflow_tpu — the application
 set the reference's evaluation papers benchmark (DSPBench-style WordCount,
-SpikeDetection) plus the flagship TPU FFAT analytics pipeline."""
+SpikeDetection) plus the flagship TPU FFAT analytics pipeline and the
+zero-per-tuple binary-telemetry pipeline."""
 
-from windflow_tpu.models import ffat_analytics, spike_detection, wordcount
+from windflow_tpu.models import (ffat_analytics, spike_detection,
+                                 telemetry_frames, wordcount)

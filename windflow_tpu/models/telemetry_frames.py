@@ -1,0 +1,64 @@
+"""Telemetry over binary frames: the zero-per-tuple-Python pipeline.
+
+``FrameSource → MapTPU⊕FilterTPU (chained) → FfatWindowsTPU (TB) →
+columnar Sink``: byte chunks parse to columns in C, all lanes of a batch
+ride ONE packed host→device transfer, time-based sliding windows fire on
+the watermark frontier with a configurable ring-overflow policy, and
+results leave through the deferred single-transfer columnar egress — no
+per-tuple Python object exists anywhere on the hot path.
+
+This is the application shape for high-rate machine telemetry (metrics,
+sensor frames): the wire format is the ``io.frames`` record layout
+(``int64 key, int64 ts, float64 value``), e.g. produced by any columnar
+exporter.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Iterable, Optional
+
+import windflow_tpu as wf
+from windflow_tpu.io import FrameSource
+
+
+def build(chunks: Callable[[], Iterable[bytes]],
+          on_windows: Optional[Callable] = None,
+          *, win_usec: int = 60_000_000, slide_usec: int = 5_000_000,
+          max_keys: int = 1024, batch: int = 8192,
+          lateness_usec: int = 1_000_000,
+          overflow_policy: str = "drop",
+          transform: Optional[Callable] = None,
+          predicate: Optional[Callable] = None) -> wf.PipeGraph:
+    """``chunks`` yields byte blobs in the frames wire format; ``on_windows``
+    receives :class:`windflow_tpu.SinkColumns` (SoA numpy: ``key``, ``wid``,
+    ``value`` columns + the timestamp lane) once per result batch."""
+    transform = transform or (
+        lambda t: {"key": t["key"], "v0": t["v0"]})
+    predicate = predicate or (lambda t: t["v0"] == t["v0"])  # drop NaNs
+
+    def emit(cols, ctx=None):
+        if cols is not None and on_windows is not None:
+            on_windows(cols)
+
+    src = FrameSource(chunks, nv=1, fmt="frames", name="frames_in",
+                      output_batch_size=batch)
+    mp = wf.MapTPU_Builder(transform).withName("normalize").build()
+    flt = wf.FilterTPU_Builder(predicate).withName("drop_nan").build()
+    win = (wf.Ffat_WindowsTPU_Builder(lambda t: t["v0"],
+                                      lambda a, b: a + b)
+           .withName("tb_windows")
+           .withTBWindows(win_usec, slide_usec)
+           .withKeyBy(lambda t: t["key"])
+           .withMaxKeys(max_keys)
+           .withLateness(lateness_usec)
+           .withOverflowPolicy(overflow_policy).build())
+    sink = (wf.Sink_Builder(emit).withName("columns_out")
+            .withColumnarSink().build())
+
+    g = wf.PipeGraph("telemetry_frames", wf.ExecutionMode.DEFAULT,
+                     wf.TimePolicy.EVENT)
+    pipe = g.add_source(src)
+    pipe.add(mp)
+    pipe.chain(flt)        # Map+Filter fuse into one XLA program
+    pipe.add(win).add_sink(sink)
+    return g

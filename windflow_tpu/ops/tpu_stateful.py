@@ -298,14 +298,6 @@ class _StatefulTPUBase(Operator):
         cap = batch.capacity
         if self.mesh is not None:
             return self._sharded_stateful_step(batch)
-        if self._extract is None:
-            key_fn = self.key_extractor
-
-            @jax.jit
-            def extract(payload):
-                return jax.vmap(key_fn)(payload).astype(jnp.int32)
-
-            self._extract = extract
         if self.dense_keys:
             # no interning: dispatch stays fully asynchronous
             return self._get_step(cap)(self._state, batch.payload,
@@ -350,7 +342,10 @@ class _StatefulTPUBase(Operator):
         cap = batch.capacity
         step = self._get_sharded_step(cap)
         if self.dense_keys:
-            dummy = jnp.zeros(cap, jnp.int32)
+            dummy = self._steps.get(("mesh_dummy", cap))
+            if dummy is None:
+                dummy = jnp.zeros(cap, jnp.int32)
+                self._steps[("mesh_dummy", cap)] = dummy
             return step(self._state, batch.payload, batch.valid, dummy,
                         dummy)
         _, uniq_keys_dev, uniq_slots_dev = self._intern_batch(batch)
