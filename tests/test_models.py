@@ -130,3 +130,28 @@ def test_telemetry_frames_model():
     assert set(got) == set(exp)
     for kk in exp:
         assert abs(got[kk] - exp[kk]) < 1e-3
+
+
+def test_ad_analytics_matches_oracle():
+    """The YSB-shaped pipeline: filter by event type, join ad→campaign via a
+    device table gather, per-campaign tumbling TB counts — exact vs a
+    python oracle."""
+    from windflow_tpu.models import ad_analytics
+
+    rnd = random.Random(17)
+    n_ads, n_campaigns, n = 40, 10, 5000
+    ad_to_campaign = [rnd.randrange(n_campaigns) for _ in range(n_ads)]
+    events = [{"ad_id": rnd.randrange(n_ads),
+               "etype": rnd.randrange(3),
+               "ts": i * 2_500} for i in range(n)]
+
+    win = slide = 1_000_000  # 1 s tumbling
+    got = ad_analytics.run(events, ad_to_campaign,
+                           win_usec=win, slide_usec=slide, batch=256,
+                           view_type=1)
+    exp = {}
+    for e in events:
+        if e["etype"] == 1:
+            key = (ad_to_campaign[e["ad_id"]], e["ts"] // slide)
+            exp[key] = exp.get(key, 0) + 1
+    assert got == exp

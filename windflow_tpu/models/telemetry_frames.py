@@ -28,13 +28,20 @@ def build(chunks: Callable[[], Iterable[bytes]],
           lateness_usec: int = 1_000_000,
           overflow_policy: str = "drop",
           transform: Optional[Callable] = None,
-          predicate: Optional[Callable] = None) -> wf.PipeGraph:
+          predicate: Optional[Callable] = None,
+          lift: Optional[Callable] = None) -> wf.PipeGraph:
     """``chunks`` yields byte blobs in the frames wire format; ``on_windows``
     receives :class:`windflow_tpu.SinkColumns` (SoA numpy: ``key``, ``wid``,
-    ``value`` columns + the timestamp lane) once per result batch."""
+    ``value`` columns + the timestamp lane) once per result batch.
+
+    ``transform``/``predicate``/``lift`` customize the three stages; a
+    custom ``transform`` must keep the ``key`` field, and the default
+    ``predicate`` and ``lift`` read field ``v0`` — a transform that renames
+    or drops ``v0`` must supply its own ``predicate`` and ``lift``."""
     transform = transform or (
         lambda t: {"key": t["key"], "v0": t["v0"]})
     predicate = predicate or (lambda t: t["v0"] == t["v0"])  # drop NaNs
+    lift = lift or (lambda t: t["v0"])
 
     def emit(cols, ctx=None):
         if cols is not None and on_windows is not None:
@@ -44,8 +51,7 @@ def build(chunks: Callable[[], Iterable[bytes]],
                       output_batch_size=batch)
     mp = wf.MapTPU_Builder(transform).withName("normalize").build()
     flt = wf.FilterTPU_Builder(predicate).withName("drop_nan").build()
-    win = (wf.Ffat_WindowsTPU_Builder(lambda t: t["v0"],
-                                      lambda a, b: a + b)
+    win = (wf.Ffat_WindowsTPU_Builder(lift, lambda a, b: a + b)
            .withName("tb_windows")
            .withTBWindows(win_usec, slide_usec)
            .withKeyBy(lambda t: t["key"])

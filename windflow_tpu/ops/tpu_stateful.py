@@ -56,6 +56,23 @@ from windflow_tpu.parallel.emitters import KeyInterner
 _KEY_SENTINEL = np.int32(2**31 - 1)
 
 
+def _cast_update(u, dtype):
+    """Cast a state update to the table dtype (the user's prototype is
+    authoritative; fn may promote, e.g. f32 state + f64 payload column, and
+    a promoting scatter is an error in future JAX) — but only within a
+    kind: silently truncating a float update into an int table would
+    corrupt state, so kind-crossing is a loud error instead."""
+    if u.dtype == dtype:
+        return u
+    if np.dtype(u.dtype).kind == np.dtype(dtype).kind:
+        return u.astype(dtype)
+    raise WindFlowError(
+        f"stateful update dtype {u.dtype} does not match the state "
+        f"prototype dtype {dtype} (kind-crossing cast would corrupt "
+        "state); make fn return the prototype's kind or widen the "
+        "prototype passed to withInitialState")
+
+
 def _broadcast_state(proto, num_slots: int):
     """Materialize the [S, ...] state table from one per-key prototype."""
     def rep(x):
@@ -118,8 +135,10 @@ def _wavefront_body(fn: Callable, capacity: int,
             # Masked-out lanes scatter to index num_slots → dropped (XLA
             # drops out-of-bounds scatter updates under jit).
             scat = jnp.where(mask, s_slots, jnp.int32(num_slots))
-            st = jax.tree.map(lambda a, u: a.at[scat].set(u, mode="drop"),
-                              st, new_st)
+            st = jax.tree.map(
+                lambda a, u: a.at[scat].set(_cast_update(u, a.dtype),
+                                            mode="drop"),
+                st, new_st)
             return r + 1, st, out
 
         _, state, s_out = jax.lax.while_loop(
@@ -187,7 +206,9 @@ def _assoc_body(lift: Callable, comb: Callable, project: Callable,
         scat = jnp.where(ends & (s_slots < num_slots), s_slots,
                          jnp.int32(num_slots))
         state = jax.tree.map(
-            lambda a, u: a.at[scat].set(u, mode="drop"), state, state_incl)
+            lambda a, u: a.at[scat].set(_cast_update(u, a.dtype),
+                                        mode="drop"),
+            state, state_incl)
 
         inv = jnp.argsort(order)
         if is_filter:

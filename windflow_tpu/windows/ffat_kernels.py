@@ -187,7 +187,10 @@ def make_ffat_step(capacity: int, K: int, P: int, R: int, D: int,
             v = jnp.where(_b(use_cur & use_cell, both), both,
                           jnp.where(_b(use_cur, both), cur_leaf,
                                     cell_leaf[:, 0]))
-            return cell_leaf.at[:, 0].set(v)
+            # carried state may be wider than the batch-derived cells (e.g.
+            # an f64 agg_spec under x64 vs f32 lifts); the cell dtype is
+            # authoritative — a promoting scatter errors in future JAX
+            return cell_leaf.at[:, 0].set(v.astype(cell_leaf.dtype))
         cells = jax.tree.map(
             lambda cur_leaf, cell_leaf: merge0(cur_leaf, cell_leaf),
             state["cur"], cells)
