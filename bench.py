@@ -25,6 +25,7 @@ import os
 import subprocess
 import sys
 import time
+from typing import Optional
 
 TPU_PROBE_TIMEOUT_S = int(os.environ.get("BENCH_TPU_TIMEOUT", "150"))
 TPU_PROBE_RETRIES = int(os.environ.get("BENCH_TPU_RETRIES", "1"))
@@ -280,6 +281,76 @@ def run_bench_e2e(platform: str, cfg: dict, jax) -> dict:
     }
 
 
+def scaling_step(jax, n: int, K: int, per_chip: int, seed: int = 2):
+    """Build one width-``n`` rung of the weak-scaling sweep: the key-sharded
+    mesh, the compiled keyed reduce, and its staged inputs.  Shared with the
+    test suite so the composition the harness runs on real hardware is the
+    composition CI exercises (tests/test_mesh.py)."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from windflow_tpu.parallel import mesh as meshmod
+
+    mesh = meshmod.make_mesh(n_devices=n, data=1)
+    cap = per_chip * n
+    fn = meshmod.make_sharded_keyed_reduce(
+        mesh, cap, K,
+        lambda a, b: {"k": a["k"], "v": a["v"] + b["v"]},
+        key_fn=lambda t: t["k"], use_psum=True)
+    rng = np.random.default_rng(seed)
+    sh = meshmod.batch_sharding(mesh)
+    payload = {
+        "k": jax.device_put(
+            jnp.asarray(rng.integers(0, K, cap), jnp.int32), sh),
+        "v": jax.device_put(
+            jnp.asarray(rng.random(cap, dtype=np.float32)), sh),
+    }
+    valid = jax.device_put(jnp.ones(cap, bool), sh)
+    return fn, payload, valid, cap
+
+
+def run_bench_scaling(jax, max_devices: Optional[int] = None) -> dict:
+    """Keyed-Reduce weak scaling over a ``(1, n)`` key-sharded mesh
+    (BASELINE.json north star: "linear scaling to 8 chips on keyed
+    Reduce").  Requires > 1 REAL device: per-chip work is held constant
+    (weak scaling) while the mesh widens 1 → N, so ideal efficiency is a
+    flat tuples/sec/chip line.  Opt-in (``--scaling`` /
+    ``BENCH_SCALING=1``) and refused on virtual/forced-CPU meshes —
+    host-core-sharing virtual devices would fabricate the numbers."""
+    devs = jax.devices()
+    if len(devs) < 2:
+        return {"skipped": f"needs >1 real device, have {len(devs)}"}
+    if devs[0].platform == "cpu":
+        return {"skipped": "virtual CPU mesh: scaling numbers would be "
+                           "host-core-sharing artifacts"}
+    n_max = min(len(devs), max_devices or len(devs))
+    K = 4096
+    per_chip = 1 << 20
+    series = []
+    n = 1
+    while n <= n_max:
+        fn, payload, valid, cap = scaling_step(jax, n, K, per_chip)
+        for _ in range(3):
+            table, has = fn(payload, valid)
+        jax.block_until_ready(table)
+        best = 0.0
+        for _ in range(3):
+            t0 = time.perf_counter()
+            for _ in range(10):
+                table, has = fn(payload, valid)
+            jax.block_until_ready(table)
+            best = max(best, 10 * cap / (time.perf_counter() - t0))
+        series.append({"devices": n,
+                       "tuples_per_sec": round(best, 1),
+                       "tuples_per_sec_per_chip": round(best / n, 1)})
+        n *= 2
+    base = series[0]["tuples_per_sec_per_chip"]
+    for s in series:
+        s["efficiency"] = round(s["tuples_per_sec_per_chip"] / base, 4)
+    return {"mode": "weak", "keys": K, "tuples_per_chip": per_chip,
+            "series": series}
+
+
 def load_history() -> dict:
     try:
         with open(HISTORY_PATH) as f:
@@ -353,6 +424,13 @@ def main() -> None:
     # through PipeGraph.run() + p99 event→window-result latency, alongside
     # the kernel number; the ratio shows what the runtime costs on top of
     # the device program.
+    if "--scaling" in sys.argv or \
+            os.environ.get("BENCH_SCALING") not in (None, "", "0"):
+        try:
+            result["scaling"] = run_bench_scaling(jax)
+        except Exception as e:
+            result["scaling"] = {"error": f"{type(e).__name__}: {e}"[:300]}
+
     try:
         e2e = run_bench_e2e(platform, CONFIGS[platform], jax)
         e2e["ratio_vs_kernel"] = round(
@@ -366,9 +444,15 @@ def main() -> None:
             # e2e saturates the LINK, not the chip: staged MB/s below ≈
             # measured link bandwidth.  On host-attached TPU (PCIe/ICI,
             # tens of GB/s) the same path is compute-bound.
-            e2e["gap_diagnosis"] = (
-                f"link-bound: staging {e2e['tuples_per_sec'] * 16 / 1e6:.0f}"
-                " MB/s ~= tunnel bandwidth; kernel reads pre-staged HBM")
+            if platform == "tpu":
+                e2e["gap_diagnosis"] = (
+                    "link-bound: staging "
+                    f"{e2e['tuples_per_sec'] * 16 / 1e6:.0f}"
+                    " MB/s ~= tunnel bandwidth; kernel reads pre-staged HBM")
+            else:
+                e2e["gap_diagnosis"] = (
+                    "cpu fallback: kernel and pipeline share host cores; "
+                    "ingest parsing + driver loop compete with compute")
         result["e2e"] = e2e
     except Exception as e:
         result["e2e_error"] = f"{type(e).__name__}: {e}"[:400]

@@ -1,0 +1,121 @@
+"""Window-SPEC sweep for the flagship FfatWindowsTPU: random (win, slide)
+pairs — sliding, tumbling (win == slide), hopping with gaps (slide > win),
+and coprime pairs where the pane decomposition degenerates to P = gcd = 1 —
+each checked against a pure-Python oracle over random batch sizes.
+
+The reference's window tests fix one spec per binary
+(``tests/win_tests_gpu/test_win_fat_gpu_tb.cpp``); its randomized sweeps
+vary parallelism/batching but never the spec.  The pane decomposition
+(P = gcd(win, slide), R = win/P, D = slide/P) makes the spec itself the
+riskiest input here, so this sweep varies it.
+"""
+
+import math
+import random
+
+import pytest
+
+import windflow_tpu as wf
+
+N_KEYS = 3
+LENGTH = 300
+
+
+def stream():
+    return [{"key": i % N_KEYS, "value": i, "ts": i * 1000}
+            for i in range(LENGTH)]
+
+
+def oracle_cb(win, slide):
+    """Per-key count windows incl. EOS partials: window w covers that key's
+    arrivals [w*slide, w*slide+win) and exists iff its start is before the
+    key's end-of-stream."""
+    per_key = {}
+    for t in stream():
+        per_key.setdefault(t["key"], []).append(t["value"])
+    exp = {}
+    for k, vals in per_key.items():
+        w = 0
+        while w * slide < len(vals):
+            seg = vals[w * slide: w * slide + win]
+            if seg:
+                exp[(k, w)] = sum(seg)
+            w += 1
+    return exp
+
+
+def oracle_tb(win_us, slide_us):
+    """Per-key time windows: every window containing >= 1 tuple fires with
+    its full contents (empty windows never fire)."""
+    per_key = {}
+    for t in stream():
+        per_key.setdefault(t["key"], []).append((t["ts"], t["value"]))
+    exp = {}
+    for k, pts in per_key.items():
+        wids = set()
+        for ts, _ in pts:
+            last = ts // slide_us
+            first = max(0, -(-(ts - win_us + 1) // slide_us))
+            wids.update(range(first, last + 1))
+        for w in wids:
+            vals = [v for ts, v in pts
+                    if w * slide_us <= ts < w * slide_us + win_us]
+            if vals:
+                exp[(k, w)] = sum(vals)
+    return exp
+
+
+def run_ffat_tpu(win_type, win, slide, batch):
+    got = {}
+    src = (wf.Source_Builder(lambda: iter(stream()))
+           .withTimestampExtractor(lambda t: t["ts"])
+           .withOutputBatchSize(batch).build())
+    b = (wf.Ffat_WindowsTPU_Builder(lambda t: t["value"],
+                                    lambda a, b: a + b)
+         .withKeyBy(lambda t: t["key"]).withMaxKeys(N_KEYS))
+    if win_type == "cb":
+        b = b.withCBWindows(win, slide)
+    else:
+        b = b.withTBWindows(win * 1000, slide * 1000)
+    snk = wf.Sink_Builder(
+        lambda r: got.__setitem__((r["key"], r["wid"]), r["value"])
+        if r is not None else None).build()
+    g = wf.PipeGraph("spec_sweep", wf.ExecutionMode.DEFAULT,
+                     wf.TimePolicy.EVENT)
+    g.add_source(src).add(b.build()).add_sink(snk)
+    g.run()
+    return got
+
+
+# spec classes: sliding, tumbling, hopping-with-gap, coprime (P = 1), and a
+# slide-1 stress (D = 1, maximal window overlap)
+SPECS = [
+    (16, 4),     # classic sliding, P=4 R=4 D=1
+    (12, 12),    # tumbling, R=1 D=1
+    (6, 10),     # hopping with a 4-count gap, P=2 R=3 D=5
+    (7, 3),      # coprime: P=1 R=7 D=3
+    (9, 5),      # coprime: P=1 R=9 D=5
+    (10, 1),     # slide-1: every arrival ends a window, R=10 D=1
+]
+
+
+@pytest.mark.parametrize("win,slide", SPECS)
+def test_cb_spec(win, slide):
+    exp = oracle_cb(win, slide)
+    rnd = random.Random(win * 100 + slide)
+    for _ in range(2):
+        batch = rnd.randint(1, 96)
+        got = run_ffat_tpu("cb", win, slide, batch)
+        assert got == exp, (win, slide, batch,
+                            len(got), len(exp))
+
+
+@pytest.mark.parametrize("win,slide", SPECS)
+def test_tb_spec(win, slide):
+    exp = oracle_tb(win * 1000, slide * 1000)
+    rnd = random.Random(win * 100 + slide + 1)
+    for _ in range(2):
+        batch = rnd.randint(1, 96)
+        got = run_ffat_tpu("tb", win, slide, batch)
+        assert got == exp, (win, slide, batch,
+                            len(got), len(exp))

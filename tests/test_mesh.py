@@ -145,3 +145,34 @@ def test_sharded_ffat_matches_single_chip():
                           np.asarray(out["value"])[f].tolist()))
 
     assert fired_set(rout, rfired) == fired_set(sout, sfired)
+
+def test_scaling_harness_loop_body():
+    """One width-2 rung of bench.py's weak-scaling harness (the per-n body
+    run_bench_scaling executes on real multi-chip hardware; refused on
+    virtual devices) must compose and reduce correctly — built via the
+    SHARED bench.scaling_step so this test and the harness cannot drift."""
+    import os
+    import sys
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    import bench
+
+    K, per_chip = 64, 4096
+    fn, payload, valid, cap = bench.scaling_step(jax, n=2, K=K,
+                                                 per_chip=per_chip)
+    assert cap == 2 * per_chip
+    table, has = fn(payload, valid)
+    exp = np.zeros(K, np.float64)
+    np.add.at(exp, np.asarray(payload["k"]), np.asarray(payload["v"]))
+    np.testing.assert_allclose(np.asarray(table["v"]), exp, rtol=1e-5)
+    assert bool(np.asarray(has).all())
+
+
+def test_scaling_harness_refuses_virtual_mesh():
+    import os
+    import sys
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    import bench
+    out = bench.run_bench_scaling(jax)
+    assert "skipped" in out and "virtual" in out["skipped"]

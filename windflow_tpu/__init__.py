@@ -61,7 +61,7 @@ from windflow_tpu.persistent import (DBHandle, LogKV, PFilter, PFlatMap,
                                      P_Keyed_Windows_Builder, P_Map_Builder,
                                      P_Reduce_Builder, P_Sink_Builder)
 
-__version__ = "0.1.0"
+__version__ = "0.3.0"  # keep in sync with pyproject.toml
 
 __all__ = [
     "Config", "EMPTY_KEY", "ExecutionMode", "RoutingMode", "TimePolicy",

@@ -52,25 +52,9 @@ from windflow_tpu.batch import DeviceBatch
 from windflow_tpu.ops.base import Operator
 from windflow_tpu.ops.tpu import _TPUReplica, _bshape
 from windflow_tpu.parallel.emitters import KeyInterner
+from windflow_tpu.utils.dtypes import cast_state_update as _cast_update
 
 _KEY_SENTINEL = np.int32(2**31 - 1)
-
-
-def _cast_update(u, dtype):
-    """Cast a state update to the table dtype (the user's prototype is
-    authoritative; fn may promote, e.g. f32 state + f64 payload column, and
-    a promoting scatter is an error in future JAX) — but only within a
-    kind: silently truncating a float update into an int table would
-    corrupt state, so kind-crossing is a loud error instead."""
-    if u.dtype == dtype:
-        return u
-    if np.dtype(u.dtype).kind == np.dtype(dtype).kind:
-        return u.astype(dtype)
-    raise WindFlowError(
-        f"stateful update dtype {u.dtype} does not match the state "
-        f"prototype dtype {dtype} (kind-crossing cast would corrupt "
-        "state); make fn return the prototype's kind or widen the "
-        "prototype passed to withInitialState")
 
 
 def _broadcast_state(proto, num_slots: int):

@@ -1,0 +1,36 @@
+"""Dtype policy for writes into carried device state.
+
+One rule, shared by the stateful-operator scatters (``ops/tpu_stateful.py``)
+and the FFAT continuation-cell merge (``windows/ffat_kernels.py``): the
+state/table dtype is authoritative, and a user-fn update may be cast to it
+when the cast cannot corrupt state —
+
+* same kind (f64 → f32 narrowing, i64 → i32, …): allowed — deliberate
+  narrowing to the declared state precision;
+* standard promotion lands on the state dtype (i32 update into an f32
+  table): allowed — identical to what ``state + update`` arithmetic does;
+* anything else (float update into an int table, complex into float,
+  signed into unsigned): a loud error — a silent truncating scatter would
+  corrupt state with no diagnostic (and is an error in future JAX anyway).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from windflow_tpu.basic import WindFlowError
+
+
+def cast_state_update(u, dtype, what: str = "stateful update"):
+    """Cast update ``u`` to the state ``dtype`` under the policy above."""
+    if u.dtype == dtype:
+        return u
+    if np.dtype(u.dtype).kind == np.dtype(dtype).kind:
+        return u.astype(dtype)
+    if jnp.promote_types(u.dtype, dtype) == np.dtype(dtype):
+        return u.astype(dtype)
+    raise WindFlowError(
+        f"{what} dtype {u.dtype} does not match the state dtype {dtype} "
+        "(the cast would corrupt state); make the function return the "
+        "state's kind or widen the state prototype")

@@ -13,6 +13,8 @@ from typing import Any, Callable, Optional
 import jax
 import jax.numpy as jnp
 
+from windflow_tpu.utils.dtypes import cast_state_update
+
 
 def _seg_scan(comb, flags, values):
     """Inclusive segmented scan: within each flagged segment, fold ``comb``.
@@ -189,8 +191,10 @@ def make_ffat_step(capacity: int, K: int, P: int, R: int, D: int,
                                     cell_leaf[:, 0]))
             # carried state may be wider than the batch-derived cells (e.g.
             # an f64 agg_spec under x64 vs f32 lifts); the cell dtype is
-            # authoritative — a promoting scatter errors in future JAX
-            return cell_leaf.at[:, 0].set(v.astype(cell_leaf.dtype))
+            # authoritative — a promoting scatter errors in future JAX,
+            # and a kind-crossing cast is state corruption (utils.dtypes)
+            return cell_leaf.at[:, 0].set(
+                cast_state_update(v, cell_leaf.dtype, "FFAT pane merge"))
         cells = jax.tree.map(
             lambda cur_leaf, cell_leaf: merge0(cur_leaf, cell_leaf),
             state["cur"], cells)
