@@ -168,3 +168,77 @@ def test_rebalancing_conflicts_with_keyby():
     with pytest.raises(wf.WindFlowError):
         (wf.Map_Builder(lambda t: t).withKeyBy(lambda t: t)
          .withRebalancing()._routing())
+
+
+def test_broadcast_routing():
+    """withBroadcast (reference builders.hpp:252-1471): every replica of the
+    operator receives every tuple."""
+    length = 120
+    per_replica = {}
+
+    def spy(t, ctx):
+        per_replica.setdefault(ctx.replica_index, []).append(t["value"])
+        return t
+
+    acc = Acc()
+    src = (wf.Source_Builder(
+        lambda: iter({"value": i} for i in range(length)))
+        .withOutputBatchSize(8).build())
+    bmap = (wf.Map_Builder(spy).withParallelism(3).withBroadcast().build())
+    snk = wf.Sink_Builder(acc).build()
+    g = wf.PipeGraph("bcast", wf.ExecutionMode.DEFAULT)
+    g.add_source(src).add(bmap).add_sink(snk)
+    g.run()
+    assert set(per_replica) == {0, 1, 2}
+    for vals in per_replica.values():
+        assert sorted(vals) == list(range(length))
+    # downstream sink sees every replica's copy
+    assert acc.count == 3 * length
+
+
+def test_broadcast_conflicts():
+    with pytest.raises(wf.WindFlowError):
+        (wf.Map_Builder(lambda t: t).withKeyBy(lambda t: 0)
+         .withBroadcast()._routing())
+
+
+def test_closing_function_runs_once_per_replica():
+    """withClosingFunction (reference closing_func on every operator
+    builder): runs at replica termination with the RuntimeContext."""
+    closed = []
+    acc = Acc()
+    src = (wf.Source_Builder(lambda: iter({"value": i} for i in range(50)))
+           .withOutputBatchSize(8).build())
+    m = (wf.Map_Builder(lambda t: t).withParallelism(3)
+         .withClosingFunction(lambda ctx: closed.append(
+             (ctx.operator_name, ctx.replica_index))).build())
+    snk = (wf.Sink_Builder(acc)
+           .withClosingFunction(lambda: closed.append(("sink", 0))).build())
+    g = wf.PipeGraph("closing", wf.ExecutionMode.DEFAULT)
+    g.add_source(src).add(m).add_sink(snk)
+    g.run()
+    assert sorted(c for c in closed if c[0] != "sink") == \
+        [("map", 0), ("map", 1), ("map", 2)]
+    assert ("sink", 0) in closed
+    assert acc.count == 50
+
+
+def test_closing_function_on_chained_stages():
+    """Both constituents' closers run when stages fuse into one replica."""
+    closed = []
+    acc = Acc()
+    src = (wf.Source_Builder(lambda: iter({"value": i} for i in range(20)))
+           .withOutputBatchSize(4).build())
+    m1 = (wf.Map_Builder(lambda t: {"value": t["value"] + 1})
+          .withClosingFunction(lambda: closed.append("m1")).build())
+    m2 = (wf.Map_Builder(lambda t: {"value": t["value"] * 2})
+          .withClosingFunction(lambda: closed.append("m2")).build())
+    snk = wf.Sink_Builder(acc).build()
+    g = wf.PipeGraph("closing_chain", wf.ExecutionMode.DEFAULT)
+    mp = g.add_source(src)
+    mp.add(m1)
+    mp.chain(m2)
+    mp.add_sink(snk)
+    g.run()
+    assert closed == ["m1", "m2"]
+    assert acc.total == sum((i + 1) * 2 for i in range(20))

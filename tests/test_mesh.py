@@ -6,6 +6,8 @@ reduce via psum and via gather+fold, and FFAT window state sharded along the
 key axis, against host oracles."""
 
 import math
+import os
+import sys
 
 import jax
 import jax.numpy as jnp
@@ -13,6 +15,9 @@ import numpy as np
 import pytest
 
 from windflow_tpu.parallel import mesh as M
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import bench  # noqa: E402  (repo-root module: the scaling harness under test)
 
 
 def _rand_batch(cap, K, seed=0):
@@ -151,12 +156,6 @@ def test_scaling_harness_loop_body():
     run_bench_scaling executes on real multi-chip hardware; refused on
     virtual devices) must compose and reduce correctly — built via the
     SHARED bench.scaling_step so this test and the harness cannot drift."""
-    import os
-    import sys
-    sys.path.insert(0, os.path.dirname(os.path.dirname(
-        os.path.abspath(__file__))))
-    import bench
-
     K, per_chip = 64, 4096
     fn, payload, valid, cap = bench.scaling_step(jax, n=2, K=K,
                                                  per_chip=per_chip)
@@ -169,10 +168,5 @@ def test_scaling_harness_loop_body():
 
 
 def test_scaling_harness_refuses_virtual_mesh():
-    import os
-    import sys
-    sys.path.insert(0, os.path.dirname(os.path.dirname(
-        os.path.abspath(__file__))))
-    import bench
     out = bench.run_bench_scaling(jax)
     assert "skipped" in out and "virtual" in out["skipped"]

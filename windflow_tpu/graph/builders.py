@@ -23,6 +23,7 @@ from windflow_tpu.ops.tpu_stateful import StatefulFilterTPU, StatefulMapTPU
 
 class _BuilderBase:
     _default_name = "op"
+    _closing_func: Optional[Callable] = None
 
     def __init__(self) -> None:
         self._name = self._default_name
@@ -30,8 +31,31 @@ class _BuilderBase:
         self._output_batch_size = 0
         self._key_extractor: Optional[Callable] = None
 
+    def __init_subclass__(cls, **kwargs):
+        # every builder's build() applies the clauses _BuilderBase owns but
+        # the per-builder constructors don't know about (closing function);
+        # wrapping here keeps the ~20 build() methods oblivious
+        super().__init_subclass__(**kwargs)
+        orig = cls.__dict__.get("build")
+        if orig is not None:
+            def build(self, _orig=orig):
+                op = _orig(self)
+                if self._closing_func is not None:
+                    op.closing_func = self._closing_func
+                return op
+            build.__doc__ = orig.__doc__
+            cls.build = build
+
     def withName(self, name: str):
         self._name = name
+        return self
+
+    def withClosingFunction(self, fn: Callable):
+        """Per-replica shutdown callback, run once when the replica
+        terminates at EOS — ``fn(ctx)`` with the replica's RuntimeContext,
+        or ``fn()`` (reference ``closing_func`` accepted by every operator
+        builder, e.g. ``map.hpp:335-343``)."""
+        self._closing_func = fn
         return self
 
     def withParallelism(self, parallelism: int):
@@ -54,6 +78,13 @@ class _BuilderBase:
         return self
 
     def _routing(self) -> RoutingMode:
+        if getattr(self, "_broadcast", False):
+            if self._key_extractor is not None \
+                    or getattr(self, "_rebalancing", False):
+                raise WindFlowError(
+                    "withBroadcast is mutually exclusive with withKeyBy "
+                    "and withRebalancing")
+            return RoutingMode.BROADCAST
         if getattr(self, "_rebalancing", False):
             if self._key_extractor is not None:
                 raise WindFlowError(
@@ -61,6 +92,16 @@ class _BuilderBase:
             return RoutingMode.REBALANCING
         return (RoutingMode.KEYBY if self._key_extractor is not None
                 else RoutingMode.FORWARD)
+
+
+class _BroadcastMixin:
+    """withBroadcast for the operators the reference offers it on
+    (Map/Filter/FlatMap/Sink, ``builders.hpp:252-1471``): every replica of
+    the built operator receives every input tuple."""
+
+    def withBroadcast(self):
+        self._broadcast = True
+        return self
 
 
 class Source_Builder(_BuilderBase):
@@ -90,7 +131,7 @@ class Source_Builder(_BuilderBase):
                       ts_extractor=self._ts_extractor)
 
 
-class Map_Builder(_BuilderBase):
+class Map_Builder(_BroadcastMixin, _BuilderBase):
     _default_name = "map"
 
     def __init__(self, fn: Callable) -> None:
@@ -104,7 +145,7 @@ class Map_Builder(_BuilderBase):
                    key_extractor=self._key_extractor)
 
 
-class Filter_Builder(_BuilderBase):
+class Filter_Builder(_BroadcastMixin, _BuilderBase):
     _default_name = "filter"
 
     def __init__(self, fn: Callable) -> None:
@@ -119,7 +160,7 @@ class Filter_Builder(_BuilderBase):
                       key_extractor=self._key_extractor)
 
 
-class FlatMap_Builder(_BuilderBase):
+class FlatMap_Builder(_BroadcastMixin, _BuilderBase):
     _default_name = "flatmap"
 
     def __init__(self, fn: Callable) -> None:
@@ -154,7 +195,7 @@ class Reduce_Builder(_BuilderBase):
                       output_batch_size=self._output_batch_size)
 
 
-class Sink_Builder(_BuilderBase):
+class Sink_Builder(_BroadcastMixin, _BuilderBase):
     _default_name = "sink"
 
     def __init__(self, fn: Callable) -> None:

@@ -70,7 +70,10 @@ class MultiPipe:
             # composite window operators expand into their pipeline stages
             # (reference adds PLQ+WLQ / MAP+REDUCE as two operators,
             # multipipe.hpp:965-999)
+            cf = getattr(op, "closing_func", None)
             for stage in op.stages():
+                if cf is not None and stage.closing_func is None:
+                    stage.closing_func = cf
                 self.add(stage)
             return self
         self._check_open()

@@ -51,6 +51,12 @@ class KafkaSource_Builder(_BuilderBase):
     def withKeyBy(self, *_):
         raise WindFlowError("a Kafka_Source has no input to key by")
 
+    def withKafkaClosingFunction(self, fn: Callable):
+        """Reference-named alias of withClosingFunction
+        (``builders_kafka.hpp`` withKafkaClosingFunction): Kafka replicas
+        own a KafkaRuntimeContext, so ``fn(ctx)`` receives it directly."""
+        return self.withClosingFunction(fn)
+
     def build(self) -> KafkaSource:
         if self._brokers is None:
             raise WindFlowError("Kafka_Source needs withBrokers(...)")
@@ -75,6 +81,11 @@ class KafkaSink_Builder(_BuilderBase):
 
     def withOutputBatchSize(self, *_):
         raise WindFlowError("a Kafka_Sink has no output to batch")
+
+    def withKafkaClosingFunction(self, fn: Callable):
+        """Reference-named alias of withClosingFunction (see
+        KafkaSource_Builder.withKafkaClosingFunction)."""
+        return self.withClosingFunction(fn)
 
     def build(self) -> KafkaSink:
         if self._brokers is None:

@@ -110,6 +110,13 @@ class Replica:
         if self.emitter is not None:
             self.emitter.flush(self.current_wm)
             self.emitter.propagate_punctuation(WM_MAX)
+        cf = self.op.closing_func
+        if cf is not None:
+            # per-replica shutdown callback (reference closing_func run in
+            # svc_end with the replica's RuntimeContext, map.hpp:79-81);
+            # adapt() swallows the context for non-riched closers
+            from windflow_tpu.meta import adapt
+            adapt(cf, 0)(self.context)
         self.done = True
 
     def _dispatch(self, msg) -> None:
@@ -176,6 +183,11 @@ class Operator:
     replica_class = Replica
     #: terminal operators (sinks) have no emitter / downstream consumer
     is_terminal = False
+    #: per-replica shutdown callback, set by withClosingFunction (reference
+    #: ``closing_func``: every operator builder accepts one); invoked at
+    #: replica termination with the replica's RuntimeContext (arity 1) or
+    #: no arguments (arity 0)
+    closing_func = None
 
     def __init__(self, name: str, parallelism: int,
                  routing: RoutingMode = RoutingMode.FORWARD,

@@ -162,7 +162,20 @@ def fuse(a: Operator, b: Operator) -> Operator:
     """Fuse two chainable operators into one stage."""
     name = f"{a.name}|{b.name}"
     if a.is_tpu:
-        return ChainedTPU(_tpu_specs(a) + _tpu_specs(b), name, a.parallelism,
-                          a.routing, a.key_extractor)
-    return ChainedHost(_host_specs(a) + _host_specs(b), name, a.parallelism,
-                       a.routing, b.output_batch_size, a.key_extractor)
+        fused = ChainedTPU(_tpu_specs(a) + _tpu_specs(b), name,
+                           a.parallelism, a.routing, a.key_extractor)
+    else:
+        fused = ChainedHost(_host_specs(a) + _host_specs(b), name,
+                            a.parallelism, a.routing, b.output_batch_size,
+                            a.key_extractor)
+    closers = [f for f in (a.closing_func, b.closing_func) if f is not None]
+    if closers:
+        # the fused replica terminates once; run every constituent's closer
+        from windflow_tpu.meta import adapt
+        adapted = [adapt(f, 0) for f in closers]
+
+        def closing(ctx):
+            for f in adapted:
+                f(ctx)
+        fused.closing_func = closing
+    return fused

@@ -42,6 +42,14 @@ class _PersistentBuilderMixin:
         self._db_path = path
         return self
 
+    # reference-spelled aliases (builders_rocksdb.hpp withDbPath /
+    # withDeleteDb) so transliterated programs work unchanged
+    def withDbPath(self, path: str):
+        return self.withDBPath(path)
+
+    def withDeleteDb(self, delete: bool = True):
+        return self.withKeepDb(not delete)
+
     def withInitialState(self, state: Any):
         """Initial per-key state: a value (deep-copied per key) or a zero-arg
         factory."""
