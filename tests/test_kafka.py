@@ -270,3 +270,139 @@ def test_confluent_adapter_paths_with_fake_module():
         assert log.get("flushed")
     finally:
         del sys.modules["confluent_kafka"]
+
+
+def test_per_partition_watermarks_one_replica_two_partitions():
+    """A replica assigned several partitions must min-fold its watermark
+    over the partitions' event-time progress (per-partition watermarks):
+    poll rotation drains partitions in chunks, and a max-ts watermark
+    would mark the lagging partition's tuples late.  TB windows with zero
+    lateness downstream must still be exact with zero drops."""
+    import jax.numpy as jnp
+
+    import windflow_tpu as wf
+
+    n = 400
+    broker = InMemoryBroker()
+    broker.create_topic("pp", 2)
+    prod = broker.producer()
+    for i in range(n):   # partition p gets key p, both spanning ts 0..n ms
+        for p in (0, 1):
+            prod.produce("pp", {"key": p, "v": i, "ts": i * 1000},
+                         partition=p, timestamp_usec=i * 1000)
+    prod.flush()
+
+    got = {}
+    src = (KafkaSource_Builder(
+            lambda msg, shipper: shipper.pushWithTimestamp(
+                msg.value, msg.timestamp_usec)
+            if msg is not None else False)
+           .withBrokers(broker).withTopics("pp").withGroupID("ppg")
+           .withIdleness(1000).withOutputBatchSize(64).build())
+    win = (wf.Ffat_WindowsTPU_Builder(lambda t: t["v"], lambda a, b: a + b)
+           .withTBWindows(16_000, 4_000).withKeyBy(lambda t: t["key"])
+           .withMaxKeys(2).build())
+    snk = wf.Sink_Builder(
+        lambda r: got.__setitem__((int(r["key"]), int(r["wid"])),
+                                  int(r["value"]))
+        if r is not None else None).build()
+    g = wf.PipeGraph("pp_wm", wf.ExecutionMode.DEFAULT, wf.TimePolicy.EVENT)
+    g.add_source(src).add(win).add_sink(snk)
+    g.run()
+
+    st = win.dump_stats()
+    assert st["Late_tuples_dropped"] == 0
+    exp = {}
+    for k in (0, 1):
+        pts = [(i * 1000, i) for i in range(n)]
+        wids = set()
+        for ts, _ in pts:
+            last = ts // 4_000
+            first = max(0, -(-(ts - 16_000 + 1) // 4_000))
+            wids.update(range(first, last + 1))
+        for w in wids:
+            vals = [v for ts, v in pts
+                    if w * 4_000 <= ts < w * 4_000 + 16_000]
+            if vals:
+                exp[(k, w)] = sum(vals)
+    assert got == exp
+
+
+def test_kafka_closing_functions_see_live_clients():
+    """The closing function runs with the Kafka client still usable
+    (reference runs kafka_closing_func before teardown): the source closer
+    can read its assignment, the sink closer can produce a final marker."""
+    import windflow_tpu as wf
+
+    broker = InMemoryBroker()
+    fill_topic(broker, "in", 30, partitions=2)
+    broker.create_topic("out", 1)
+    src_assignment = []
+
+    src = (KafkaSource_Builder(
+            lambda msg, shipper: shipper.push(msg.value)
+            if msg is not None else False)
+           .withBrokers(broker).withTopics("in").withGroupID("cl")
+           .withIdleness(1000)
+           .withKafkaClosingFunction(
+               lambda ctx: src_assignment.extend(ctx.consumer.assignment()))
+           .withOutputBatchSize(8).build())
+    snk = (KafkaSink_Builder(
+            lambda t: KafkaSinkMessage("out", t))
+           .withBrokers(broker)
+           .withKafkaClosingFunction(
+               lambda ctx: (ctx.producer.produce("out", {"final": True}),
+                            ctx.producer.flush()))
+           .build())
+    g = wf.PipeGraph("kafka_closers", wf.ExecutionMode.DEFAULT)
+    g.add_source(src).add_sink(snk)
+    g.run()
+
+    assert src_assignment == [("in", 0), ("in", 1)]
+    c = broker.consumer()
+    c.subscribe(["out"], "check2")
+    vals = [m.value for m in c.poll(1000)]
+    assert {"final": True} in vals
+    assert len(vals) == 31  # 30 records + the closer's marker
+
+
+def test_heard_then_idle_partition_stops_gating():
+    """A partition that delivered once and went silent must stop pinning
+    the replica watermark after idle_time_usec — otherwise a live stream's
+    windows stall forever behind one stale partition."""
+    from windflow_tpu.basic import current_time_usecs
+    from windflow_tpu.kafka.kafka_source import (KafkaSource,
+                                                 KafkaSourceReplica)
+
+    class StubConsumer:
+        def assignment(self):
+            return [("t", 0), ("t", 1)]
+
+        def idle_partitions(self):
+            return None   # unknown: exercises the wall-clock fallback
+
+    class StubEmitter:
+        def emit(self, item, ts, wm, shared=False):
+            pass
+
+    op = KafkaSource(lambda m, s: None, object(), ["t"])
+    rep = KafkaSourceReplica(op, 0)
+    rep._consumer = StubConsumer()
+    rep.emitter = StubEmitter()
+    now = current_time_usecs()
+
+    # p1 delivered once at ts=0 long ago; p0 is streaming now
+    rep._part_max = {("t", 0): 500_000, ("t", 1): 0}
+    rep._part_last_at = {("t", 0): now, ("t", 1): now - 1_000_000}
+    assert rep._partition_wm() == 500_000  # p1 idle: no longer gating
+
+    # p1 delivered recently: it gates again
+    rep._part_last_at[("t", 1)] = now
+    assert rep._partition_wm() == 0
+
+    # and through the shipper: a push from p0 advances the wm past the
+    # idle sibling
+    rep._part_last_at[("t", 1)] = now - 1_000_000
+    rep._cur_tp = ("t", 0)
+    rep._shipper.pushWithTimestamp({"v": 1}, 600_000)
+    assert rep.current_wm == 600_000

@@ -146,6 +146,21 @@ class Config:
 default_config = Config()
 
 
+def stable_hash(key) -> int:
+    """Deterministic key hash (reference uses ``std::hash`` —
+    ``keyby_emitter.hpp:216``).  Python's ``hash`` is salted for str/bytes,
+    so use crc32 there to keep keyby placement (and Kafka partition
+    placement, ``kafka/client.py``) reproducible across processes."""
+    import zlib
+    if isinstance(key, int):
+        return key
+    if isinstance(key, str):
+        return zlib.crc32(key.encode())
+    if isinstance(key, bytes):
+        return zlib.crc32(key)
+    return hash(key)
+
+
 def current_time_usecs() -> int:
     """Monotonic-ish wall clock in microseconds (reference
     ``basic.hpp`` ``current_time_usecs``)."""

@@ -22,7 +22,8 @@ import itertools
 import threading
 from typing import Any, Dict, Iterable, List, Optional, Sequence, Tuple
 
-from windflow_tpu.basic import WindFlowError, current_time_usecs
+from windflow_tpu.basic import (WindFlowError, current_time_usecs,
+                                stable_hash)
 
 
 @dataclasses.dataclass
@@ -38,6 +39,11 @@ class KafkaMessage:
 
 
 class ConsumerClient:
+    def idle_partitions(self):
+        """Partitions confirmed drained/idle, or None when the client
+        cannot know (the source then uses wall-clock idleness)."""
+        return None
+
     def subscribe(self, topics: Sequence[str], group_id: str,
                   offsets: Optional[Sequence[int]] = None) -> None:
         raise NotImplementedError
@@ -133,7 +139,12 @@ class InMemoryBroker:
                 self._rebalance_subscribers(topic)
             if partition is None:
                 if key is not None:
-                    partition = hash(key) % len(parts)
+                    # deterministic placement: Python's hash() is salted
+                    # per process, which would scatter one key across
+                    # partitions between producer processes (Kafka uses
+                    # murmur2 for the same reason); stable_hash is crc32
+                    # for bytes
+                    partition = stable_hash(key) % len(parts)
                 else:
                     partition = next(self._rr) % len(parts)
             if not 0 <= partition < len(parts):
@@ -236,6 +247,23 @@ class InMemoryConsumer(ConsumerClient):
                 if take > 0:
                     out.extend(log[pos:pos + take])
                     self._group.positions[tp] = pos + take
+        return out
+
+    def idle_partitions(self):
+        """Assigned partitions with nothing pending RIGHT NOW (consumer
+        position at the log end) — the exact form of 'idle' the source's
+        per-partition watermark fold wants (such a partition must not gate
+        or pin event time).  Computed live under the broker lock, so a
+        partition refilled since its last visit immediately resumes
+        gating.  Real-client adapters return None (unknown) and the source
+        falls back to wall-clock idleness."""
+        out = set()
+        with self._broker._lock:
+            for tp in self._assignment:
+                t, p = tp
+                log = self._broker._topics[t][p].log
+                if self._group.positions.get(tp, 0) >= len(log):
+                    out.add(tp)
         return out
 
     def assignment(self) -> List[Tuple[str, int]]:

@@ -48,8 +48,18 @@ class KafkaSinkReplica(Replica):
                                timestamp_usec=ts)
 
     def on_eos(self):
+        # flush only: the closing function (reference kafka_closing_func)
+        # runs after on_eos with the producer still usable for final
+        # side-channel messages (kafka_sink.hpp runs it before teardown);
+        # _terminate below closes the producer afterwards
         self._producer.flush()
-        self._producer.close()
+
+    def _terminate(self):
+        was_done = self.done
+        super()._terminate()   # on_eos flush → emitter → closing_func
+        if not was_done:
+            self._producer.flush()
+            self._producer.close()
 
 
 class KafkaSink(Operator):

@@ -46,7 +46,32 @@ class KafkaShipper:
     def pushWithTimestamp(self, item: Any, ts: int) -> None:
         r = self._replica
         r._last_ts = max(r._last_ts, int(ts))
-        r._advance_wm(r._last_ts)
+        # Per-partition watermarking: a replica assigned several partitions
+        # must not let one partition's progress mark a lagging sibling's
+        # tuples late — its watermark is the MIN over its assigned
+        # partitions' event-time progress (what Kafka ecosystems call
+        # per-partition watermarks).  An assigned partition that has not
+        # delivered yet HOLDS THE WATERMARK DOWN (poll rotation may simply
+        # not have reached it), until it stays silent for idle_time_usec —
+        # then it stops gating (an empty partition must not stall event
+        # time forever).  Idle pushes (no current partition) fall back to
+        # the replica-wide max.
+        if r._cur_tp is not None:
+            pm = r._part_max
+            prev = pm.get(r._cur_tp)
+            advanced = prev is None or ts > prev
+            if advanced:
+                pm[r._cur_tp] = int(ts)
+            # recompute only when this partition's frontier moved or the
+            # fold was gated on an unheard partition — otherwise the min
+            # is unchanged and the scan (and its clock read) is skipped
+            if advanced or r._wm_gated:
+                wm = r._partition_wm()
+                r._wm_gated = wm is None
+                if wm is not None:
+                    r._advance_wm(wm)
+        else:
+            r._advance_wm(r._last_ts)
         r.stats.outputs_sent += 1
         r.emitter.emit(item, int(ts), r.current_wm)
         r._count_toward_punctuation(1)
@@ -59,6 +84,66 @@ class KafkaSourceReplica(SourceReplica):
         self._shipper = KafkaShipper(self)
         self._consumer = None
         self._last_activity = 0
+        #: (topic, partition) of the message currently being deserialized
+        self._cur_tp = None
+        #: per-partition max pushed event ts (see KafkaShipper watermarking)
+        self._part_max = {}
+        #: first wall time each assigned partition was observed (grace
+        #: anchor — per partition, so one gained in a later REBALANCE gets
+        #: its own hold-down window, not the replica's long-expired one)
+        self._part_seen_at = {}
+        #: wall time of each partition's last delivered message — a heard
+        #: partition silent past idle_time_usec stops gating the fold (it
+        #: would otherwise pin the watermark forever on a live stream)
+        self._part_last_at = {}
+        self._wm_gated = True
+        #: per-poll snapshots of assignment / idle partitions (tick
+        #: refreshes; None until the first poll → computed on demand)
+        self._poll_asn = None
+        self._poll_idle = None
+
+    def _partition_wm(self):
+        """Min event-time progress over assigned LIVE partitions; None
+        while an assigned partition still gates — unheard with data
+        possibly pending (the watermark must not advance past data poll
+        rotation hasn't reached).  An IDLE partition — confirmed drained
+        by the consumer (exact, in-memory broker), or silent past
+        idle_time_usec (wall-clock fallback, real-client adapters) — stops
+        gating until it delivers again: it must not stall or pin event
+        time on a live stream."""
+        # per-poll snapshots (tick refreshes them): the per-push fast path
+        # must not hit the consumer per tuple
+        asn = self._poll_asn
+        caught = self._poll_idle
+        if asn is None:
+            asn = self._consumer.assignment()
+            caught = self._consumer.idle_partitions()
+        idle_usec = self.op.idle_time_usec
+        now = None
+        lo = None
+        for tp in asn:
+            idle = caught is not None and tp in caught
+            pts = self._part_max.get(tp)
+            if pts is None:
+                if idle:
+                    continue         # confirmed empty: not gating
+                if caught is None:
+                    if now is None:
+                        now = current_time_usecs()
+                    seen = self._part_seen_at.setdefault(tp, now)
+                    if now - seen >= idle_usec:
+                        continue     # silent past the grace window
+                return None          # unheard, possibly pending: gate
+            if idle:
+                continue             # heard, confirmed drained: no gate
+            if caught is None and len(asn) > 1:
+                if now is None:
+                    now = current_time_usecs()
+                if now - self._part_last_at.get(tp, now) >= idle_usec:
+                    continue         # heard-then-silent: stops gating
+            if lo is None or pts < lo:
+                lo = pts
+        return lo
 
     def start(self) -> None:
         self._consumer = make_consumer(self.op.brokers)
@@ -76,10 +161,20 @@ class KafkaSourceReplica(SourceReplica):
             return False
         msgs = self._consumer.poll(max_items)
         run = True
+        # snapshot once per poll for the per-push watermark fold: idleness
+        # as of this poll (a refilled partition resumes gating at the next
+        # poll; within-poll pushes can't contain its data anyway)
+        self._poll_asn = self._consumer.assignment()
+        self._poll_idle = self._consumer.idle_partitions()
         if msgs:
             self._last_activity = current_time_usecs()
             for msg in msgs:
+                self._cur_tp = tp = (msg.topic, msg.partition)
+                # delivery = liveness, even if the deserializer pushes
+                # nothing for this message (one clock read per poll)
+                self._part_last_at[tp] = self._last_activity
                 ret = self._fn(msg, self._shipper, self.context)
+                self._cur_tp = None
                 self.stats.inputs_received += 1
                 if ret is False:
                     run = False
@@ -93,8 +188,11 @@ class KafkaSourceReplica(SourceReplica):
                     run = False
         if not run:
             self._exhausted = True
-            self._consumer.close()
+            # terminate first: the closing function (reference
+            # kafka_closing_func, kafka_source.hpp:296) must see a live
+            # consumer (commit offsets, read assignment); close after
             self._terminate()
+            self._consumer.close()
             return True  # termination (EOS cascade) is progress
         return True
 

@@ -27,7 +27,6 @@ Re-design of the reference emitter family (``/root/reference/wf/basic_emitter.hp
 from __future__ import annotations
 
 import math
-import zlib
 from typing import Any, Callable, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -62,17 +61,10 @@ def _splitmix64_dev(k32):
     return x ^ (x >> jnp.uint64(31))
 
 
-def stable_hash(key: Any) -> int:
-    """Deterministic key hash (reference uses ``std::hash`` —
-    ``keyby_emitter.hpp:216``).  Python's ``hash`` is salted for str/bytes, so
-    use crc32 there to keep keyby placement reproducible across processes."""
-    if isinstance(key, int):
-        return key
-    if isinstance(key, str):
-        return zlib.crc32(key.encode())
-    if isinstance(key, bytes):
-        return zlib.crc32(key)
-    return hash(key)
+# canonical definition lives in basic.py (pure-Python layers like the Kafka
+# client need it without pulling in this module's numpy/jax imports);
+# re-exported here because keyby placement is this layer's concern
+from windflow_tpu.basic import stable_hash  # noqa: F401,E402
 
 
 class KeyInterner:
@@ -108,6 +100,11 @@ class KeyInterner:
 class Emitter:
     """Base emitter: owns destination inboxes and per-destination channel ids
     (reference ``Basic_Emitter``, ``basic_emitter.hpp:62-121``)."""
+
+    #: whether this emitter implements the host-tuple emit() interface;
+    #: device-only emitters (pass-through, device keyby) override to False
+    #: so callers can detect an impossible host fallback up front
+    can_emit_host_items = True
 
     def __init__(self, dests: Sequence[Tuple[Any, int]],
                  output_batch_size: int) -> None:
@@ -529,6 +526,8 @@ class DeviceKeyByEmitter(Emitter):
     Empty partitions still ship (an all-invalid mask) — skipping them
     would force a host sync on the partition counts."""
 
+    can_emit_host_items = False
+
     def __init__(self, dests, key_extractor):
         super().__init__(dests, output_batch_size=0)
         self.key_extractor = key_extractor
@@ -579,6 +578,8 @@ class DevicePassEmitter(Emitter):
     unnecessary); keyby passes through — key grouping is resolved inside the
     consuming operator against the batch's key lane, and across chips by
     resharding collectives (parallel/mesh.py), not by emitter-side splits."""
+
+    can_emit_host_items = False
 
     def __init__(self, dests, routing: RoutingMode):
         super().__init__(dests, output_batch_size=0)
@@ -739,6 +740,18 @@ class SplittingEmitter(Emitter):
                                 size=None, frontier=batch.frontier))
             return
         # Fallback: host-side per-tuple split (Python or multicast split fn).
+        # Device-only branch emitters cannot accept host items — the same
+        # contract as the reference, whose GPU split requires a
+        # __host__ __device__ splitting functor (splitting_emitter_gpu.hpp).
+        for b, em in enumerate(self.branches):
+            if not type(em).can_emit_host_items:
+                raise WindFlowError(
+                    "split after a TPU stage feeds a TPU branch "
+                    f"(branch {b}), so the split function must be "
+                    "JAX-traceable and single-destination (got a Python-"
+                    "level or multicast split function); make the split "
+                    "function traceable or insert a host stage before the "
+                    "TPU branch")
         from windflow_tpu.batch import device_to_host
         hb = device_to_host(batch)
         for item, ts in zip(hb.items, hb.tss):
