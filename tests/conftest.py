@@ -24,3 +24,21 @@ import pytest
 @pytest.fixture
 def rng():
     return random.Random(1234)
+
+
+def tb_window_sums(points, win_us, slide_us):
+    """Shared TB-window oracle: per-key sums of every time window containing
+    at least one tuple.  ``points`` maps key -> [(ts_us, value), ...]."""
+    exp = {}
+    for k, pts in points.items():
+        wids = set()
+        for ts, _ in pts:
+            last = ts // slide_us
+            first = max(0, -(-(ts - win_us + 1) // slide_us))
+            wids.update(range(first, last + 1))
+        for w in wids:
+            vals = [v for ts, v in pts
+                    if w * slide_us <= ts < w * slide_us + win_us]
+            if vals:
+                exp[(k, w)] = sum(vals)
+    return exp

@@ -47,22 +47,11 @@ def oracle_cb(win, slide):
 def oracle_tb(win_us, slide_us):
     """Per-key time windows: every window containing >= 1 tuple fires with
     its full contents (empty windows never fire)."""
+    from conftest import tb_window_sums
     per_key = {}
     for t in stream():
         per_key.setdefault(t["key"], []).append((t["ts"], t["value"]))
-    exp = {}
-    for k, pts in per_key.items():
-        wids = set()
-        for ts, _ in pts:
-            last = ts // slide_us
-            first = max(0, -(-(ts - win_us + 1) // slide_us))
-            wids.update(range(first, last + 1))
-        for w in wids:
-            vals = [v for ts, v in pts
-                    if w * slide_us <= ts < w * slide_us + win_us]
-            if vals:
-                exp[(k, w)] = sum(vals)
-    return exp
+    return tb_window_sums(per_key, win_us, slide_us)
 
 
 def run_ffat_tpu(win_type, win, slide, batch):
@@ -119,3 +108,65 @@ def test_tb_spec(win, slide):
         got = run_ffat_tpu("tb", win, slide, batch)
         assert got == exp, (win, slide, batch,
                             len(got), len(exp))
+
+
+def _host_builder(family, nonin):
+    if family == "keyed":
+        return wf.Keyed_Windows_Builder(nonin)
+    if family == "paned":
+        return wf.Paned_Windows_Builder(nonin, lambda panes: sum(panes))
+    if family == "mapreduce":
+        return wf.MapReduce_Windows_Builder(nonin,
+                                            lambda partials: sum(partials))
+    if family == "ffat_host":
+        return wf.Ffat_Windows_Builder(lambda t: t["value"],
+                                       lambda a, b: a + b)
+    raise AssertionError(family)
+
+
+@pytest.mark.parametrize("family", ["keyed", "paned", "mapreduce",
+                                    "ffat_host"])
+@pytest.mark.parametrize("win,slide", [(16, 4), (12, 12), (6, 10), (7, 3)])
+def test_host_families_tb_spec(family, win, slide):
+    """Host window families across the same spec classes, TB form (the
+    reference's per-op single-spec binaries, widened to the spec space)."""
+    exp = oracle_tb(win * 1000, slide * 1000)
+    nonin = lambda items: sum(t["value"] for t in items)
+    got = {}
+    src = (wf.Source_Builder(lambda: iter(stream()))
+           .withTimestampExtractor(lambda t: t["ts"])
+           .withOutputBatchSize(13).build())
+    op = (_host_builder(family, nonin)
+          .withTBWindows(win * 1000, slide * 1000)
+          .withKeyBy(lambda t: t["key"]).build())
+    snk = wf.Sink_Builder(
+        lambda r: got.__setitem__((r.key, r.wid), int(r.value))
+        if r is not None else None).build()
+    g = wf.PipeGraph("host_spec", wf.ExecutionMode.DEFAULT,
+                     wf.TimePolicy.EVENT)
+    g.add_source(src).add(op).add_sink(snk)
+    g.run()
+    assert got == exp, (family, win, slide, len(got), len(exp))
+
+
+@pytest.mark.parametrize("family", ["keyed", "paned", "mapreduce",
+                                    "ffat_host"])
+@pytest.mark.parametrize("win,slide", [(16, 4), (12, 12), (6, 10), (7, 3)])
+def test_host_families_cb_spec(family, win, slide):
+    exp = oracle_cb(win, slide)
+    nonin = lambda items: sum(t["value"] for t in items)
+    got = {}
+    src = (wf.Source_Builder(lambda: iter(stream()))
+           .withTimestampExtractor(lambda t: t["ts"])
+           .withOutputBatchSize(13).build())
+    op = (_host_builder(family, nonin)
+          .withCBWindows(win, slide)
+          .withKeyBy(lambda t: t["key"]).build())
+    snk = wf.Sink_Builder(
+        lambda r: got.__setitem__((r.key, r.wid), int(r.value))
+        if r is not None else None).build()
+    g = wf.PipeGraph("host_spec_cb", wf.ExecutionMode.DEFAULT,
+                     wf.TimePolicy.EVENT)
+    g.add_source(src).add(op).add_sink(snk)
+    g.run()
+    assert got == exp, (family, win, slide, len(got), len(exp))

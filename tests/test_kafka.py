@@ -312,19 +312,9 @@ def test_per_partition_watermarks_one_replica_two_partitions():
 
     st = win.dump_stats()
     assert st["Late_tuples_dropped"] == 0
-    exp = {}
-    for k in (0, 1):
-        pts = [(i * 1000, i) for i in range(n)]
-        wids = set()
-        for ts, _ in pts:
-            last = ts // 4_000
-            first = max(0, -(-(ts - 16_000 + 1) // 4_000))
-            wids.update(range(first, last + 1))
-        for w in wids:
-            vals = [v for ts, v in pts
-                    if w * 4_000 <= ts < w * 4_000 + 16_000]
-            if vals:
-                exp[(k, w)] = sum(vals)
+    from conftest import tb_window_sums
+    pts = [(i * 1000, i) for i in range(n)]
+    exp = tb_window_sums({0: pts, 1: pts}, 16_000, 4_000)
     assert got == exp
 
 
@@ -406,3 +396,32 @@ def test_heard_then_idle_partition_stops_gating():
     rep._cur_tp = ("t", 0)
     rep._shipper.pushWithTimestamp({"v": 1}, 600_000)
     assert rep.current_wm == 600_000
+
+
+def test_steady_state_watermark_advances_when_caught_up():
+    """The normal live steady state — consumer keeping pace, every poll
+    drains its partition — must still advance the watermark (a drained
+    partition that delivered THIS poll is live, not idle)."""
+    from windflow_tpu.kafka.kafka_source import KafkaSource
+
+    broker = InMemoryBroker()
+    broker.create_topic("live", 1)
+    prod = broker.producer()
+
+    op = KafkaSource(
+        lambda msg, shipper: shipper.pushWithTimestamp(
+            msg.value, msg.timestamp_usec) if msg is not None else None,
+        broker, ["live"])
+    rep = op.build_replicas(wf.ExecutionMode.DEFAULT,
+                            wf.TimePolicy.EVENT)[0]
+
+    class NullEmitter:
+        def emit(self, item, ts, wm, shared=False):
+            pass
+
+    rep.emitter = NullEmitter()
+    rep.start()
+    for ts in (1_000, 2_000, 3_000):
+        prod.produce("live", {"v": ts}, timestamp_usec=ts)
+        rep.tick(10)                 # poll drains the partition each time
+        assert rep.current_wm == ts  # watermark tracks the live partition

@@ -115,18 +115,8 @@ def test_kitchen_sink(tmp_path):
             v = t["v"] * 2
             if v % 10 != 6:
                 per_key.setdefault(t["key"], []).append((t["ts"], v))
-    exp_w = {}
-    for k, pts in per_key.items():
-        wids = set()
-        for ts, _ in pts:
-            last = ts // TSLIDE
-            first = max(0, -(-(ts - TWIN + 1) // TSLIDE))
-            wids.update(range(first, last + 1))
-        for w in wids:
-            vals = [v for ts, v in pts
-                    if w * TSLIDE <= ts < w * TSLIDE + TWIN]
-            if vals:
-                exp_w[(k, w)] = sum(vals)
+    from conftest import tb_window_sums
+    exp_w = tb_window_sums(per_key, TWIN, TSLIDE)
     assert win_cols == exp_w
 
     # branch 1: odd keys, broadcast delivered to BOTH tap replicas

@@ -13,6 +13,7 @@ import dataclasses
 import enum
 import os
 import time
+import zlib
 
 
 class ExecutionMode(enum.Enum):
@@ -151,7 +152,6 @@ def stable_hash(key) -> int:
     ``keyby_emitter.hpp:216``).  Python's ``hash`` is salted for str/bytes,
     so use crc32 there to keep keyby placement (and Kafka partition
     placement, ``kafka/client.py``) reproducible across processes."""
-    import zlib
     if isinstance(key, int):
         return key
     if isinstance(key, str):

@@ -163,9 +163,16 @@ class KafkaSourceReplica(SourceReplica):
         run = True
         # snapshot once per poll for the per-push watermark fold: idleness
         # as of this poll (a refilled partition resumes gating at the next
-        # poll; within-poll pushes can't contain its data anyway)
+        # poll; within-poll pushes can't contain its data anyway).  A
+        # partition that DELIVERED in this poll is live by definition even
+        # if the poll drained it — in the normal steady state (consumer
+        # keeping pace) every partition is always caught up, and treating
+        # that as idle would freeze the watermark forever.
         self._poll_asn = self._consumer.assignment()
-        self._poll_idle = self._consumer.idle_partitions()
+        caught = self._consumer.idle_partitions()
+        if caught is not None and msgs:
+            caught = caught - {(m.topic, m.partition) for m in msgs}
+        self._poll_idle = caught
         if msgs:
             self._last_activity = current_time_usecs()
             for msg in msgs:

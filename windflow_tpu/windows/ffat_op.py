@@ -92,6 +92,14 @@ class FfatWindowsReplica(Replica):
                 st.next_win += 1
         else:
             pane = ts // self._quantum
+            if self._domain_slide > self._domain_win \
+                    and pane % self._domain_slide >= self._domain_win:
+                # hopping windows with gaps (slide > win): panes in the
+                # inter-window gap belong to NO window — never write them
+                # into the ring (they would linger unevicted and fold into
+                # whatever pane wraps onto their slot; the device kernel
+                # masks these lanes the same way, ffat_kernels.py)
+                return
             if not st.started:
                 st.started = True
                 st.next_win = self._first_window_of(pane)
