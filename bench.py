@@ -202,12 +202,7 @@ def run_bench_e2e(platform: str, cfg: dict, jax) -> dict:
     timed run measures the framework, not the compiler."""
     import numpy as np
 
-    os.makedirs("/tmp/wf_jax_cache", exist_ok=True)
-    try:
-        jax.config.update("jax_compilation_cache_dir", "/tmp/wf_jax_cache")
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
-    except Exception:
-        pass  # older jax: first graph still warms per-process caches
+    _setup_compile_cache(jax)
 
     CAP, K = cfg["cap"], cfg["keys"]
     n_tuples = int(os.environ.get("BENCH_E2E_TUPLES", cfg["e2e_tuples"]))
@@ -307,6 +302,90 @@ def scaling_step(jax, n: int, K: int, per_chip: int, seed: int = 2):
     }
     valid = jax.device_put(jnp.ones(cap, bool), sh)
     return fn, payload, valid, cap
+
+
+def _setup_compile_cache(jax) -> None:
+    """Persistent XLA compilation cache: fresh operator objects (each graph
+    build) re-jit, so cross-run reuse needs the disk cache."""
+    os.makedirs("/tmp/wf_jax_cache", exist_ok=True)
+    try:
+        jax.config.update("jax_compilation_cache_dir", "/tmp/wf_jax_cache")
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+    except Exception:
+        pass  # older jax: first graph still warms per-process caches
+
+
+def run_bench_ysb(platform: str, cfg: dict, jax) -> dict:
+    """Yahoo-Streaming-Benchmark-shaped pipeline throughput (BASELINE.md
+    harness list: "YahooStreamingBench ad-analytics DAG"): columnar binary
+    ingest → FilterTPU(view events) ⊕ MapTPU(ad→campaign device-table
+    join), fused → per-campaign tumbling TB count windows → columnar sink,
+    all through ``PipeGraph.run()``."""
+    import numpy as np
+
+    import windflow_tpu as wf
+    from windflow_tpu.io import FrameSource
+
+    _setup_compile_cache(jax)
+    CAP = cfg["cap"]
+    n_ads, n_campaigns = 1000, 100
+    n_tuples = int(os.environ.get("BENCH_YSB_TUPLES", cfg["e2e_tuples"]))
+    rng = np.random.default_rng(3)
+    table_np = rng.integers(0, n_campaigns, n_ads).astype(np.int32)
+
+    rec = np.empty(n_tuples, dtype=[("k", "<i8"), ("t", "<i8"),
+                                    ("v", "<f8")])
+    rec["k"] = rng.integers(0, n_ads, n_tuples)          # ad_id
+    # event time spans ~64 tumbling windows so the firing path runs in
+    # steady state (not just the EOS flush)
+    gap_usec = max(1, 64 * 10_000_000 // n_tuples)
+    rec["t"] = np.arange(n_tuples, dtype=np.int64) * gap_usec
+    rec["v"] = rng.integers(0, 3, n_tuples).astype(np.float64)  # etype
+    blob = rec.tobytes()
+
+    def chunks():
+        for lo in range(0, len(blob), 1 << 20):
+            yield blob[lo:lo + (1 << 20)]
+
+    import jax.numpy as jnp
+    table = jnp.asarray(table_np)
+    rows = [0]
+
+    def build():
+        src = FrameSource(chunks, nv=1, fmt="frames",
+                          output_batch_size=CAP)
+        flt = wf.FilterTPU_Builder(lambda e: e["v0"] == 1.0).build()
+        prj = wf.MapTPU_Builder(
+            lambda e: {"campaign": table[e["key"]], "one": 1}).build()
+        win = (wf.Ffat_WindowsTPU_Builder(lambda e: e["one"],
+                                          lambda a, b: a + b)
+               .withTBWindows(10_000_000, 10_000_000)
+               .withKeyBy(lambda e: e["campaign"])
+               .withMaxKeys(n_campaigns).build())
+        snk = (wf.Sink_Builder(
+                lambda c: rows.__setitem__(0, rows[0] + len(c))
+                if c is not None else None)
+               .withColumnarSink().build())
+        g = wf.PipeGraph("bench_ysb", wf.ExecutionMode.DEFAULT,
+                         wf.TimePolicy.EVENT)
+        pipe = g.add_source(src)
+        pipe.add(flt)
+        pipe.chain(prj)       # Filter+Map fuse into one XLA program
+        pipe.add(win).add_sink(snk)
+        return g
+
+    build().run()             # warmup: compile all program shapes
+    rows[0] = 0
+    t0 = time.perf_counter()
+    build().run()
+    elapsed = time.perf_counter() - t0
+    return {
+        "tuples_per_sec": round(n_tuples / elapsed, 1),
+        "tuples": n_tuples,
+        "window_rows": rows[0],
+        "elapsed_s": round(elapsed, 3),
+        "shape": "FrameSource->FilterTPU+MapTPU(join)->FfatTB->colSink",
+    }
 
 
 def run_bench_scaling(jax, max_devices: Optional[int] = None) -> dict:
@@ -432,6 +511,11 @@ def main() -> None:
             result["scaling"] = {"error": f"{type(e).__name__}: {e}"[:300]}
 
     try:
+        result["ysb"] = run_bench_ysb(platform, CONFIGS[platform], jax)
+    except Exception as e:
+        result["ysb_error"] = f"{type(e).__name__}: {e}"[:300]
+
+    try:
         e2e = run_bench_e2e(platform, CONFIGS[platform], jax)
         e2e["ratio_vs_kernel"] = round(
             e2e["tuples_per_sec"] / result["value"], 4) \
@@ -467,6 +551,7 @@ def main() -> None:
     runs.append({"value": result["value"],
                  "p99_batch_latency_ms": result["p99_batch_latency_ms"],
                  "e2e": result.get("e2e"),
+                 "ysb": result.get("ysb"),
                  "t": now,
                  "recorded_at": time.strftime("%Y-%m-%d %H:%M:%S")})
     del runs[:-20]  # keep the last 20 runs per platform

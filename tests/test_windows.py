@@ -618,3 +618,66 @@ def test_ffat_tpu_tb_forward_parallelism_rejected():
         w = t["ts"] // 8_000
         exp[(0, w)] = exp.get((0, w), 0) + t["value"]
     assert got == exp
+
+
+def test_ffat_tpu_tb_ring_regrows_on_overflow():
+    """An auto-sized TB pane ring whose first batch under-represents the
+    steady state (dense burst, then 1 tuple per pane) must REGROW on
+    overflow instead of silently suppressing windows forever; once grown
+    to the batch-spread contract, late windows are exact."""
+    batch, P_usec = 512, 4_000   # win 16 ms / slide 4 ms -> R=4, D=1
+    items = []
+    for i in range(batch):       # batch 1: all inside one pane
+        items.append({"key": 0, "value": 1, "ts": i})
+    n_batches = 140
+    for j in range(n_batches * batch):  # then exactly 1 tuple per pane
+        items.append({"key": 0, "value": 1,
+                      "ts": (j + 1) * P_usec})
+    got = {}
+    src = (wf.Source_Builder(lambda: iter(items))
+           .withTimestampExtractor(lambda t: t["ts"])
+           .withOutputBatchSize(batch).build())
+    op = (wf.Ffat_WindowsTPU_Builder(lambda t: t["value"],
+                                     lambda a, b: a + b)
+          .withTBWindows(16_000, 4_000).withMaxKeys(1).build())
+    snk = wf.Sink_Builder(
+        lambda r: got.__setitem__(int(r["wid"]), int(r["value"]))
+        if r is not None else None).build()
+    g = wf.PipeGraph("regrow", wf.ExecutionMode.DEFAULT,
+                     wf.TimePolicy.EVENT)
+    g.add_source(src).add(op).add_sink(snk)
+    init_np_ceiling = 4 + 1 + batch + 2  # R + lat_panes + cap + 2
+    g.run()
+    st = op.dump_stats()
+    # the ring overflowed (the estimator undersized it) and grew to the
+    # contract size; after growth every window is exact
+    assert st["Pane_cells_evicted"] > 0
+    assert op.NP == init_np_ceiling, op.NP
+    # windows fully inside the last third of the stream: exact (each
+    # covers 4 panes x 1 tuple = 4, value 4)
+    last_pane = n_batches * batch
+    for w in range(last_pane - 2000, last_pane - 4):
+        assert got.get(w) == 4, (w, got.get(w))
+
+
+def test_ffat_tpu_tb_auto_ring_error_policy_grows_not_raises():
+    """overflow_policy='error' with an AUTO-sized ring: estimator growing
+    pains regrow silently; the error only fires for evictions after the
+    ring reached its ceiling (a user-sized ring still errors as before)."""
+    batch, P_usec = 256, 4_000
+    items = [{"key": 0, "value": 1, "ts": i} for i in range(batch)]
+    for j in range(80 * batch):
+        items.append({"key": 0, "value": 1, "ts": (j + 1) * P_usec})
+    src = (wf.Source_Builder(lambda: iter(items))
+           .withTimestampExtractor(lambda t: t["ts"])
+           .withOutputBatchSize(batch).build())
+    op = (wf.Ffat_WindowsTPU_Builder(lambda t: t["value"],
+                                     lambda a, b: a + b)
+          .withTBWindows(16_000, 4_000).withMaxKeys(1)
+          .withOverflowPolicy("error").build())
+    snk = wf.Sink_Builder(lambda r: None).build()
+    g = wf.PipeGraph("regrow_err", wf.ExecutionMode.DEFAULT,
+                     wf.TimePolicy.EVENT)
+    g.add_source(src).add(op).add_sink(snk)
+    g.run()   # must not raise: growth, not error
+    assert op.NP == 4 + 1 + batch + 2, op.NP

@@ -148,6 +148,14 @@ class FfatWindowsTPU(Operator):
         #: fires a wrong window (its FlatFAT grows instead).
         self.overflow_policy = overflow_policy
         self._overflow_steps = 0
+        self._auto_np = False          # NP chosen by the span estimator
+        self._np_ceil = None
+        self._evicted_seen = 0         # n_evicted at the last regrow check
+        self._pending_evct = None      # lazy counter read (one cadence old)
+        self._evicted_base = 0         # evictions excused as regrow pains
+        self._error_armed = False      # error policy live (post-transient)
+        self._clean_checks = 0
+        self._dirty_checks = 0
         # Device state, created on first batch.  CB: one shared table (key
         # 0) — per-key clock lanes make it partition-safe.  TB: one state
         # PER REPLICA index — the ring clocks are shared across a state's
@@ -219,15 +227,39 @@ class FfatWindowsTPU(Operator):
     def _ensure(self, batch: DeviceBatch, sidx: int):
         if self._capacity is None:
             self._capacity = batch.capacity
-            if self.NP is None:
-                # auto-size to one batch's worth of panes (a keyed
-                # partition batch of C tuples can span C panes), bounded so
-                # the dense [max_keys, NP] state stays ~O(32 MB)/leaf —
-                # beyond that, size explicitly with withPaneCapacity
-                cap_by_mem = max(64, (1 << 23) // max(1, self.max_keys))
+            cap_by_mem = max(64, (1 << 23) // max(1, self.max_keys))
+            # ceiling: a batch of C tuples can never span more than C
+            # panes, and the dense [max_keys, NP] state (plus the
+            # NP-proportional window-output grid) must stay bounded; the
+            # lateness allowance is ADDED — lateness pins panes in the
+            # ring by contract, so clamping it away would make the grown
+            # ring permanently too small for high-lateness specs
+            lat_panes = (self.spec.lateness // self.P + 1) if self.is_tb \
+                else 0
+            self._np_ceil = max(2 * self.R, self.R + 64,
+                                self.R + lat_panes
+                                + min(batch.capacity, 8192, cap_by_mem) + 2)
+            if self.NP is None and self.is_tb:
+                # Auto-size from the FIRST batch's observed time spread
+                # (one host sync, once): 8x margin over its pane span plus
+                # the lateness allowance, floored at 2R / R+64 and capped
+                # at the ceiling.  A first batch unrepresentative of the
+                # steady state cannot silently lose windows: ring overflow
+                # is detected on a cadence and the ring REGROWS toward the
+                # ceiling (see _maybe_regrow — the device form of the host
+                # FlatFAT's growth, ffat_op.py).
+                tmin = int(jnp.min(jnp.where(batch.valid, batch.ts,
+                                             jnp.int64(1) << 62)))
+                tmax = int(jnp.max(jnp.where(batch.valid, batch.ts,
+                                             -(jnp.int64(1) << 62))))
+                span = (tmax - tmin) // self.P + 1 if tmax >= tmin else 1
+                lat_panes = self.spec.lateness // self.P + 1
+                est = 8 * span + lat_panes + self.R + 2
                 self.NP = max(2 * self.R, self.R + 64,
-                              self.R + min(batch.capacity, 8192,
-                                           cap_by_mem) + 2)
+                              min(est, self._np_ceil))
+                self._auto_np = True
+            elif self.NP is None:
+                self.NP = self._np_ceil
             self._jit_step = self._build_step(batch.capacity)
             if self.is_tb:
                 self._payload_zero = jax.tree.map(jnp.zeros_like,
@@ -258,12 +290,15 @@ class FfatWindowsTPU(Operator):
             self._states[sidx], out, fired, out_ts, _ = self._jit_step(
                 self._states[sidx], batch.payload, batch.ts, batch.valid,
                 jnp.int64(self._wm_pane(batch.frontier)))
-            if self.overflow_policy == "error":
-                # periodic host checkpoint (one sync every 64 steps, and at
-                # EOS): fail loudly instead of producing wrong aggregates
-                self._overflow_steps += 1
-                if self._overflow_steps % 64 == 0:
-                    self._check_overflow(sidx)
+            # periodic host checkpoint (one sync every 32 steps, and at
+            # EOS): an auto-sized ring REGROWS on overflow before the
+            # error policy would fail loudly
+            self._overflow_steps += 1
+            if self._overflow_steps % 32 == 0:
+                if self._auto_np:
+                    self._maybe_regrow()
+                if self.overflow_policy == "error":
+                    self._check_overflow()
         else:
             self._states[sidx], out, fired, out_ts = self._jit_step(
                 self._states[sidx], batch.payload, batch.ts, batch.valid)
@@ -293,7 +328,7 @@ class FfatWindowsTPU(Operator):
         if sidx not in self._states:
             return []
         if self.overflow_policy == "error":
-            self._check_overflow(sidx)
+            self._check_overflow()
         cap = self._capacity
         ts0 = jnp.zeros(cap, jnp.int64)
         invalid = jnp.zeros(cap, bool)
@@ -311,8 +346,87 @@ class FfatWindowsTPU(Operator):
                 break
         return outs
 
-    def _check_overflow(self, sidx: int):
-        if int(jnp.sum(self._states[sidx]["n_evicted"])) > 0:
+    def _maybe_regrow(self):
+        """Self-healing for the span-estimated ring: if panes were evicted
+        since the last check, double the ring (up to the tuple-count
+        ceiling), padding the live state with invalid columns — the device
+        form of the host FlatFAT's growth-on-span (ffat_op.py).  Already-
+        evicted panes are gone (their windows were suppressed and counted
+        by the overflow policy); growth stops further loss.
+
+        The eviction counter is read one checkpoint LATE: each call
+        enqueues the (lazy, un-awaited) device sum and inspects the one
+        enqueued 32 steps ago — by then dispatch has executed it, so the
+        healthy path never blocks on a device sync."""
+        if self.NP >= self._np_ceil or not self._states:
+            return
+        prev = self._pending_evct
+        self._pending_evct = sum(
+            jnp.sum(st["n_evicted"]) for st in self._states.values())
+        if prev is None:
+            return
+        ev = int(prev)
+        if ev <= self._evicted_seen:
+            return
+        self._evicted_seen = ev
+        # x4 per event: the lazy read grows at most once per two
+        # checkpoints, so convergence to the ceiling must be steep
+        new_np = min(self._np_ceil, max(self.NP * 4, self.NP + 64))
+        pad = new_np - self.NP
+
+        def grow(st):
+            out = dict(st)
+            out["cells"] = jax.tree.map(
+                lambda a: jnp.pad(
+                    a, [(0, 0), (0, pad)] + [(0, 0)] * (a.ndim - 2)),
+                st["cells"])
+            out["cell_valid"] = jnp.pad(st["cell_valid"],
+                                        ((0, 0), (0, pad)))
+            if self.mesh is not None:
+                from windflow_tpu.parallel.mesh import state_sharding
+                sh = state_sharding(self.mesh)
+                for k in ("cells", "cell_valid"):
+                    out[k] = jax.tree.map(
+                        lambda a: jax.device_put(a, sh), out[k])
+            return out
+
+        self._states = {k: grow(st) for k, st in self._states.items()}
+        self.NP = new_np
+        self._pending_evct = None
+        self._jit_step = self._build_step(self._capacity)
+        if self.NP >= self._np_ceil:
+            # ceiling reached: evictions up to here were the estimator's
+            # growing pains, not the stream violating a user-sized ring —
+            # the 'error' policy only counts evictions past this point
+            self._evicted_base = self._tb_counter("n_evicted")
+
+    def _check_overflow(self):
+        # operator-wide: counters and the excused-eviction base
+        # are summed over every replica state
+        if self._auto_np and self.NP < self._np_ceil:
+            return   # still growing: regrow, don't error, on overflow
+        ev = self._tb_counter("n_evicted")
+        if self._auto_np and not self._error_armed:
+            # the undersized phase leaves a window-firing backlog whose
+            # drain still evicts briefly after growth; arm the error only
+            # after TWO consecutive clean checkpoints (the grow checkpoint
+            # itself is trivially clean — its base was just snapshotted).
+            # The grace is BOUNDED: persistent overflow at the ceiling is
+            # the stream violating the ring contract, and re-basing
+            # forever would silently defeat the 'error' policy.
+            if ev > self._evicted_base:
+                self._dirty_checks += 1
+                if self._dirty_checks <= 4:
+                    self._evicted_base = ev
+                    self._clean_checks = 0
+                    return
+                self._error_armed = True
+            else:
+                self._clean_checks += 1
+                if self._clean_checks < 2:
+                    return
+                self._error_armed = True
+        if ev > self._evicted_base:
             raise WindFlowError(
                 f"{self.name}: TB pane ring overflow (pane_capacity="
                 f"{self.NP} < window span + batch time spread + lateness "
