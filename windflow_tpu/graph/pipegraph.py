@@ -22,12 +22,12 @@ import json
 import os
 from typing import List, Optional
 
-from windflow_tpu.basic import (Config, ExecutionMode, RoutingMode, TimePolicy,
+from windflow_tpu.basic import (Config, ExecutionMode, TimePolicy,
                                 WindFlowError, default_config)
 from windflow_tpu.graph.multipipe import MultiPipe
 from windflow_tpu.ops.base import Operator
 from windflow_tpu.ops.source import Source, SourceReplica
-from windflow_tpu.parallel.collectors import KSlackCollector, create_collector
+from windflow_tpu.parallel.collectors import create_collector
 from windflow_tpu.parallel.emitters import SplittingEmitter, create_emitter
 
 

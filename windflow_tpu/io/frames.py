@@ -22,7 +22,6 @@ import numpy as np
 from windflow_tpu import native
 from windflow_tpu.basic import RoutingMode, TimePolicy, WindFlowError, \
     current_time_usecs
-from windflow_tpu.batch import WM_NONE
 from windflow_tpu.meta import adapt
 from windflow_tpu.ops.base import Operator, Replica
 from windflow_tpu.ops.source import BaseSourceReplica, Source

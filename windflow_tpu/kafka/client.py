@@ -20,7 +20,7 @@ from __future__ import annotations
 import dataclasses
 import itertools
 import threading
-from typing import Any, Dict, Iterable, List, Optional, Sequence, Tuple
+from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 from windflow_tpu.basic import (WindFlowError, current_time_usecs,
                                 stable_hash)

@@ -20,7 +20,6 @@ from __future__ import annotations
 from typing import Any, Callable, Optional, Sequence
 
 from windflow_tpu.basic import WindFlowError, current_time_usecs
-from windflow_tpu.batch import WM_NONE
 from windflow_tpu.kafka.client import make_consumer
 from windflow_tpu.kafka.kafka_context import KafkaRuntimeContext
 from windflow_tpu.meta import adapt

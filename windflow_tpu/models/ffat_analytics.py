@@ -9,7 +9,7 @@ and per-key sliding-window aggregation over the on-device FlatFAT pane tree
 
 from __future__ import annotations
 
-from typing import Callable, Iterable, List, Optional, Tuple
+from typing import Callable, Iterable, List, Optional
 
 import windflow_tpu as wf
 

@@ -10,7 +10,7 @@ windows with incremental logic and a keyed filter chained on window results.
 from __future__ import annotations
 
 import dataclasses
-from typing import Callable, Iterable, List, Optional, Tuple
+from typing import Callable, Iterable, List, Optional
 
 import windflow_tpu as wf
 

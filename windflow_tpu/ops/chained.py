@@ -18,7 +18,7 @@ from typing import Callable, List, Tuple
 
 import jax
 
-from windflow_tpu.basic import RoutingMode, WindFlowError
+from windflow_tpu.basic import WindFlowError
 from windflow_tpu.batch import DeviceBatch
 from windflow_tpu.meta import adapt
 from windflow_tpu.ops.base import Operator, Replica

@@ -27,13 +27,12 @@ compiles a single program.
 
 from __future__ import annotations
 
-import functools
 from typing import Any, Callable, Optional
 
 import jax
 import jax.numpy as jnp
 
-from windflow_tpu.basic import RoutingMode, WindFlowError
+from windflow_tpu.basic import RoutingMode
 from windflow_tpu.batch import DeviceBatch
 from windflow_tpu.ops.base import Operator, Replica
 

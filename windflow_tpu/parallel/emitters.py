@@ -27,7 +27,7 @@ Re-design of the reference emitter family (``/root/reference/wf/basic_emitter.hp
 from __future__ import annotations
 
 import math
-from typing import Any, Callable, List, Optional, Sequence, Tuple
+from typing import Any, Callable, Optional, Sequence, Tuple
 
 import numpy as np
 

@@ -32,7 +32,7 @@ same program (the driver validates this path on a virtual CPU mesh).
 from __future__ import annotations
 
 import math
-from typing import Any, Callable, Optional, Sequence, Tuple
+from typing import Callable, Optional, Sequence
 
 import jax
 import jax.numpy as jnp

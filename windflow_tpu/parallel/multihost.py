@@ -32,7 +32,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from windflow_tpu.basic import WindFlowError
 from windflow_tpu.batch import DeviceBatch, HostBatch, host_to_device
-from windflow_tpu.parallel.mesh import DATA_AXIS, KEY_AXIS, batch_sharding
+from windflow_tpu.parallel.mesh import DATA_AXIS, KEY_AXIS
 
 _initialized = False
 

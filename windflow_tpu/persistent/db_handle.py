@@ -13,7 +13,7 @@ from __future__ import annotations
 
 import copy
 import pickle
-from typing import Any, Callable, Iterable, List, Optional
+from typing import Any, Callable, List, Optional
 
 from windflow_tpu.persistent import kv as kvmod
 

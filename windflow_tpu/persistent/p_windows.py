@@ -15,7 +15,6 @@ from __future__ import annotations
 
 from typing import Any, Callable, List, Optional, Tuple
 
-from windflow_tpu.basic import RoutingMode, WindFlowError, WindowRole
 from windflow_tpu.persistent.db_handle import DBHandle
 from windflow_tpu.windows.engine import Archive, WindowSpec
 from windflow_tpu.windows.ops import KeyedWindows, _WindowReplicaBase

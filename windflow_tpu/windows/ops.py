@@ -19,7 +19,7 @@ import dataclasses
 import math
 from typing import Any, Callable, Optional
 
-from windflow_tpu.basic import (ExecutionMode, RoutingMode, WindFlowError,
+from windflow_tpu.basic import (RoutingMode, WindFlowError,
                                 WindowRole, WinType)
 from windflow_tpu.batch import WM_NONE
 from windflow_tpu.ops.base import Operator, Replica
