@@ -1,0 +1,135 @@
+"""Randomized DAG fuzzer (the reference's metamorphic strategy taken past
+its fixed topologies): each seed generates a random DAG — host and TPU
+stages, optional split into two branches, optional second source merged in,
+optional keyed window or reduce tail — and runs it under several random
+parallelism/batch configurations.  Run 0 is the oracle; every other
+configuration must reproduce it.
+
+Comparison semantics follow the operators' contracts, exactly as the
+reference's sweeps do:
+* window tails run in DETERMINISTIC mode with host stages only (CB window
+  CONTENTS are arrival-order-sensitive; multi-replica upstreams in DEFAULT
+  mode legally reorder — the reference's ordered-mode tests exist for the
+  same reason), compared exactly;
+* ReduceTPU tails compare TOTALS only (a per-batch reduce emits one record
+  per distinct key per batch, so the record COUNT legally varies with
+  batching, while sum-combined totals are invariant);
+* plain tails compare (count, total) exactly — tuple multisets are
+  batching/parallelism invariant.
+
+Integer payloads keep every aggregation exact, so equality is bitwise."""
+
+import random
+
+import pytest
+
+import windflow_tpu as wf
+
+N_KEYS = 4
+LENGTH = 320
+
+
+def stream(seed):
+    rnd = random.Random(seed)
+    return [{"key": rnd.randrange(N_KEYS), "value": rnd.randrange(1000),
+             "ts": i * 1000} for i in range(LENGTH)]
+
+
+HOST_STAGES = ["map", "flatmap", "filter"]
+ALL_STAGES = HOST_STAGES + ["map_tpu", "filter_tpu"]
+
+
+def _mk_stage(kind, rnd):
+    par = rnd.randint(1, 3)
+    obs = rnd.randint(1, 32)
+    if kind == "map":
+        return (wf.Map_Builder(lambda t: {**t, "value": t["value"] + 7})
+                .withParallelism(par).withOutputBatchSize(obs).build())
+    if kind == "flatmap":
+        def fm(t, shipper):
+            shipper.push(dict(t))
+            if t["value"] % 3 == 0:
+                shipper.push({**t, "value": 1})
+        return (wf.FlatMap_Builder(fm)
+                .withParallelism(par).withOutputBatchSize(obs).build())
+    if kind == "filter":
+        return (wf.Filter_Builder(lambda t: t["value"] % 5 != 0)
+                .withParallelism(par).withOutputBatchSize(obs).build())
+    if kind == "map_tpu":
+        return wf.MapTPU_Builder(
+            lambda t: {**t, "value": t["value"] * 2}).build()
+    return wf.FilterTPU_Builder(lambda t: (t["value"] & 3) != 3).build()
+
+
+def _run_dag(seed, config_rnd):
+    topo_rnd = random.Random(seed)           # fixed per seed: same topology
+    n_stages = topo_rnd.randint(1, 3)
+    tail = topo_rnd.choice(["none", "window", "reduce"])
+    pool = HOST_STAGES if tail == "window" else ALL_STAGES
+    kinds = [topo_rnd.choice(pool) for _ in range(n_stages)]
+    do_split = topo_rnd.random() < 0.5
+    do_merge = not do_split and topo_rnd.random() < 0.5
+    mode = (wf.ExecutionMode.DETERMINISTIC if tail == "window"
+            else wf.ExecutionMode.DEFAULT)
+
+    accs = {}
+
+    def mk_sink(name):
+        accs[name] = [0, 0]
+
+        def s(r, ctx=None):
+            if r is None:
+                return
+            v = r.value if hasattr(r, "value") else r["value"]
+            accs[name][0] += 1
+            accs[name][1] += int(v)
+        return wf.Sink_Builder(s).withParallelism(
+            config_rnd.randint(1, 2)).build()
+
+    g = wf.PipeGraph("fuzz", mode, wf.TimePolicy.EVENT)
+    mp = g.add_source(
+        wf.Source_Builder(lambda: iter(stream(seed)))
+        .withTimestampExtractor(lambda t: t["ts"])
+        .withOutputBatchSize(config_rnd.randint(1, 64)).build())
+    if do_merge:
+        mp2 = g.add_source(
+            wf.Source_Builder(lambda: iter(stream(seed + 1)))
+            .withTimestampExtractor(lambda t: t["ts"])
+            .withOutputBatchSize(config_rnd.randint(1, 64)).build())
+        mp = mp.merge(mp2)
+
+    for kind in kinds:
+        mp.add(_mk_stage(kind, config_rnd))
+
+    def add_tail(pipe, name):
+        if tail == "window":
+            pipe.add(wf.Keyed_Windows_Builder(
+                lambda items: sum(t["value"] for t in items))
+                .withCBWindows(8, 4).withKeyBy(lambda t: t["key"])
+                .withParallelism(config_rnd.randint(1, 3)).build())
+        elif tail == "reduce":
+            pipe.add(wf.ReduceTPU_Builder(
+                lambda a, b: {"key": a["key"],
+                              "value": a["value"] + b["value"],
+                              "ts": b["ts"]})
+                .withKeyBy(lambda t: t["key"]).build())
+        pipe.add_sink(mk_sink(name))
+
+    if do_split:
+        mp.split(lambda t: t["key"] % 2, 2)
+        add_tail(mp.select(0), "b0")
+        add_tail(mp.select(1), "b1")
+    else:
+        add_tail(mp, "b0")
+    g.run()
+    if tail == "reduce":   # per-batch partials: count legally varies
+        return {k: v[1] for k, v in accs.items()}
+    return {k: tuple(v) for k, v in accs.items()}
+
+
+@pytest.mark.parametrize("seed", [101, 202, 303, 404, 505, 606, 707, 808])
+def test_dag_fuzz(seed):
+    oracle = _run_dag(seed, random.Random(seed * 13 + 1))
+    for run in range(2, 4):
+        got = _run_dag(seed, random.Random(seed * 13 + run))
+        assert got == oracle, (seed, run, got, oracle)

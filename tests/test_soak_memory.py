@@ -1,0 +1,67 @@
+"""Memory-bounded long-run soak: a million tuples through the columnar
+TPU pipeline (ingest → fused map/filter → TB windows → columnar sink) must
+not grow RSS unboundedly — catches leaked device buffers, unbounded pane
+rings, or history accumulating in emitters/collectors (the reference's
+recycling pools bound memory the same way; here XLA buffer lifetime +
+fixed-capacity state carry the guarantee)."""
+
+import os
+import sys
+
+import numpy as np
+import pytest
+
+import windflow_tpu as wf
+from windflow_tpu.io import FrameSource
+
+
+def _rss_kb() -> int:
+    with open("/proc/self/statm") as f:
+        return int(f.read().split()[1]) * (os.sysconf("SC_PAGESIZE") // 1024)
+
+
+@pytest.mark.skipif(sys.platform != "linux", reason="/proc RSS sampling")
+def test_soak_rss_bounded():
+    n_tuples, cap, n_keys = 1_048_576, 32_768, 64
+    rng = np.random.default_rng(5)
+    rec = np.empty(n_tuples, dtype=[("k", "<i8"), ("t", "<i8"),
+                                    ("v", "<f8")])
+    rec["k"] = rng.integers(0, n_keys, n_tuples)
+    rec["t"] = np.arange(n_tuples, dtype=np.int64) * 100   # 100 µs apart
+    rec["v"] = rng.random(n_tuples)
+    blob = rec.tobytes()
+
+    samples = []
+
+    def chunks():
+        for lo in range(0, len(blob), 1 << 20):
+            samples.append(_rss_kb())
+            yield blob[lo:lo + (1 << 20)]
+
+    rows = [0]
+    src = FrameSource(chunks, nv=1, fmt="frames", output_batch_size=cap)
+    m = wf.MapTPU_Builder(
+        lambda t: {"key": t["key"], "v0": t["v0"] * 2.0}).build()
+    f = wf.FilterTPU_Builder(lambda t: t["v0"] >= 0.5).build()
+    w = (wf.Ffat_WindowsTPU_Builder(lambda t: t["v0"], lambda a, b: a + b)
+         .withTBWindows(1_000_000, 250_000)
+         .withKeyBy(lambda t: t["key"]).withMaxKeys(n_keys).build())
+    snk = (wf.Sink_Builder(
+            lambda c: rows.__setitem__(0, rows[0] + len(c))
+            if c is not None else None)
+           .withColumnarSink().build())
+    g = wf.PipeGraph("soak", wf.ExecutionMode.DEFAULT, wf.TimePolicy.EVENT)
+    pipe = g.add_source(src)
+    pipe.add(m)
+    pipe.chain(f)
+    pipe.add(w).add_sink(snk)
+    g.run()
+
+    assert rows[0] > 10_000          # windows really flowed
+    # steady-state RSS growth: compare the 2nd quarter's mean to the last
+    # quarter's (the first quarter includes compilation + arena growth)
+    q = len(samples) // 4
+    early = sum(samples[q:2 * q]) / q
+    late = sum(samples[-q:]) / q
+    growth_mb = (late - early) / 1024
+    assert growth_mb < 256, (early, late, growth_mb)

@@ -127,3 +127,21 @@ def test_tpu_requires_batching_upstream():
     g = wf.PipeGraph("bad2", wf.ExecutionMode.DEFAULT)
     with pytest.raises(wf.WindFlowError):
         g.add_source(src).add(m)
+
+
+def test_reduce_tpu_combiner_structure_contract():
+    """A combiner that drops a record field raises a clear contract error
+    (not an opaque pytree mismatch from inside the scan)."""
+    src = (wf.Source_Builder(
+            lambda: iter({"key": i % 4, "value": i, "extra": 1.0}
+                         for i in range(64)))
+           .withOutputBatchSize(32).build())
+    red = (wf.ReduceTPU_Builder(
+            lambda a, b: {"key": a["key"],
+                          "value": a["value"] + b["value"]})  # drops extra
+           .withKeyBy(lambda t: t["key"]).build())
+    snk = wf.Sink_Builder(lambda r: None).build()
+    g = wf.PipeGraph("contract", wf.ExecutionMode.DEFAULT)
+    g.add_source(src).add(red).add_sink(snk)
+    with pytest.raises(wf.WindFlowError, match="same record structure"):
+        g.run()

@@ -32,7 +32,7 @@ from typing import Any, Callable, Optional
 import jax
 import jax.numpy as jnp
 
-from windflow_tpu.basic import RoutingMode
+from windflow_tpu.basic import RoutingMode, WindFlowError
 from windflow_tpu.batch import DeviceBatch
 from windflow_tpu.ops.base import Operator, Replica
 
@@ -288,7 +288,32 @@ class ReduceTPU(Operator):
             st["Out_of_range_keys_dropped"] = self.num_dropped_tuples()
         return st
 
+    def _check_comb_contract(self, payload) -> None:
+        """The combiner must return the full record structure — one that
+        drops, renames, or restructures fields (e.g. forgets a carried
+        'ts' column) cannot fold records associatively.  Checked here, at
+        the first batch, so every execution path (single-chip sort/scan,
+        mesh dense tables, mesh arbitrary-key all_to_all) gets the clear
+        message instead of an opaque pytree mismatch from inside a scan."""
+        one = jax.tree.map(
+            lambda a: jax.ShapeDtypeStruct(a.shape[1:], a.dtype), payload)
+        out_struct = jax.eval_shape(self.comb, one, one)
+        if jax.tree.structure(out_struct) == jax.tree.structure(one):
+            return
+        if isinstance(one, dict) and isinstance(out_struct, dict) \
+                and sorted(one.keys()) != sorted(out_struct.keys()):
+            want, got = sorted(one.keys()), sorted(out_struct.keys())
+        else:  # same field names but nested shape differs: show treedefs
+            want = jax.tree.structure(one)
+            got = jax.tree.structure(out_struct)
+        raise WindFlowError(
+            "ReduceTPU combiner must return the same record structure as "
+            f"its inputs (records have {want}, combiner returned {got}); "
+            "carry every field through the combine")
+
     def _step(self, batch: DeviceBatch) -> DeviceBatch:
+        if not self._jit_steps:
+            self._check_comb_contract(batch.payload)
         if self.mesh is not None:
             # Sharded variant: dense per-chip partials combined over ICI;
             # output is a capacity-max_keys batch of distinct-key records.
