@@ -14,6 +14,10 @@ reference's sweeps do:
 * ReduceTPU tails compare TOTALS only (a per-batch reduce emits one record
   per distinct key per batch, so the record COUNT legally varies with
   batching, while sum-combined totals are invariant);
+* time-based FfatWindowsTPU tails run in DEFAULT mode with the full stage
+  pool and compare (count, total) EXACTLY — TB window assignment is
+  order-insensitive, so the min-folded watermark machinery must absorb any
+  legal cross-replica reordering without a single late drop;
 * plain tails compare (count, total) exactly — tuple multisets are
   batching/parallelism invariant.
 
@@ -64,7 +68,12 @@ def _mk_stage(kind, rnd):
 def _run_dag(seed, config_rnd):
     topo_rnd = random.Random(seed)           # fixed per seed: same topology
     n_stages = topo_rnd.randint(1, 3)
-    tail = topo_rnd.choice(["none", "window", "reduce"])
+    # tb_window: time-based FfatWindowsTPU tail in DEFAULT mode — TB
+    # assignment is order-insensitive, so even with multi-replica host
+    # upstreams legally reordering tuples, the min-folded watermark must
+    # keep results EXACT (the collector + staging-frontier machinery
+    # under random topologies)
+    tail = topo_rnd.choice(["none", "window", "reduce", "tb_window"])
     pool = HOST_STAGES if tail == "window" else ALL_STAGES
     kinds = [topo_rnd.choice(pool) for _ in range(n_stages)]
     do_split = topo_rnd.random() < 0.5
@@ -113,6 +122,12 @@ def _run_dag(seed, config_rnd):
                               "value": a["value"] + b["value"],
                               "ts": b["ts"]})
                 .withKeyBy(lambda t: t["key"]).build())
+        elif tail == "tb_window":
+            pipe.add(wf.Ffat_WindowsTPU_Builder(
+                lambda t: t["value"], lambda a, b: a + b)
+                .withTBWindows(16_000, 8_000)
+                .withKeyBy(lambda t: t["key"])
+                .withMaxKeys(N_KEYS).build())
         pipe.add_sink(mk_sink(name))
 
     if do_split:
@@ -127,7 +142,8 @@ def _run_dag(seed, config_rnd):
     return {k: tuple(v) for k, v in accs.items()}
 
 
-@pytest.mark.parametrize("seed", [101, 202, 303, 404, 505, 606, 707, 808])
+@pytest.mark.parametrize("seed", [101, 202, 303, 404, 505, 606,
+                                  707, 808, 909, 1212])
 def test_dag_fuzz(seed):
     oracle = _run_dag(seed, random.Random(seed * 13 + 1))
     for run in range(2, 4):
