@@ -108,23 +108,36 @@ def run_bench(platform: str, cfg: dict, jax) -> dict:
     state = make_ffat_state(jnp.zeros((), jnp.float32), K, R)
     state = jax.device_put(state, dev)
 
-    for i in range(cfg["warmup"]):
-        p, t, v = batches[i % len(batches)]
-        state, out, fired, _ = step(state, p, t, v)
-    jax.block_until_ready(state)
-
-    # best of 3 timing windows: the measurement rides a remote-device link
-    # whose scheduling jitter can halve any single window's number
-    tuples_per_sec = 0.0
-    for _ in range(3):
-        t0 = time.perf_counter()
-        for i in range(cfg["steps"]):
+    def time_steps(stp, st):
+        """Warm up, then best of 3 timing windows (the measurement rides a
+        remote-device link whose scheduling jitter can halve any single
+        window's number).  One methodology for every kernel variant so the
+        numbers stay comparable."""
+        for i in range(cfg["warmup"]):
             p, t, v = batches[i % len(batches)]
-            state, out, fired, _ = step(state, p, t, v)
-        jax.block_until_ready(state)
-        elapsed = time.perf_counter() - t0
-        tuples_per_sec = max(tuples_per_sec,
-                             cfg["steps"] * CAP / elapsed)
+            st, out, fired, _ = stp(st, p, t, v)
+        jax.block_until_ready(st)
+        best = 0.0
+        for _ in range(3):
+            t0 = time.perf_counter()
+            for i in range(cfg["steps"]):
+                p, t, v = batches[i % len(batches)]
+                st, out, fired, _ = stp(st, p, t, v)
+            jax.block_until_ready(st)
+            best = max(best, cfg["steps"] * CAP / (time.perf_counter() - t0))
+        return best, st
+
+    tuples_per_sec, state = time_steps(step, state)
+
+    # the same workload with the combiner DECLARED sum-like (flagless
+    # sliding fold, windows/ffat_kernels._sliding_reduce_plain): reported
+    # alongside — `value` stays the default-path number so round-over-round
+    # vs_baseline compares like with like
+    step_sum = jax.jit(make_ffat_step(CAP, K, Pn, R, D, lift, comb, key_fn,
+                                      sum_like=True), donate_argnums=(0,))
+    state_sum = jax.device_put(
+        make_ffat_state(jnp.zeros((), jnp.float32), K, R), dev)
+    sum_tps, _ = time_steps(step_sum, state_sum)
 
     # p99 per-batch latency: timed with a sync per step (dispatch pipeline
     # drained), so it is an upper bound on steady-state window latency.
@@ -155,6 +168,7 @@ def run_bench(platform: str, cfg: dict, jax) -> dict:
         }
     return {
         "value": round(tuples_per_sec, 1),
+        "sum_decl_value": round(sum_tps, 1),
         "p99_batch_latency_ms": round(p99_ms, 3),
         "roofline": roofline,
         "config": {"cap": CAP, "keys": K, "win": cfg["win"],
@@ -549,6 +563,7 @@ def main() -> None:
         result["vs_baseline"] = round(result["value"] / base["value"], 4)
         result["prev_value"] = base["value"]
     runs.append({"value": result["value"],
+                 "sum_decl_value": result.get("sum_decl_value"),
                  "p99_batch_latency_ms": result["p99_batch_latency_ms"],
                  "e2e": result.get("e2e"),
                  "ysb": result.get("ysb"),

@@ -170,3 +170,33 @@ def test_scaling_harness_loop_body():
 def test_scaling_harness_refuses_virtual_mesh():
     out = bench.run_bench_scaling(jax)
     assert "skipped" in out and "virtual" in out["skipped"]
+
+
+def test_sharded_ffat_sum_like_matches_default():
+    """The flagless declared-sum fold on the mesh matches the default
+    flag-aware fold bitwise on integer lifts."""
+    cap, K, Pn, R, D = 64, 8, 4, 4, 1
+    mesh = M.make_mesh(8, data=2)
+    payload = {"k": jnp.arange(cap, dtype=jnp.int32) % K,
+               "v": (jnp.arange(cap, dtype=jnp.int64) * 3) % 101}
+    ts = jnp.arange(cap, dtype=jnp.int64)
+    valid = jnp.ones(cap, bool)
+    sh = M.batch_sharding(mesh)
+    outs = {}
+    for sum_like in (False, True):
+        step = M.make_sharded_ffat_step(
+            mesh, cap, K, Pn, R, D, lambda x: x["v"], lambda a, b: a + b,
+            lambda x: x["k"], sum_like=sum_like)
+        st = M.make_sharded_ffat_state(jnp.zeros((), jnp.int64), K, R, mesh)
+        got = []
+        for it in range(5):     # enough batches per key to fire windows
+            p5 = {"k": jax.device_put(payload["k"], sh),
+                  "v": jax.device_put((payload["v"] + it) % 97, sh)}
+            st, out, fired, _ = step(st, p5, jax.device_put(ts, sh),
+                                     jax.device_put(valid, sh))
+            f = np.asarray(fired)
+            got.extend(zip(np.asarray(out["key"])[f].tolist(),
+                           np.asarray(out["wid"])[f].tolist(),
+                           np.asarray(out["value"])[f].tolist()))
+        outs[sum_like] = sorted(got)
+    assert outs[False] == outs[True] and outs[False]

@@ -681,3 +681,24 @@ def test_ffat_tpu_tb_auto_ring_error_policy_grows_not_raises():
     g.add_source(src).add(op).add_sink(snk)
     g.run()   # must not raise: growth, not error
     assert op.NP == 4 + 1 + batch + 2, op.NP
+
+
+def test_ffat_tpu_cb_sum_combiner_fast_path():
+    """withSumCombiner (flagless CB sliding fold) is bitwise-identical to
+    the default flag-aware fold on integer sums, single-chip and mesh."""
+    exp = oracle_cb(WIN, SLIDE)
+    for batch in (32, 64):
+        acc = WinAcc()
+        src = (wf.Source_Builder(lambda: iter(stream()))
+               .withOutputBatchSize(batch).build())
+        op = (wf.Ffat_WindowsTPU_Builder(
+                lambda t: t["value"], lambda a, b: a + b)
+              .withCBWindows(WIN, SLIDE)
+              .withKeyBy(lambda t: t["key"])
+              .withMaxKeys(N_KEYS).withSumCombiner().build())
+        snk = wf.Sink_Builder(
+            lambda r: acc(_as_result(r)) if r is not None else None).build()
+        g = wf.PipeGraph("ffat_sum", wf.ExecutionMode.DEFAULT)
+        g.add_source(src).add(op).add_sink(snk)
+        g.run()
+        assert (acc.count, acc.total) == exp, batch

@@ -544,10 +544,19 @@ class Ffat_WindowsTPU_Builder(_WindowBuilderBase):
         self._max_keys = 1
         self._pane_capacity = None
         self._overflow_policy = "drop"
+        self._sum_like = False
 
     def withMaxKeys(self, n: int):
         """Size of the dense device key space [0, n)."""
         self._max_keys = int(n)
+        return self
+
+    def withSumCombiner(self):
+        """Declare the combiner zero-absorbing on every leaf
+        (``comb(x, 0) == x`` — sum and friends): count-based windows then
+        run a flagless sliding fold with half the operand traffic.  Same
+        declaration knob as ReduceTPU_Builder.withSumCombiner."""
+        self._sum_like = True
         return self
 
     def withPaneCapacity(self, n: int):
@@ -573,4 +582,5 @@ class Ffat_WindowsTPU_Builder(_WindowBuilderBase):
             parallelism=self._parallelism,
             key_extractor=self._key_extractor,
             pane_capacity=self._pane_capacity,
-            overflow_policy=self._overflow_policy)
+            overflow_policy=self._overflow_policy,
+            sum_like=self._sum_like)

@@ -300,7 +300,8 @@ def _ffat_shard_layout(mesh: Mesh, capacity: int, K: int):
 
 def make_sharded_ffat_step(mesh: Mesh, capacity: int, K: int, Pn: int, R: int,
                            D: int, lift: Callable, comb: Callable,
-                           key_fn: Optional[Callable]):
+                           key_fn: Optional[Callable],
+                           sum_like: bool = False):
     """Compile one FFAT window step sharded over the mesh.
 
     State tables are split along ``key`` (chip *i* owns keys
@@ -310,7 +311,8 @@ def make_sharded_ffat_step(mesh: Mesh, capacity: int, K: int, Pn: int, R: int,
     key-sharded, one row block per chip."""
     K_local, key_base_fn, gather = _ffat_shard_layout(mesh, capacity, K)
     step_local = make_ffat_step(capacity, K_local, Pn, R, D, lift, comb,
-                                key_fn, key_base_fn=key_base_fn)
+                                key_fn, key_base_fn=key_base_fn,
+                                sum_like=sum_like)
 
     def local(state, payload, ts, valid):
         payload, ts, valid = gather(payload, ts, valid)

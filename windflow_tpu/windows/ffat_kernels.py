@@ -52,20 +52,23 @@ def _b(mask, ref):
     return mask.reshape(mask.shape + (1,) * (ref.ndim - mask.ndim))
 
 
+def _shift_leaf(a, k: int, axis: int):
+    """Shift one leaf along ``axis`` by ``k`` toward higher indices,
+    zero/False-filling the vacated slots."""
+    pad = [(0, 0)] * a.ndim
+    pad[axis] = (k, 0)
+    s = [slice(None)] * a.ndim
+    s[axis] = slice(0, a.shape[axis])
+    return jnp.pad(a, pad)[tuple(s)]
+
+
 def _shift_right(flags, values, k: int, axis: int):
     """Shift along ``axis`` by ``k`` positions (toward higher indices),
-    filling vacated slots with invalid entries."""
+    filling vacated slots with invalid entries (bool pads False)."""
     if k == 0:
         return flags, values
-
-    def shift_leaf(a):
-        pad = [(0, 0)] * a.ndim
-        pad[axis] = (k, 0)
-        s = [slice(None)] * a.ndim
-        s[axis] = slice(0, a.shape[axis])
-        return jnp.pad(a, pad)[tuple(s)]  # bool pads False = invalid fill
-
-    return shift_leaf(flags), jax.tree.map(shift_leaf, values)
+    return (_shift_leaf(flags, k, axis),
+            jax.tree.map(lambda a: _shift_leaf(a, k, axis), values))
 
 
 def _flag_comb(comb):
@@ -112,10 +115,42 @@ def _sliding_reduce(comb, flags, values, R: int, axis: int):
     return res
 
 
+def _sliding_reduce_plain(comb, flags, values, R: int, axis: int):
+    """Flagless dilated sliding fold for ZERO-ABSORBING combiners
+    (declared via withSumCombiner): invalid entries are zero-filled once,
+    then the log2(R) doubling runs on values alone — half the operand
+    traffic of the flag-aware fold.  Only valid when ``comb(x, 0) == x``
+    on every leaf (sum and friends)."""
+    zeroed = jax.tree.map(lambda a: jnp.where(_b(flags, a), a, 0), values)
+
+    # zero-fill shift: the vacated slots hold the combiner's identity
+    def zshift(v, k):
+        if k == 0:
+            return v
+        return jax.tree.map(lambda a: _shift_leaf(a, k, axis), v)
+
+    pow2 = [zeroed]
+    width = 1
+    while width * 2 <= R:
+        v = pow2[-1]
+        pow2.append(comb(zshift(v, width), v))
+        width *= 2
+    res = None
+    offset = 0
+    for j in range(len(pow2) - 1, -1, -1):
+        w = 1 << j
+        if R & w:
+            v = zshift(pow2[j], offset)
+            res = v if res is None else comb(v, res)
+            offset += w
+    return res
+
+
 def make_ffat_step(capacity: int, K: int, P: int, R: int, D: int,
                    lift: Callable, comb: Callable,
                    key_fn: Optional[Callable],
-                   key_base_fn: Optional[Callable[[], Any]] = None):
+                   key_base_fn: Optional[Callable[[], Any]] = None,
+                   sum_like: bool = False):
     """Build the (un-jitted) FFAT per-batch program.
 
     Pure-function form of the operator step so the multi-chip layer
@@ -219,7 +254,13 @@ def make_ffat_step(capacity: int, K: int, P: int, R: int, D: int,
         # [K, R-1+NP1] pane sequence) stays dense; window values are
         # gathered only at the MAXO compacted output slots.
         done = state["pane_base"] + m_k
-        _, swin = _sliding_reduce(comb, full_valid, full, R, axis=1)
+        if sum_like:
+            # declared zero-absorbing: the flag lane of the fold is pure
+            # overhead here (the CB step never reads the flag output —
+            # fired windows always contain data)
+            swin = _sliding_reduce_plain(comb, full_valid, full, R, axis=1)
+        else:
+            _, swin = _sliding_reduce(comb, full_valid, full, R, axis=1)
 
         n_fired = jnp.maximum(
             jnp.int64(0), (done - state["win_next"]) // D + 1)

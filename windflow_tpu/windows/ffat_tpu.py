@@ -98,7 +98,8 @@ class FfatWindowsTPU(Operator):
                  parallelism: int = 1,
                  key_extractor: Optional[Callable] = None,
                  pane_capacity: Optional[int] = None,
-                 overflow_policy: str = "drop") -> None:
+                 overflow_policy: str = "drop",
+                 sum_like: bool = False) -> None:
         routing = (RoutingMode.KEYBY if key_extractor is not None
                    else RoutingMode.FORWARD)
         super().__init__(name, parallelism, routing=routing, is_tpu=True,
@@ -147,6 +148,9 @@ class FfatWindowsTPU(Operator):
         #: "error" raises at the next host checkpoint.  The reference never
         #: fires a wrong window (its FlatFAT grows instead).
         self.overflow_policy = overflow_policy
+        #: declared zero-absorbing combiner (withSumCombiner): the CB
+        #: sliding fold drops its flag lane — half the operand traffic
+        self.sum_like = sum_like
         self._overflow_steps = 0
         self._auto_np = False          # NP chosen by the span estimator
         self._np_ceil = None
@@ -198,7 +202,8 @@ class FfatWindowsTPU(Operator):
                     drop_tainted=self.overflow_policy == "drop")
             return make_sharded_ffat_step(
                 self.mesh, capacity, self.max_keys, self.P, self.R, self.D,
-                self.lift, self.comb, self.key_extractor)
+                self.lift, self.comb, self.key_extractor,
+                sum_like=self.sum_like)
         if self.is_tb:
             step = make_ffat_tb_step(capacity, self.max_keys, self.P,
                                      self.R, self.D, self.NP,
@@ -209,7 +214,8 @@ class FfatWindowsTPU(Operator):
         else:
             step = make_ffat_step(capacity, self.max_keys, self.P, self.R,
                                   self.D, self.lift, self.comb,
-                                  self.key_extractor)
+                                  self.key_extractor,
+                                  sum_like=self.sum_like)
         return jax.jit(step, donate_argnums=(0,))
 
     # -- operator plumbing ---------------------------------------------------
