@@ -425,3 +425,30 @@ def test_steady_state_watermark_advances_when_caught_up():
         prod.produce("live", {"v": ts}, timestamp_usec=ts)
         rep.tick(10)                 # poll drains the partition each time
         assert rep.current_wm == ts  # watermark tracks the live partition
+
+
+def test_assignment_policy_clause():
+    """withAssignmentPolicy validates its argument and reaches the
+    consumer; the in-memory broker serves all strategies with its
+    cooperative assignment."""
+    broker = InMemoryBroker()
+    fill_topic(broker, "ap", 20, partitions=2)
+    got = []
+    src = (KafkaSource_Builder(
+            lambda msg, shipper: shipper.push(msg.value)
+            if msg is not None else False)
+           .withBrokers(broker).withTopics("ap").withGroupID("apg")
+           .withIdleness(1000).withAssignmentPolicy("roundrobin")
+           .withOutputBatchSize(8).build())
+    snk = wf.Sink_Builder(lambda t: got.append(t["value"])
+                          if t is not None else None).build()
+    g = wf.PipeGraph("ap", wf.ExecutionMode.DEFAULT)
+    g.add_source(src).add_sink(snk)
+    g.run()
+    assert sorted(got) == list(range(20))
+    assert src.replicas[0]._consumer.assignment_policy == "roundrobin"
+
+    with pytest.raises(wf.WindFlowError, match="assignment policy"):
+        (KafkaSource_Builder(lambda m, s: None)
+         .withBrokers(broker).withTopics("ap")
+         .withAssignmentPolicy("mystery").build())

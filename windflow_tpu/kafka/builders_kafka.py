@@ -24,6 +24,7 @@ class KafkaSource_Builder(_BuilderBase):
         self._group_id = "windflow"
         self._offsets: Optional[list] = None
         self._idle_usec = 100_000
+        self._assignment_policy = "cooperative-sticky"
 
     def withBrokers(self, brokers):
         """A broker address string ('host:port') or an InMemoryBroker."""
@@ -48,6 +49,15 @@ class KafkaSource_Builder(_BuilderBase):
         self._idle_usec = int(idle_usec)
         return self
 
+    def withAssignmentPolicy(self, policy: str):
+        """Partition assignment strategy (reference withAssignmentPolicy,
+        ``builders_kafka.hpp``): one of "cooperative-sticky" (default),
+        "roundrobin", "range" — passed to librdkafka by the real-client
+        adapter; the in-memory broker's single cooperative round-robin
+        assignment serves all three."""
+        self._assignment_policy = policy
+        return self
+
     def withKeyBy(self, *_):
         raise WindFlowError("a Kafka_Source has no input to key by")
 
@@ -62,7 +72,9 @@ class KafkaSource_Builder(_BuilderBase):
             raise WindFlowError("Kafka_Source needs withBrokers(...)")
         return KafkaSource(self._deser_fn, self._brokers, self._topics,
                            group_id=self._group_id, offsets=self._offsets,
-                           idle_time_usec=self._idle_usec, name=self._name,
+                           idle_time_usec=self._idle_usec,
+                           assignment_policy=self._assignment_policy,
+                           name=self._name,
                            parallelism=self._parallelism,
                            output_batch_size=self._output_batch_size)
 

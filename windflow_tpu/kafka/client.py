@@ -38,7 +38,16 @@ class KafkaMessage:
     timestamp_usec: int
 
 
+#: partition assignment strategies the client layer understands; the
+#: in-memory broker implements one cooperative round-robin assignment (the
+#: names map onto it), the confluent adapter passes the choice to librdkafka
+ASSIGNMENT_POLICIES = ("cooperative-sticky", "roundrobin", "range")
+
+
 class ConsumerClient:
+    #: selected partition assignment strategy (withAssignmentPolicy)
+    assignment_policy = "cooperative-sticky"
+
     def idle_partitions(self):
         """Partitions confirmed drained/idle, or None when the client
         cannot know (the source then uses wall-clock idleness)."""
@@ -297,20 +306,21 @@ class ConfluentConsumer(ConsumerClient):
     """Thin adapter over confluent_kafka.Consumer (librdkafka underneath —
     the same library the reference binds)."""
 
-    def __init__(self, brokers: str) -> None:
+    def __init__(self, brokers: str,
+                 assignment_policy: str = "cooperative-sticky") -> None:
         self._ck = _require_confluent()
         self._brokers = brokers
+        self.assignment_policy = assignment_policy
         self._consumer = None
 
     def subscribe(self, topics, group_id, offsets=None):
+        cooperative = self.assignment_policy == "cooperative-sticky"
         conf = {"bootstrap.servers": self._brokers,
                 "group.id": group_id,
                 "auto.offset.reset": "earliest",
-                "partition.assignment.strategy": "cooperative-sticky"}
+                "partition.assignment.strategy": self.assignment_policy}
         self._consumer = self._ck.Consumer(conf)
         if offsets:
-            tp = self._ck.TopicPartition
-
             def on_assign(consumer, partitions):
                 for part in partitions:
                     try:
@@ -319,7 +329,13 @@ class ConfluentConsumer(ConsumerClient):
                         continue
                     if off is not None and off > -1:
                         part.offset = off
-                consumer.incremental_assign(partitions)
+                # librdkafka requires incremental_assign under the
+                # COOPERATIVE protocol and plain assign under EAGER
+                # strategies (roundrobin/range)
+                if cooperative:
+                    consumer.incremental_assign(partitions)
+                else:
+                    consumer.assign(partitions)
 
             self._consumer.subscribe(list(topics), on_assign=on_assign)
         else:
@@ -380,10 +396,16 @@ class ConfluentProducer(ProducerClient):
         self.flush()
 
 
-def make_consumer(brokers) -> ConsumerClient:
+def make_consumer(brokers,
+                  assignment_policy: str = "cooperative-sticky") \
+        -> ConsumerClient:
     if isinstance(brokers, InMemoryBroker):
-        return brokers.consumer()
-    return ConfluentConsumer(str(brokers))
+        c = brokers.consumer()
+        # the in-memory broker's single cooperative round-robin assignment
+        # serves every strategy; record the choice for introspection
+        c.assignment_policy = assignment_policy
+        return c
+    return ConfluentConsumer(str(brokers), assignment_policy)
 
 
 def make_producer(brokers) -> ProducerClient:

@@ -20,7 +20,8 @@ from __future__ import annotations
 from typing import Any, Callable, Optional, Sequence
 
 from windflow_tpu.basic import WindFlowError, current_time_usecs
-from windflow_tpu.kafka.client import make_consumer
+from windflow_tpu.kafka.client import (ASSIGNMENT_POLICIES,
+                                       make_consumer)
 from windflow_tpu.kafka.kafka_context import KafkaRuntimeContext
 from windflow_tpu.meta import adapt
 from windflow_tpu.ops.source import Source, SourceReplica
@@ -145,7 +146,8 @@ class KafkaSourceReplica(SourceReplica):
         return lo
 
     def start(self) -> None:
-        self._consumer = make_consumer(self.op.brokers)
+        self._consumer = make_consumer(self.op.brokers,
+                                       self.op.assignment_policy)
         self._consumer.subscribe(self.op.topics, self.op.group_id,
                                  self.op.offsets)
         # riched deserializers see a KafkaRuntimeContext (reference passes
@@ -210,10 +212,15 @@ class KafkaSource(Source):
                  group_id: str = "windflow",
                  offsets: Optional[Sequence[int]] = None,
                  idle_time_usec: int = 100_000,
+                 assignment_policy: str = "cooperative-sticky",
                  name: str = "kafka_source", parallelism: int = 1,
                  output_batch_size: int = 0) -> None:
         if not topics:
             raise WindFlowError("Kafka_Source needs at least one topic")
+        if assignment_policy not in ASSIGNMENT_POLICIES:
+            raise WindFlowError(
+                f"unknown assignment policy '{assignment_policy}' "
+                f"(one of {ASSIGNMENT_POLICIES})")
         # bypass Source.__init__'s generator plumbing; Operator init only
         super().__init__(gen_fn=lambda: iter(()), name=name,
                          parallelism=parallelism,
@@ -224,3 +231,4 @@ class KafkaSource(Source):
         self.group_id = group_id
         self.offsets = list(offsets) if offsets is not None else None
         self.idle_time_usec = idle_time_usec
+        self.assignment_policy = assignment_policy
