@@ -242,3 +242,22 @@ def test_closing_function_on_chained_stages():
     g.run()
     assert closed == ["m1", "m2"]
     assert acc.total == sum((i + 1) * 2 for i in range(20))
+
+
+def test_start_wait_end_idiom():
+    """The reference idiom g.start(); g.wait_end() works and matches
+    run(); wait_end before start raises."""
+    acc = Acc()
+    src = (wf.Source_Builder(lambda: iter({"value": i} for i in range(40)))
+           .withOutputBatchSize(8).build())
+    snk = wf.Sink_Builder(acc).build()
+    g = wf.PipeGraph("startwait", wf.ExecutionMode.DEFAULT)
+    g.add_source(src).add_sink(snk)
+    g.start()
+    g.wait_end()
+    assert acc.count == 40
+    assert g.getNumDroppedTuples() == 0
+
+    g2 = wf.PipeGraph("nostart", wf.ExecutionMode.DEFAULT)
+    with pytest.raises(wf.WindFlowError):
+        g2.wait_end()

@@ -180,10 +180,19 @@ class PipeGraph:
 
     # -- execution -----------------------------------------------------------
     def run(self) -> "PipeGraph":
-        """Build, then drive the whole graph to completion (the reference's
-        ``run()`` + ``wait_end()`` pair collapsed into one call; a streaming
-        deployment would call :meth:`step` from its own loop)."""
+        """Build, then drive the whole graph to completion — the
+        reference's ``run()`` (``start()`` + ``wait_end()``,
+        ``pipegraph.hpp:614-697``); both halves are also public so the
+        reference idiom ``g.start(); ...; g.wait_end()`` transliterates."""
         self.start()
+        return self.wait_end()
+
+    def wait_end(self) -> "PipeGraph":
+        """Drive a started graph to completion (reference
+        ``PipeGraph::wait_end``, ``pipegraph.hpp:703-768``); a streaming
+        deployment would call :meth:`step` from its own loop instead."""
+        if not self._started:
+            raise WindFlowError("wait_end before start")
         while not self.is_done():
             if not self.step():
                 raise WindFlowError(
@@ -282,6 +291,11 @@ class PipeGraph:
         ``pipegraph.hpp:560-576``)."""
         from windflow_tpu.monitoring.diagram import to_dot
         return to_dot(self)
+
+    def getNumDroppedTuples(self) -> int:
+        """Reference-spelled alias of :meth:`get_num_dropped_tuples`
+        (``pipegraph.hpp:786-789``)."""
+        return self.get_num_dropped_tuples()
 
     def stats(self) -> dict:
         """Stats report; schema follows the reference's dashboard JSON

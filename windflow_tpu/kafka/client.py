@@ -321,8 +321,17 @@ class ConfluentConsumer(ConsumerClient):
                 "partition.assignment.strategy": self.assignment_policy}
         self._consumer = self._ck.Consumer(conf)
         if offsets:
+            applied = set()   # (topic, partition)s already given the
+                              # user's START offset — an EAGER rebalance
+                              # re-delivers the full assignment, and
+                              # re-seeking retained partitions would
+                              # rewind them mid-stream
             def on_assign(consumer, partitions):
                 for part in partitions:
+                    tp = (part.topic, part.partition)
+                    if tp in applied:
+                        continue   # retained/regained: resume committed
+                    applied.add(tp)
                     try:
                         off = offsets[topics.index(part.topic)]
                     except (ValueError, IndexError):
