@@ -142,10 +142,17 @@ def _run_dag(seed, config_rnd):
     return {k: tuple(v) for k, v in accs.items()}
 
 
+# seeds 2009/2011/2018/2031 are ordering-tie regressions: DETERMINISTIC
+# window tails fed by multi-replica flatmap stages duplicate timestamps,
+# and before origin-id tie-breaking (HostBatch.ids) the tuples' window
+# assignment depended on which replica relayed them — equal counts,
+# different totals across configurations
 @pytest.mark.parametrize("seed", [101, 202, 303, 404, 505, 606,
-                                  707, 808, 909, 1212])
+                                  707, 808, 909, 1212,
+                                  2009, 2011, 2018, 2031])
 def test_dag_fuzz(seed):
     oracle = _run_dag(seed, random.Random(seed * 13 + 1))
     for run in range(2, 4):
         got = _run_dag(seed, random.Random(seed * 13 + run))
         assert got == oracle, (seed, run, got, oracle)
+

@@ -372,7 +372,7 @@ def test_heard_then_idle_partition_stops_gating():
             return None   # unknown: exercises the wall-clock fallback
 
     class StubEmitter:
-        def emit(self, item, ts, wm, shared=False):
+        def emit(self, item, ts, wm, shared=False, tid=None):
             pass
 
     op = KafkaSource(lambda m, s: None, object(), ["t"])
@@ -416,7 +416,7 @@ def test_steady_state_watermark_advances_when_caught_up():
                             wf.TimePolicy.EVENT)[0]
 
     class NullEmitter:
-        def emit(self, item, ts, wm, shared=False):
+        def emit(self, item, ts, wm, shared=False, tid=None):
             pass
 
     rep.emitter = NullEmitter()

@@ -67,6 +67,13 @@ class HostBatch:
     items: list
     tss: list
     watermark: int = WM_NONE
+    #: optional per-item ORIGIN ids (tuples: source ordinal, replica, seq,
+    #: expansion...) — assigned at sources and relayed by one-to-one /
+    #: one-to-many host stages so DETERMINISTIC ordering can break
+    #: timestamp ties config-independently (reference Single_t id field,
+    #: ``single_t.hpp:50-183``); None when unavailable (aggregates emit
+    #: fresh streams, device edges strip them — TPU ops are DEFAULT-only)
+    ids: list = None
     #: True when this batch object is multicast to several inboxes
     #: (BROADCAST edges); in-place-capable consumers must copy before
     #: mutating (reference ``copyOnWrite`` + ``delete_counter`` multicast,
@@ -75,6 +82,11 @@ class HostBatch:
 
     def __len__(self) -> int:
         return len(self.items)
+
+    def ids_or_nones(self):
+        """Per-item origin ids, None-filled when the batch carries none."""
+        return self.ids if self.ids is not None \
+            else (None,) * len(self.items)
 
 
 class DeviceBatch:

@@ -121,6 +121,7 @@ class PipeGraph:
             for op in mp.operators:
                 if id(op) not in seen:
                     seen.add(id(op))
+                    op.ordinal = len(self._operators)  # stable topo index
                     self._operators.append(op)
                     op.mesh = self.config.mesh
                     op.build_replicas(self.mode, self.time_policy)

@@ -312,6 +312,7 @@ class ConfluentConsumer(ConsumerClient):
         self._brokers = brokers
         self.assignment_policy = assignment_policy
         self._consumer = None
+        self._consumed_tps = set()   # partitions that delivered data
 
     def subscribe(self, topics, group_id, offsets=None):
         cooperative = self.assignment_policy == "cooperative-sticky"
@@ -321,17 +322,18 @@ class ConfluentConsumer(ConsumerClient):
                 "partition.assignment.strategy": self.assignment_policy}
         self._consumer = self._ck.Consumer(conf)
         if offsets:
-            applied = set()   # (topic, partition)s already given the
-                              # user's START offset — an EAGER rebalance
-                              # re-delivers the full assignment, and
-                              # re-seeking retained partitions would
-                              # rewind them mid-stream
             def on_assign(consumer, partitions):
                 for part in partitions:
                     tp = (part.topic, part.partition)
-                    if tp in applied:
-                        continue   # retained/regained: resume committed
-                    applied.add(tp)
+                    # apply the user's START offset only until the
+                    # partition has actually DELIVERED data (tracked in
+                    # poll): an EAGER rebalance re-delivers the full
+                    # assignment, and re-seeking a mid-stream partition
+                    # would rewind it into duplicates — but a partition
+                    # revoked before consuming anything must still get
+                    # its start offset, not auto.offset.reset
+                    if tp in self._consumed_tps:
+                        continue
                     try:
                         off = offsets[topics.index(part.topic)]
                     except (ValueError, IndexError):
@@ -359,6 +361,7 @@ class ConfluentConsumer(ConsumerClient):
             if msg.error():
                 continue
             ts_type, ts_ms = msg.timestamp()
+            self._consumed_tps.add((msg.topic(), msg.partition()))
             out.append(KafkaMessage(
                 topic=msg.topic(), partition=msg.partition(),
                 offset=msg.offset(), key=msg.key(), value=msg.value(),

@@ -73,7 +73,9 @@ class KafkaShipper:
         else:
             r._advance_wm(r._last_ts)
         r.stats.outputs_sent += 1
-        r.emitter.emit(item, int(ts), r.current_wm)
+        r._tid_seq += 1
+        r.emitter.emit(item, int(ts), r.current_wm,
+                       tid=(r.op.ordinal, r.index, r._tid_seq))
         r._count_toward_punctuation(1)
 
 

@@ -59,6 +59,11 @@ class Replica:
                                  is_tpu=op.is_tpu)
         self.mode = ExecutionMode.DEFAULT
         self.time_policy = TimePolicy.INGRESS
+        #: origin id of the input currently being processed (HostBatch.ids);
+        #: one-to-one/one-to-many relays pass it to their emits so
+        #: DETERMINISTIC ordering can break timestamp ties
+        #: config-independently (reference Single_t id)
+        self.cur_tid = None
 
     # -- wiring -------------------------------------------------------------
     def add_channel(self) -> int:
@@ -139,11 +144,14 @@ class Replica:
             # an in-place-capable operator must mutate a private copy
             # (reference ``copyOnWrite``, ``map.hpp:57-215``).
             cow = msg.shared and self.copy_on_shared
-            for item, ts in zip(msg.items, msg.tss):
+            for item, ts, tid in zip(msg.items, msg.tss,
+                                     msg.ids_or_nones()):
                 if cow:
                     item = copy.deepcopy(item)
+                self.cur_tid = tid
                 self.context._set_context(ts, msg.watermark)
                 self.process_single(item, ts, msg.watermark)
+            self.cur_tid = None
         self._maybe_hook_wm()
         self.stats.end_sample()
 
@@ -183,6 +191,9 @@ class Operator:
     replica_class = Replica
     #: terminal operators (sinks) have no emitter / downstream consumer
     is_terminal = False
+    #: stable topological index assigned by PipeGraph._build; origin-id
+    #: prefix for source stamping
+    ordinal = 0
     #: per-replica shutdown callback, set by withClosingFunction (reference
     #: ``closing_func``: every operator builder accepts one); invoked at
     #: replica termination with the replica's RuntimeContext (arity 1) or

@@ -62,10 +62,18 @@ class ChainedHostReplica(Replica):
 
     def __init__(self, op: "ChainedHost", index: int) -> None:
         super().__init__(op, index)
+        self._exp = 0
 
         def tail(item, ts, wm, ctx):
             self.stats.outputs_sent += 1
-            self.emitter.emit(item, ts, wm)
+            # append the per-input output index: a fused flatmap emits
+            # several outputs per input and each needs a distinct origin
+            # id (same contract as flatmap_op.Shipper)
+            tid = self.cur_tid
+            if tid is not None:
+                tid = tid + (self._exp,)
+                self._exp += 1
+            self.emitter.emit(item, ts, wm, tid=tid)
 
         call = tail
         for kind, fn in reversed(op.specs):
@@ -93,6 +101,7 @@ class ChainedHostReplica(Replica):
         return stage
 
     def process_single(self, item, ts, wm):
+        self._exp = 0
         self._head(item, ts, wm, self.context)
 
 

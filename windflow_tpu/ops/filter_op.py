@@ -20,7 +20,7 @@ class FilterReplica(Replica):
     def process_single(self, item, ts, wm):
         if self._fn(item, self.context):
             self.stats.outputs_sent += 1
-            self.emitter.emit(item, ts, wm)
+            self.emitter.emit(item, ts, wm, tid=self.cur_tid)
 
 
 class Filter(Operator):

@@ -15,18 +15,26 @@ from windflow_tpu.ops.base import Operator, Replica
 class Shipper:
     """Hands the user function a push interface (reference ``Shipper``)."""
 
-    __slots__ = ("_replica", "_ts", "_wm", "pushed")
+    __slots__ = ("_replica", "_ts", "_wm", "pushed", "_exp")
 
     def __init__(self, replica: "FlatMapReplica") -> None:
         self._replica = replica
         self._ts = 0
         self._wm = 0
         self.pushed = 0
+        self._exp = 0   # expansion index within the current input
 
     def push(self, item: Any) -> None:
         self.pushed += 1
         self._replica.stats.outputs_sent += 1
-        self._replica.emitter.emit(item, self._ts, self._wm)
+        # origin id = input id + expansion index: the k-th output of one
+        # input orders after the (k-1)-th, config-independently (the
+        # reference's flatmap outputs keep their input's id + FIFO order)
+        tid = self._replica.cur_tid
+        if tid is not None:
+            tid = tid + (self._exp,)
+            self._exp += 1
+        self._replica.emitter.emit(item, self._ts, self._wm, tid=tid)
 
 
 class FlatMapReplica(Replica):
@@ -40,6 +48,7 @@ class FlatMapReplica(Replica):
     def process_single(self, item, ts, wm):
         self._shipper._ts = ts
         self._shipper._wm = wm
+        self._shipper._exp = 0
         self._fn(item, self._shipper, self.context)
 
 

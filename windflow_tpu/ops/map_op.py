@@ -26,7 +26,7 @@ class MapReplica(Replica):
         if out is None:  # in-place variant: the (mutated) input moves on
             out = item
         self.stats.outputs_sent += 1
-        self.emitter.emit(out, ts, wm)
+        self.emitter.emit(out, ts, wm, tid=self.cur_tid)
 
 
 class Map(Operator):

@@ -36,7 +36,8 @@ class ReduceReplica(Replica):
             out = state
         self._states[key] = out
         self.stats.outputs_sent += 1
-        self.emitter.emit(copy.copy(out), ts, wm)
+        self.emitter.emit(copy.copy(out), ts, wm,
+                          tid=self.cur_tid)
 
 
 class Reduce(Operator):

@@ -30,6 +30,7 @@ class BaseSourceReplica(Replica):
 
     def __init__(self, op: Operator, index: int) -> None:
         super().__init__(op, index)
+        self._tid_seq = 0          # origin-id sequence (HostBatch.ids)
         self._last_ts = WM_NONE
         self._exhausted = False
         self._since_punct = 0
@@ -114,7 +115,10 @@ class SourceReplica(BaseSourceReplica):
             ts = self._assign_ts(item)
             self._advance_wm(ts)
             self.stats.outputs_sent += 1
-            self.emitter.emit(item, ts, self.current_wm)
+            self._tid_seq += 1
+            self.emitter.emit(item, ts, self.current_wm,
+                              tid=(self.op.ordinal, self.index,
+                                   self._tid_seq))
             produced += 1
             self._count_toward_punctuation(1)
         return produced > 0

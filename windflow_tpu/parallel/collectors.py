@@ -114,6 +114,11 @@ class WatermarkCollector(Collector):
         return []
 
 
+#: sort-key sentinel ordering id-less tuples after id-carrying ones at the
+#: same timestamp (tuple compare: any real origin ordinal < _NO_TID)
+_NO_TID = 1 << 60
+
+
 class OrderingCollector(Collector):
     """DETERMINISTIC mode: merge the (per-channel ordered) input streams into
     one globally timestamp-ordered stream, releasing a tuple only when every
@@ -123,7 +128,12 @@ class OrderingCollector(Collector):
     heap of channel heads over per-channel deques — O(log C) per released
     tuple — and batches each release run into one HostBatch, so long
     DETERMINISTIC streams stay linear instead of the naive per-tuple
-    quadratic.  Ties break on (ts, channel, arrival seq)."""
+    quadratic.  Ties break on (ts, origin id): origin ids are stamped at
+    sources and relayed by one-to-one/one-to-many host stages
+    (HostBatch.ids — the reference's Single_t id), so equal-timestamp
+    tuples order the same under ANY parallelism/batching configuration;
+    id-less tuples (aggregate outputs) fall back to (channel, arrival
+    seq)."""
 
     def __init__(self, num_channels: int) -> None:
         super().__init__(num_channels)
@@ -143,15 +153,18 @@ class OrderingCollector(Collector):
         # could still arrive there
         if self._empty_open:
             return []
-        items, tss, wms = [], [], []
+        items, tss, wms, ids = [], [], [], []
+        any_tid = False
         shared = False
         while self._heads and not self._empty_open:
             _, ch = heapq.heappop(self._heads)
             q = self._queues[ch]
-            _, item, ts, wm, sh = q.popleft()
+            _, item, ts, wm, sh, tid = q.popleft()
             items.append(item)
             tss.append(ts)
             wms.append(wm)
+            ids.append(tid)
+            any_tid |= tid is not None
             shared |= sh
             if q:
                 self._push_head(ch)
@@ -160,9 +173,11 @@ class OrderingCollector(Collector):
         if not items:
             return []
         # one ordered batch per release run; the conservative min watermark
-        # (items from slower channels may carry older frontiers)
+        # (items from slower channels may carry older frontiers); ids relay
+        # so a second ordered stage can break ties the same way
         wm = min((w for w in wms if w != WM_NONE), default=WM_NONE)
-        return [HostBatch(items, tss, wm, shared=shared)]
+        return [HostBatch(items, tss, wm, shared=shared,
+                          ids=ids if any_tid else None)]
 
     def on_message(self, channel, msg):
         if isinstance(msg, Punctuation):
@@ -175,10 +190,11 @@ class OrderingCollector(Collector):
             return []
         q = self._queues[channel]
         was_empty = not q
-        for item, ts in zip(msg.items, msg.tss):
+        for item, ts, tid in zip(msg.items, msg.tss, msg.ids_or_nones()):
             self._seq += 1
-            q.append(((ts, channel, self._seq), item, ts, msg.watermark,
-                      msg.shared))
+            key = (ts, tid) if tid is not None                 else (ts, (_NO_TID, channel, self._seq))
+            q.append((key, item, ts, msg.watermark,
+                      msg.shared, tid))
         if was_empty:
             self._push_head(channel)
             if not self._closed[channel]:

@@ -114,11 +114,12 @@ class Emitter:
 
     # -- host-tuple interface ----------------------------------------------
     def emit(self, item: Any, ts: int, wm: int,
-             shared: bool = False) -> None:
+             shared: bool = False, tid=None) -> None:
         """``shared=True`` marks an item whose object is (or may be) also
         delivered elsewhere (split multicast); it taints the open batch so
         in-place consumers copy before mutating rather than paying an eager
-        deepcopy per branch."""
+        deepcopy per branch.  ``tid`` is the optional origin id relayed for
+        DETERMINISTIC tie-breaking (HostBatch.ids)."""
         raise NotImplementedError
 
     # -- device-batch interface --------------------------------------------
@@ -132,8 +133,8 @@ class Emitter:
         reference GPU→CPU path also re-ships whole CPU batches
         (``keyby_emitter_gpu.hpp:594-638``); the default falls back to
         per-tuple emit for routings that need tuple granularity (keyby)."""
-        for item, ts in zip(hb.items, hb.tss):
-            self.emit(item, ts, hb.watermark, hb.shared)
+        for item, ts, tid in zip(hb.items, hb.tss, hb.ids_or_nones()):
+            self.emit(item, ts, hb.watermark, hb.shared, tid=tid)
 
     # -- columnar interface (bulk sources, windflow_tpu/io) -----------------
     def emit_columns(self, cols, tss, wm: int, row_wms=None) -> None:
@@ -184,20 +185,27 @@ class _OpenBatch:
     separately as ``DeviceBatch.frontier`` (see batch.py), valid only for
     the consuming operator's own place-then-fire step."""
 
-    __slots__ = ("items", "tss", "wm", "shared")
+    __slots__ = ("items", "tss", "wm", "shared", "tids", "any_tid")
 
     def __init__(self):
         self.items: list = []
         self.tss: list = []
         self.wm: int = WM_NONE
         self.shared: bool = False
+        self.tids: list = []
+        self.any_tid: bool = False
 
-    def add(self, item, ts, wm, shared=False):
+    def add(self, item, ts, wm, shared=False, tid=None):
         self.items.append(item)
         self.tss.append(ts)
+        self.tids.append(tid)
+        self.any_tid |= tid is not None
         self.shared |= shared
         if wm != WM_NONE:
             self.wm = wm if self.wm == WM_NONE else min(self.wm, wm)
+
+    def ids_or_none(self):
+        return self.tids if self.any_tid else None
 
 
 class ForwardEmitter(Emitter):
@@ -210,11 +218,11 @@ class ForwardEmitter(Emitter):
         self._open = [_OpenBatch() for _ in dests]
         self._next = 0
 
-    def emit(self, item, ts, wm, shared=False):
+    def emit(self, item, ts, wm, shared=False, tid=None):
         d = self._next
         self._next = (self._next + 1) % len(self.dests)
         ob = self._open[d]
-        ob.add(item, ts, wm, shared)
+        ob.add(item, ts, wm, shared, tid)
         if len(ob.items) >= max(1, self.output_batch_size):
             self._flush_dest(d)
 
@@ -222,7 +230,8 @@ class ForwardEmitter(Emitter):
         ob = self._open[d]
         if ob.items:
             self._send(d, HostBatch(ob.items, ob.tss, ob.wm,
-                                    shared=ob.shared))
+                                    shared=ob.shared,
+                                    ids=ob.ids_or_none()))
             self._open[d] = _OpenBatch()
 
     def emit_host_batch(self, hb):
@@ -248,10 +257,10 @@ class KeyByEmitter(Emitter):
         self.key_extractor = key_extractor
         self._open = [_OpenBatch() for _ in dests]
 
-    def emit(self, item, ts, wm, shared=False):
+    def emit(self, item, ts, wm, shared=False, tid=None):
         d = stable_hash(self.key_extractor(item)) % len(self.dests)
         ob = self._open[d]
-        ob.add(item, ts, wm, shared)
+        ob.add(item, ts, wm, shared, tid)
         if len(ob.items) >= max(1, self.output_batch_size):
             self._flush_dest(d)
 
@@ -259,7 +268,8 @@ class KeyByEmitter(Emitter):
         ob = self._open[d]
         if ob.items:
             self._send(d, HostBatch(ob.items, ob.tss, ob.wm,
-                                    shared=ob.shared))
+                                    shared=ob.shared,
+                                    ids=ob.ids_or_none()))
             self._open[d] = _OpenBatch()
 
     def flush(self, wm):
@@ -276,8 +286,8 @@ class BroadcastEmitter(Emitter):
         super().__init__(dests, output_batch_size)
         self._ob = _OpenBatch()
 
-    def emit(self, item, ts, wm, shared=False):
-        self._ob.add(item, ts, wm, shared)
+    def emit(self, item, ts, wm, shared=False, tid=None):
+        self._ob.add(item, ts, wm, shared, tid)
         if len(self._ob.items) >= max(1, self.output_batch_size):
             self.flush(wm)
 
@@ -288,7 +298,8 @@ class BroadcastEmitter(Emitter):
             # delete_counter multicast with Map's copyOnWrite,
             # single_t.hpp:54, map.hpp:57-215)
             b = HostBatch(self._ob.items, self._ob.tss, self._ob.wm,
-                          shared=len(self.dests) > 1 or self._ob.shared)
+                          shared=len(self.dests) > 1 or self._ob.shared,
+                          ids=self._ob.ids_or_none())
             for d in range(len(self.dests)):
                 self._send(d, b)
             self._ob = _OpenBatch()
@@ -356,9 +367,10 @@ class DeviceStageEmitter(Emitter):
         if wm != WM_NONE and wm > self._frontier:
             self._frontier = wm
 
-    def emit(self, item, ts, wm, shared=False):
-        # `shared` is irrelevant here: staging materializes new device arrays
-        # from the record's values, never aliasing the host object.
+    def emit(self, item, ts, wm, shared=False, tid=None):
+        # `shared` is irrelevant here: staging materializes new device
+        # arrays from the record's values, never aliasing the host object;
+        # `tid` is dropped — device edges are DEFAULT-mode only.
         self._advance_frontier(wm)
         self._ob.add(item, ts, wm)
         if len(self._ob.items) >= self.output_batch_size:
@@ -462,7 +474,7 @@ class KeyedDeviceStageEmitter(Emitter):
         i = int(k) & 0xFFFFFFFF
         return i - (1 << 32) if i >= (1 << 31) else i
 
-    def emit(self, item, ts, wm, shared=False):
+    def emit(self, item, ts, wm, shared=False, tid=None):
         # scalar splitmix64 (bit-identical to the native/columnar path) —
         # pure int ops, no per-tuple FFI or array allocation
         h = splitmix64_int(self._key32(self.key_extractor(item)))
@@ -607,8 +619,8 @@ class DeviceToHostEmitter(Emitter):
         super().__init__(inner.dests, inner.output_batch_size)
         self.inner = inner
 
-    def emit(self, item, ts, wm, shared=False):
-        self.inner.emit(item, ts, wm, shared)
+    def emit(self, item, ts, wm, shared=False, tid=None):
+        self.inner.emit(item, ts, wm, shared, tid=tid)
 
     def emit_device_batch(self, batch: DeviceBatch):
         from windflow_tpu.batch import device_to_host
@@ -679,10 +691,10 @@ class SplittingEmitter(Emitter):
         self.branches = list(branch_emitters)
         self._device_splits = {}  # capacity -> compiled split or None
 
-    def emit(self, item, ts, wm, shared=False):
+    def emit(self, item, ts, wm, shared=False, tid=None):
         dest = self.split_fn(item)
         if isinstance(dest, int):
-            self.branches[dest].emit(item, ts, wm, shared)
+            self.branches[dest].emit(item, ts, wm, shared, tid=tid)
         else:
             dest = list(dest)
             # Multicast: every branch sees the same object; mark it shared so
@@ -691,7 +703,7 @@ class SplittingEmitter(Emitter):
             # consumer-side copyOnWrite, map.hpp:57-215).
             multi = shared or len(dest) > 1
             for d in dest:
-                self.branches[d].emit(item, ts, wm, multi)
+                self.branches[d].emit(item, ts, wm, multi, tid=tid)
 
     def _get_device_split(self, capacity: int, payload):
         """Compile one mask-only split program per capacity

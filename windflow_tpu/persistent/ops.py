@@ -99,7 +99,7 @@ class PMapReplica(_PersistentReplica):
         if out is None:  # in-place variant
             out = item
         self.stats.outputs_sent += 1
-        self.emitter.emit(out, ts, wm)
+        self.emitter.emit(out, ts, wm, tid=self.cur_tid)
 
 
 class PMap(_PersistentOperator):
@@ -114,7 +114,7 @@ class PFilterReplica(_PersistentReplica):
         self.db.put(key, state)
         if keep:
             self.stats.outputs_sent += 1
-            self.emitter.emit(item, ts, wm)
+            self.emitter.emit(item, ts, wm, tid=self.cur_tid)
 
 
 class PFilter(_PersistentOperator):
@@ -150,7 +150,8 @@ class PReduceReplica(_PersistentReplica):
             out = state
         self.db.put(key, out)
         self.stats.outputs_sent += 1
-        self.emitter.emit(copy.copy(out), ts, wm)
+        self.emitter.emit(copy.copy(out), ts, wm,
+                          tid=self.cur_tid)
 
 
 class PReduce(_PersistentOperator):
