@@ -96,15 +96,21 @@ def _run_dag(seed, config_rnd):
             config_rnd.randint(1, 2)).build()
 
     g = wf.PipeGraph("fuzz", mode, wf.TimePolicy.EVENT)
+    src_batch = config_rnd.randint(1, 64)
     mp = g.add_source(
         wf.Source_Builder(lambda: iter(stream(seed)))
         .withTimestampExtractor(lambda t: t["ts"])
-        .withOutputBatchSize(config_rnd.randint(1, 64)).build())
+        .withOutputBatchSize(src_batch).build())
     if do_merge:
+        # a tb_window tail compiles for ONE batch capacity; all-TPU stage
+        # chains preserve each source's capacity, so merged sources must
+        # agree (the graph build enforces this with a clear error)
+        b2 = (src_batch if tail == "tb_window"
+              else config_rnd.randint(1, 64))
         mp2 = g.add_source(
             wf.Source_Builder(lambda: iter(stream(seed + 1)))
             .withTimestampExtractor(lambda t: t["ts"])
-            .withOutputBatchSize(config_rnd.randint(1, 64)).build())
+            .withOutputBatchSize(b2).build())
         mp = mp.merge(mp2)
 
     for kind in kinds:

@@ -261,3 +261,29 @@ def test_start_wait_end_idiom():
     g2 = wf.PipeGraph("nostart", wf.ExecutionMode.DEFAULT)
     with pytest.raises(wf.WindFlowError):
         g2.wait_end()
+
+
+def test_merge_capacity_mismatch_into_ffat_tpu_raises_at_build():
+    """Merged sources with different batch sizes relayed by capacity-
+    preserving TPU stages into a fixed-capacity FfatWindowsTPU must fail
+    at BUILD time with the offending sizes, not mid-run."""
+    s1 = (wf.Source_Builder(lambda: iter({"k": 0, "v": i, "ts": i * 1000}
+                                         for i in range(64)))
+          .withTimestampExtractor(lambda t: t["ts"])
+          .withOutputBatchSize(31).build())
+    s2 = (wf.Source_Builder(lambda: iter({"k": 1, "v": i, "ts": i * 1000}
+                                         for i in range(64)))
+          .withTimestampExtractor(lambda t: t["ts"])
+          .withOutputBatchSize(4).build())
+    g = wf.PipeGraph("capmix", wf.ExecutionMode.DEFAULT, wf.TimePolicy.EVENT)
+    p1 = g.add_source(s1)
+    p2 = g.add_source(s2)
+    merged = p1.merge(p2)
+    merged.add(wf.MapTPU_Builder(lambda t: dict(t)).build())
+    merged.add(wf.Ffat_WindowsTPU_Builder(lambda t: t["v"],
+                                          lambda a, b: a + b)
+               .withTBWindows(16_000, 4_000).withKeyBy(lambda t: t["k"])
+               .withMaxKeys(2).build())
+    merged.add_sink(wf.Sink_Builder(lambda r: None).build())
+    with pytest.raises(wf.WindFlowError, match="fixed batch capacity"):
+        g.run()

@@ -96,6 +96,54 @@ class PipeGraph:
             collect(mp)
         return out
 
+    def _check_fixed_capacity_ops(self):
+        """Fixed-capacity device operators (FfatWindowsTPU: its compiled
+        state layout is tied to ONE batch capacity) fed by several upstream
+        paths — a merge relayed through capacity-preserving TPU stages —
+        must see ONE capacity; surface the mismatch at build time with the
+        offending sizes instead of a mid-run step error."""
+        from windflow_tpu.windows.ffat_tpu import FfatWindowsTPU
+
+        upstreams = {}
+        for edge in self._edges():
+            if edge[0] == "op":
+                _, a, b = edge
+                upstreams.setdefault(id(b), (b, []))[1].append(a)
+            else:  # split: each child's head is fed by the split source
+                _, mp = edge
+                src_op = mp.operators[-1]
+                for child in mp.split_children:
+                    if child.operators:
+                        head = child.operators[0]
+                        upstreams.setdefault(
+                            id(head), (head, []))[1].append(src_op)
+
+        def effective_caps(op, seen=None):
+            # capacity a device batch arrives with: host ops stamp their
+            # output_batch_size; TPU ops pass their input capacity through
+            seen = seen or set()
+            if id(op) in seen:
+                return set()
+            seen.add(id(op))
+            if not op.is_tpu:
+                return {op.output_batch_size}
+            caps = set()
+            for up in upstreams.get(id(op), (None, []))[1]:
+                caps |= effective_caps(up, seen)
+            return caps
+
+        for _, (op, ups) in upstreams.items():
+            if isinstance(op, FfatWindowsTPU):
+                caps = set()
+                for up in ups:
+                    caps |= effective_caps(up)
+                if len(caps) > 1:
+                    raise WindFlowError(
+                        f"'{op.name}' (FfatWindowsTPU) compiles for one "
+                        f"fixed batch capacity but its upstream paths "
+                        f"deliver {sorted(caps)}; give the merged branches "
+                        "equal withOutputBatchSize")
+
     def _edges(self):
         """Yield (src_op, dst_op_or_split, routing) for every graph edge, in
         topological order of the MultiPipe DAG."""
@@ -131,6 +179,7 @@ class PipeGraph:
                 self._source_replicas.extend(op.replicas)
         for rep in self._all_replicas:
             rep.config = self.config
+        self._check_fixed_capacity_ops()
 
         # 2. wire edges: emitters on sources of the edge, collectors +
         #    channels on destinations

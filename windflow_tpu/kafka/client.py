@@ -315,6 +315,7 @@ class ConfluentConsumer(ConsumerClient):
         self._consumed_tps = set()   # partitions that delivered data
 
     def subscribe(self, topics, group_id, offsets=None):
+        self._consumed_tps = set()   # scoped to this consumer session
         cooperative = self.assignment_policy == "cooperative-sticky"
         conf = {"bootstrap.servers": self._brokers,
                 "group.id": group_id,

@@ -307,7 +307,8 @@ class BroadcastEmitter(Emitter):
     def emit_host_batch(self, hb):
         self.flush(hb.watermark)
         if len(self.dests) > 1:
-            hb = HostBatch(hb.items, hb.tss, hb.watermark, shared=True)
+            hb = HostBatch(hb.items, hb.tss, hb.watermark, shared=True,
+                           ids=hb.ids)
         for d in range(len(self.dests)):
             self._send(d, hb)
 
@@ -703,7 +704,11 @@ class SplittingEmitter(Emitter):
             # consumer-side copyOnWrite, map.hpp:57-215).
             multi = shared or len(dest) > 1
             for d in dest:
-                self.branches[d].emit(item, ts, wm, multi, tid=tid)
+                # branch-suffix the origin id: multicast delivers the SAME
+                # tuple to several branches, and a diamond re-merge into a
+                # DETERMINISTIC stage needs the copies' ids distinct
+                btid = tid + (-1, d) if tid is not None else None
+                self.branches[d].emit(item, ts, wm, multi, tid=btid)
 
     def _get_device_split(self, capacity: int, payload):
         """Compile one mask-only split program per capacity
