@@ -81,3 +81,57 @@ def test_multicast_split_falls_back_and_isolates():
     g.run()
     assert sorted(seen0) == [i + 1000 for i in range(128)]
     assert sorted(seen1) == list(range(128))
+
+
+def test_python_split_to_host_branches_ok_with_tpu_branch_elsewhere():
+    """A non-traceable split whose tuples only ever route to HOST branches
+    keeps working even when another branch is device-only — the host
+    fallback raises lazily, per routed tuple, not eagerly at the first
+    device batch."""
+    import pytest
+    host_seen = []
+    g = wf.PipeGraph("lazy_split_guard")
+    src = (wf.Source_Builder(lambda: iter({"v": i} for i in range(128)))
+           .withOutputBatchSize(32).build())
+    mp = g.add_source(src).add(
+        wf.MapTPU_Builder(lambda t: {"v": t["v"]}).build())
+
+    def split(t):  # Python control flow (not traceable); always branch 0
+        if t["v"] >= 0:
+            return 0
+        return 1
+
+    mp.split(split, 2)
+    mp.select(0).add_sink(wf.Sink_Builder(
+        lambda t: host_seen.append(t["v"]) if t is not None else None)
+        .build())
+    # branch 1 is a device-only continuation that never receives tuples
+    mp.select(1).add(
+        wf.MapTPU_Builder(lambda t: {"v": t["v"] * 2}).build()) \
+      .add_sink(wf.Sink_Builder(lambda t: None).build())
+    g.run()
+    assert sorted(host_seen) == list(range(128))
+
+
+def test_python_split_routing_to_tpu_branch_raises():
+    """The lazy guard still fires with the clear message when a tuple IS
+    routed to the device-only branch through the host fallback."""
+    import pytest
+    g = wf.PipeGraph("lazy_split_guard_bad")
+    src = (wf.Source_Builder(lambda: iter({"v": i} for i in range(128)))
+           .withOutputBatchSize(32).build())
+    mp = g.add_source(src).add(
+        wf.MapTPU_Builder(lambda t: {"v": t["v"]}).build())
+
+    def split(t):
+        if t["v"] % 2 == 0:
+            return 0
+        return 1
+
+    mp.split(split, 2)
+    mp.select(0).add_sink(wf.Sink_Builder(lambda t: None).build())
+    mp.select(1).add(
+        wf.MapTPU_Builder(lambda t: {"v": t["v"] * 2}).build()) \
+      .add_sink(wf.Sink_Builder(lambda t: None).build())
+    with pytest.raises(wf.WindFlowError, match="JAX-traceable"):
+        g.run()

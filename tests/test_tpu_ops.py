@@ -145,3 +145,23 @@ def test_reduce_tpu_combiner_structure_contract():
     g.add_source(src).add(red).add_sink(snk)
     with pytest.raises(wf.WindFlowError, match="same record structure"):
         g.run()
+
+
+def test_reduce_tpu_combiner_leaf_contract():
+    """Same treedef but a leaf whose dtype (or shape) drifts also raises
+    the clear contract error — structure alone is not enough (the scan
+    would fail with the same opaque mismatch)."""
+    src = (wf.Source_Builder(
+            lambda: iter({"key": i % 4, "value": float(i)}
+                         for i in range(64)))
+           .withOutputBatchSize(32).build())
+    import jax.numpy as jnp
+    red = (wf.ReduceTPU_Builder(
+            lambda a, b: {"key": a["key"],
+                          "value": jnp.stack([a["value"], b["value"]])})
+           .withKeyBy(lambda t: t["key"]).build())
+    snk = wf.Sink_Builder(lambda r: None).build()
+    g = wf.PipeGraph("leaf_contract", wf.ExecutionMode.DEFAULT)
+    g.add_source(src).add(red).add_sink(snk)
+    with pytest.raises(wf.WindFlowError, match="shape"):
+        g.run()

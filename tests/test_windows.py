@@ -702,3 +702,14 @@ def test_ffat_tpu_cb_sum_combiner_fast_path():
         g.add_source(src).add(op).add_sink(snk)
         g.run()
         assert (acc.count, acc.total) == exp, batch
+
+
+def test_ffat_tpu_sum_combiner_tb_warns():
+    """withSumCombiner is CB-only; declaring it together with TB windows
+    warns at build() instead of being a silent no-op."""
+    import warnings
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        (wf.Ffat_WindowsTPU_Builder(lambda t: t["v"], lambda a, b: a + b)
+         .withTBWindows(1000, 500).withMaxKeys(4).withSumCombiner().build())
+    assert any("count-based" in str(w.message) for w in caught)

@@ -555,7 +555,10 @@ class Ffat_WindowsTPU_Builder(_WindowBuilderBase):
         """Declare the combiner zero-absorbing on every leaf
         (``comb(x, 0) == x`` — sum and friends): count-based windows then
         run a flagless sliding fold with half the operand traffic.  Same
-        declaration knob as ReduceTPU_Builder.withSumCombiner."""
+        declaration knob as ReduceTPU_Builder.withSumCombiner.  CB-only:
+        the TB firing path already folds over value panes without
+        per-operand flags, so the declaration has nothing to speed up
+        there (``build()`` warns if combined with ``withTBWindows``)."""
         self._sum_like = True
         return self
 
@@ -576,6 +579,12 @@ class Ffat_WindowsTPU_Builder(_WindowBuilderBase):
         return self
 
     def build(self) -> FfatWindowsTPU:
+        if self._sum_like and self._win_type == WinType.TB:
+            import warnings
+            warnings.warn(
+                "withSumCombiner applies only to count-based FFAT windows; "
+                "it is a no-op for withTBWindows (the TB firing path is "
+                "already flagless)", stacklevel=2)
         return FfatWindowsTPU(
             self._lift, self._comb, self._spec(), max_keys=self._max_keys,
             name=self._name,

@@ -54,8 +54,10 @@ class KafkaShipper:
         # delivered yet HOLDS THE WATERMARK DOWN (poll rotation may simply
         # not have reached it), until it stays silent for idle_time_usec —
         # then it stops gating (an empty partition must not stall event
-        # time forever).  Idle pushes (no current partition) fall back to
-        # the replica-wide max.
+        # time forever).  Pushes with no current partition (idle callback,
+        # closing function) fold through the same gated per-partition
+        # minimum — the replica-wide max could jump the watermark past a
+        # lagging partition's pending data.
         if r._cur_tp is not None:
             pm = r._part_max
             prev = pm.get(r._cur_tp)
@@ -71,7 +73,21 @@ class KafkaShipper:
                 if wm is not None:
                     r._advance_wm(wm)
         else:
-            r._advance_wm(r._last_ts)
+            wm = r._partition_wm()
+            if wm is None:
+                # Distinguish "gated by a lagging partition" (hold the
+                # watermark) from "no partitions assigned at all" (e.g.
+                # parallelism > partition count): a partition-less
+                # replica's heartbeat pushes exist precisely to keep
+                # event time flowing — nothing can lag, so the replica-
+                # wide max is safe there.
+                asn = r._poll_asn
+                if asn is None and r._consumer is not None:
+                    asn = r._consumer.assignment()
+                if not asn:
+                    wm = r._last_ts
+            if wm is not None:
+                r._advance_wm(wm)
         r.stats.outputs_sent += 1
         r._tid_seq += 1
         r.emitter.emit(item, int(ts), r.current_wm,
@@ -171,7 +187,17 @@ class KafkaSourceReplica(SourceReplica):
         # if the poll drained it — in the normal steady state (consumer
         # keeping pace) every partition is always caught up, and treating
         # that as idle would freeze the watermark forever.
-        self._poll_asn = self._consumer.assignment()
+        self._poll_asn = asn = self._consumer.assignment()
+        # a partition revoked in a rebalance must not leave stale tracking
+        # behind: re-gained later, it starts a fresh grace window and a
+        # fresh event-time frontier (its backlog would otherwise be gated
+        # by a long-expired _part_seen_at anchor and marked late)
+        if asn is not None:
+            live = set(asn)
+            for d in (self._part_max, self._part_seen_at,
+                      self._part_last_at):
+                for tp in [t for t in d if t not in live]:
+                    del d[tp]
         caught = self._consumer.idle_partitions()
         if caught is not None and msgs:
             caught = caught - {(m.topic, m.partition) for m in msgs}

@@ -298,18 +298,29 @@ class ReduceTPU(Operator):
         one = jax.tree.map(
             lambda a: jax.ShapeDtypeStruct(a.shape[1:], a.dtype), payload)
         out_struct = jax.eval_shape(self.comb, one, one)
-        if jax.tree.structure(out_struct) == jax.tree.structure(one):
-            return
-        if isinstance(one, dict) and isinstance(out_struct, dict) \
-                and sorted(one.keys()) != sorted(out_struct.keys()):
-            want, got = sorted(one.keys()), sorted(out_struct.keys())
-        else:  # same field names but nested shape differs: show treedefs
-            want = jax.tree.structure(one)
-            got = jax.tree.structure(out_struct)
-        raise WindFlowError(
-            "ReduceTPU combiner must return the same record structure as "
-            f"its inputs (records have {want}, combiner returned {got}); "
-            "carry every field through the combine")
+        if jax.tree.structure(out_struct) != jax.tree.structure(one):
+            if isinstance(one, dict) and isinstance(out_struct, dict) \
+                    and sorted(one.keys()) != sorted(out_struct.keys()):
+                want, got = sorted(one.keys()), sorted(out_struct.keys())
+            else:  # same field names but nested shape differs: treedefs
+                want = jax.tree.structure(one)
+                got = jax.tree.structure(out_struct)
+            raise WindFlowError(
+                "ReduceTPU combiner must return the same record structure "
+                f"as its inputs (records have {want}, combiner returned "
+                f"{got}); carry every field through the combine")
+        # Same structure is not enough: a leaf whose shape or dtype drifts
+        # (a combiner summing over an axis, or promoting f32→f64) fails
+        # later inside the scan with the same opaque mismatch.
+        in_leaves, _ = jax.tree.flatten_with_path(one)
+        out_leaves = jax.tree.leaves(out_struct)
+        for (path, a), b in zip(in_leaves, out_leaves):
+            if a.shape != b.shape or a.dtype != b.dtype:
+                raise WindFlowError(
+                    "ReduceTPU combiner must preserve each field's shape "
+                    f"and dtype: field {jax.tree_util.keystr(path) or '.'} "
+                    f"is {a.shape}/{a.dtype} in the records but the "
+                    f"combiner returned {b.shape}/{b.dtype}")
 
     def _step(self, batch: DeviceBatch) -> DeviceBatch:
         if not self._jit_steps:

@@ -693,22 +693,27 @@ class SplittingEmitter(Emitter):
         self._device_splits = {}  # capacity -> compiled split or None
 
     def emit(self, item, ts, wm, shared=False, tid=None):
-        dest = self.split_fn(item)
+        self._route(item, ts, wm, self.split_fn(item), shared, tid)
+
+    def _route(self, item, ts, wm, dest, shared, tid):
+        """Single place for the split routing semantics (int vs iterable,
+        multicast CoW flag, origin-id branch suffixing) — shared by the
+        host-tuple path and the device-batch host fallback."""
         if isinstance(dest, int):
             self.branches[dest].emit(item, ts, wm, shared, tid=tid)
-        else:
-            dest = list(dest)
-            # Multicast: every branch sees the same object; mark it shared so
-            # in-place consumers copy lazily before mutating — no eager
-            # per-branch deepcopy (reference pairs multicast with the
-            # consumer-side copyOnWrite, map.hpp:57-215).
-            multi = shared or len(dest) > 1
-            for d in dest:
-                # branch-suffix the origin id: multicast delivers the SAME
-                # tuple to several branches, and a diamond re-merge into a
-                # DETERMINISTIC stage needs the copies' ids distinct
-                btid = tid + (-1, d) if tid is not None else None
-                self.branches[d].emit(item, ts, wm, multi, tid=btid)
+            return
+        dest = list(dest)
+        # Multicast: every branch sees the same object; mark it shared so
+        # in-place consumers copy lazily before mutating — no eager
+        # per-branch deepcopy (reference pairs multicast with the
+        # consumer-side copyOnWrite, map.hpp:57-215).
+        multi = shared or len(dest) > 1
+        for d in dest:
+            # branch-suffix the origin id: multicast delivers the SAME
+            # tuple to several branches, and a diamond re-merge into a
+            # DETERMINISTIC stage needs the copies' ids distinct
+            btid = tid + (-1, d) if tid is not None else None
+            self.branches[d].emit(item, ts, wm, multi, tid=btid)
 
     def _get_device_split(self, capacity: int, payload):
         """Compile one mask-only split program per capacity
@@ -757,22 +762,29 @@ class SplittingEmitter(Emitter):
                                 size=None, frontier=batch.frontier))
             return
         # Fallback: host-side per-tuple split (Python or multicast split fn).
-        # Device-only branch emitters cannot accept host items — the same
-        # contract as the reference, whose GPU split requires a
-        # __host__ __device__ splitting functor (splitting_emitter_gpu.hpp).
-        for b, em in enumerate(self.branches):
-            if not type(em).can_emit_host_items:
-                raise WindFlowError(
-                    "split after a TPU stage feeds a TPU branch "
-                    f"(branch {b}), so the split function must be "
-                    "JAX-traceable and single-destination (got a Python-"
-                    "level or multicast split function); make the split "
-                    "function traceable or insert a host stage before the "
-                    "TPU branch")
+        # A device-only branch emitter cannot accept host items, but that is
+        # an error only for a tuple actually ROUTED there — a non-traceable
+        # split that happens to route exclusively to host branches keeps
+        # working (same contract as the reference, whose GPU split requires
+        # a __host__ __device__ functor, splitting_emitter_gpu.hpp).
+        host_ok = [type(em).can_emit_host_items for em in self.branches]
         from windflow_tpu.batch import device_to_host
         hb = device_to_host(batch)
         for item, ts in zip(hb.items, hb.tss):
-            self.emit(item, ts, hb.watermark)
+            dest = self.split_fn(item)
+            if not isinstance(dest, int):
+                dest = list(dest)
+            for b in ((dest,) if isinstance(dest, int) else dest):
+                if not host_ok[b]:
+                    raise WindFlowError(
+                        "split after a TPU stage routed a tuple to a TPU "
+                        f"branch (branch {b}) through the host fallback, "
+                        "so the split function must be JAX-traceable and "
+                        "single-destination (got a Python-level or "
+                        "multicast split function); make the split "
+                        "function traceable or insert a host stage before "
+                        "the TPU branch")
+            self._route(item, ts, hb.watermark, dest, False, None)
 
     def propagate_punctuation(self, wm):
         for b in self.branches:
