@@ -1,0 +1,127 @@
+"""Worker process for the two-process DCN test (SURVEY §5.8).
+
+Each process joins a real ``jax.distributed`` job (Gloo CPU collectives,
+TCP coordinator — the CPU stand-in for DCN), exposes 4 virtual devices,
+builds the multi-host ``(data, key)`` mesh with host boundaries on the key
+axis, stages its OWN half of the input through ``stage_local``, and runs
+the sharded keyed reduce and the key-sharded FFAT window step across both
+processes.  Every process verifies the full result against a locally
+computed oracle; exit code 0 = all assertions held.
+
+Run by ``tests/test_multihost.py::test_two_process_dcn_reduce_and_ffat``;
+usable standalone:  python _multihost_worker.py <proc_id> <nproc> <port>
+"""
+
+import os
+import sys
+
+
+def main() -> None:
+    proc_id, nproc, port = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3]
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_cpu_collectives_implementation", "gloo")
+
+    from windflow_tpu.parallel.multihost import (initialize,
+                                                 make_multihost_mesh,
+                                                 stage_local)
+    initialize(coordinator_address=f"127.0.0.1:{port}",
+               num_processes=nproc, process_id=proc_id)
+    assert jax.process_count() == nproc, jax.process_count()
+
+    import numpy as np
+
+    import jax.numpy as jnp
+    from jax.experimental.multihost_utils import process_allgather
+
+    from windflow_tpu.batch import HostBatch
+    from windflow_tpu.parallel import mesh as meshmod
+
+    mesh = make_multihost_mesh(local_data=2)
+    assert mesh.shape == {"data": 2, "key": 2 * nproc}, mesh.shape
+    # host boundaries on the key axis: this process's devices own whole
+    # key columns (the data-axis all_gather stays inside one host)
+    for col in range(mesh.devices.shape[1]):
+        owners = {d.process_index for d in mesh.devices[:, col]}
+        assert len(owners) == 1, (col, owners)
+
+    # -- keyed reduce: each process stages only the lanes IT ingested ------
+    K, CAP = 16, 256
+    rng = np.random.default_rng(5)
+    keys = rng.integers(0, K, CAP)             # full input derivable by all
+    vals = rng.integers(0, 1000, CAP).astype(np.float64)
+    lo, hi = proc_id * CAP // nproc, (proc_id + 1) * CAP // nproc
+    hb = HostBatch([{"k": int(k), "v": float(v)}
+                    for k, v in zip(keys[lo:hi], vals[lo:hi])],
+                   list(range(lo, hi)), 0)
+    db = stage_local(hb, CAP, mesh)
+    fn = meshmod.make_sharded_keyed_reduce(
+        mesh, CAP, K, lambda a, b: {"k": a["k"], "v": a["v"] + b["v"]},
+        key_fn=lambda t: t["k"], use_psum=False)
+    table, has = fn(db.payload, db.valid)
+    expected = np.zeros(K)
+    for k, v in zip(keys, vals):
+        expected[k] += v
+    got = np.asarray(table["v"])      # replicated output: readable anywhere
+    np.testing.assert_allclose(got, expected, rtol=1e-6)
+    assert bool(np.asarray(has).all())
+    print(f"proc {proc_id}: keyed reduce across {nproc} processes OK",
+          flush=True)
+
+    # -- key-sharded FFAT CB windows across the process boundary -----------
+    Kf, CAPf, Pn, R, D = 8, 64, 4, 4, 1
+    lift = lambda t: t["v"]
+    comb = lambda a, b: a + b
+    key_fn = lambda t: t["k"]
+    step = meshmod.make_sharded_ffat_step(mesh, CAPf, Kf, Pn, R, D,
+                                          lift, comb, key_fn)
+    state = meshmod.make_sharded_ffat_state(jnp.zeros(()), Kf, R, mesh)
+
+    from windflow_tpu.windows.ffat_kernels import (make_ffat_state,
+                                                   make_ffat_step)
+    ref_step = jax.jit(make_ffat_step(CAPf, Kf, Pn, R, D, lift, comb,
+                                      key_fn))
+    ref_state = make_ffat_state(jnp.zeros(()), Kf, R)
+
+    from jax.sharding import NamedSharding, PartitionSpec
+    bsh = NamedSharding(mesh, PartitionSpec(meshmod.DATA_AXIS))
+
+    def global_put(a):
+        # data-sharded global array; every process derives the full input
+        # (same seed) and contributes each device's slice
+        return jax.make_array_from_callback(
+            a.shape, bsh, lambda idx: a[idx])
+
+    rng2 = np.random.default_rng(7)
+    got_w, exp_w = {}, {}
+    for _ in range(6):
+        k_np = rng2.integers(0, Kf, CAPf).astype(np.int32)
+        v_np = rng2.integers(0, 100, CAPf).astype(np.float32)  # exact sums
+        ts_np = np.arange(CAPf, dtype=np.int64)
+        ok_np = np.ones(CAPf, bool)
+        payload = {"k": global_put(k_np), "v": global_put(v_np)}
+        state, out, fired, _ = step(state, payload, global_put(ts_np),
+                                    global_put(ok_np))
+        # reference single-chip run on local, unsharded arrays
+        ref_payload = {"k": jnp.asarray(k_np), "v": jnp.asarray(v_np)}
+        ref_state, rout, rfired, _ = ref_step(
+            ref_state, ref_payload, jnp.asarray(ts_np), jnp.asarray(ok_np))
+        fired_np = process_allgather(fired, tiled=True)
+        out_np = {kk: process_allgather(v, tiled=True)
+                  for kk, v in out.items()}
+        for o, f, dst in ((out_np, fired_np, got_w),
+                          ({kk: np.asarray(v) for kk, v in rout.items()},
+                           np.asarray(rfired), exp_w)):
+            for i in np.nonzero(f)[0]:
+                dst[(int(o["key"][i]), int(o["wid"][i]))] = \
+                    float(o["value"][i])
+    assert len(exp_w) > 0
+    assert got_w == exp_w, (len(got_w), len(exp_w))
+    print(f"proc {proc_id}: FFAT windows across {nproc} processes OK",
+          flush=True)
+    print(f"proc {proc_id}: DCN_WORKER_OK", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -287,3 +287,71 @@ def test_merge_capacity_mismatch_into_ffat_tpu_raises_at_build():
     merged.add_sink(wf.Sink_Builder(lambda r: None).build())
     with pytest.raises(wf.WindFlowError, match="fixed batch capacity"):
         g.run()
+
+
+def _capmix_graph(op):
+    """Two merged sources with unequal batch sizes relayed through a
+    capacity-preserving TPU stage into ``op``."""
+    s1 = (wf.Source_Builder(lambda: iter({"k": 0, "v": float(i)}
+                                         for i in range(64)))
+          .withOutputBatchSize(31).build())
+    s2 = (wf.Source_Builder(lambda: iter({"k": 1, "v": float(i)}
+                                         for i in range(64)))
+          .withOutputBatchSize(4).build())
+    g = wf.PipeGraph("capmix2", wf.ExecutionMode.DEFAULT)
+    merged = g.add_source(s1).merge(g.add_source(s2))
+    merged.add(wf.MapTPU_Builder(lambda t: dict(t)).build())
+    merged.add(op)
+    merged.add_sink(wf.Sink_Builder(lambda r: None).build())
+    return g
+
+
+def test_merge_capacity_mismatch_into_stateful_map_tpu_raises():
+    op = (wf.MapTPU_Builder(
+            lambda t, s: ({"k": t["k"], "v": t["v"] + s}, s + t["v"]))
+          .withInitialState(0.0).withKeyBy(lambda t: t["k"]).build())
+    with pytest.raises(wf.WindFlowError,
+                       match=r"StatefulMapTPU.*\[4, 31\]"):
+        _capmix_graph(op).run()
+
+
+def test_merge_capacity_mismatch_into_stateful_filter_tpu_raises():
+    op = (wf.FilterTPU_Builder(
+            lambda t, s: (t["v"] > s, s + 1.0))
+          .withInitialState(0.0).withKeyBy(lambda t: t["k"]).build())
+    with pytest.raises(wf.WindFlowError,
+                       match=r"StatefulFilterTPU.*\[4, 31\]"):
+        _capmix_graph(op).run()
+
+
+def test_merge_capacity_mismatch_into_dense_reduce_tpu_raises():
+    op = (wf.ReduceTPU_Builder(
+            lambda a, b: {"k": a["k"], "v": a["v"] + b["v"]})
+          .withKeyBy(lambda t: t["k"]).withMaxKeys(2).build())
+    with pytest.raises(wf.WindFlowError,
+                       match=r"ReduceTPU\[withMaxKeys\].*\[4, 31\]"):
+        _capmix_graph(op).run()
+
+
+def test_merge_equal_capacity_into_dense_reduce_tpu_ok():
+    """The generalized check only fires on UNEQUAL capacities."""
+    s1 = (wf.Source_Builder(lambda: iter({"k": 0, "v": float(i)}
+                                         for i in range(64)))
+          .withOutputBatchSize(16).build())
+    s2 = (wf.Source_Builder(lambda: iter({"k": 1, "v": float(i)}
+                                         for i in range(64)))
+          .withOutputBatchSize(16).build())
+    got = []
+    g = wf.PipeGraph("capok", wf.ExecutionMode.DEFAULT)
+    merged = g.add_source(s1).merge(g.add_source(s2))
+    merged.add(wf.ReduceTPU_Builder(
+        lambda a, b: {"k": a["k"], "v": a["v"] + b["v"]})
+        .withKeyBy(lambda t: t["k"]).withMaxKeys(2).build())
+    merged.add_sink(wf.Sink_Builder(
+        lambda r: got.append((int(r["k"]), float(r["v"])))
+        if r is not None else None).build())
+    g.run()
+    per_key = {}
+    for k, v in got:
+        per_key[k] = per_key.get(k, 0.0) + v
+    assert per_key == {0: float(sum(range(64))), 1: float(sum(range(64)))}

@@ -1,0 +1,149 @@
+"""Host worker pool (Config.host_worker_threads): the reference runs one OS
+thread per replica (``basic_operator.hpp:54-235``), so host-operator
+pipelines scale across cores; here a worker pool drains host replicas
+concurrently each sweep.  These tests pin the correctness contract: pooled
+execution must produce exactly the single-thread results (per-replica
+processing stays serial; keyed routing still pins each key to one replica),
+for host-only graphs, mixed host/TPU graphs, and shared-DB persistent
+operators (which must stay on the driver thread)."""
+
+import threading
+
+import pytest
+
+import windflow_tpu as wf
+
+
+def _host_graph(workers: int):
+    """Source -> keyed FlatMap(4) -> KeyedWindows(4) -> Sink(2), all host.
+
+    Each key flows through ONE channel end to end (keyed routing + a
+    single source replica), so CB window contents are scheduling-
+    independent — cross-channel interleave would make them arrival-order
+    dependent in DEFAULT mode, with or without the pool (same as the
+    reference's thread-per-replica runtime)."""
+    results = []
+    results_lock = threading.Lock()
+    n, keys = 4000, 16
+
+    def gen():
+        for i in range(n):
+            yield {"k": i % keys, "v": float(i)}
+
+    def expand(t, shipper):
+        shipper.push({"k": t["k"], "v": t["v"]})
+        if t["k"] % 2 == 0:
+            shipper.push({"k": t["k"], "v": -t["v"]})
+
+    def win(t, acc):
+        return (acc or 0.0) + t["v"]
+
+    def sink(r):
+        if r is not None:
+            with results_lock:  # sink replicas may run on pool threads
+                results.append((int(r.key), int(r.wid), float(r.value)))
+
+    cfg = wf.Config(host_worker_threads=workers)
+    g = wf.PipeGraph("host_pool", wf.ExecutionMode.DEFAULT, config=cfg)
+    src = wf.Source_Builder(gen).withOutputBatchSize(64).build()
+    fm = (wf.FlatMap_Builder(expand).withKeyBy(lambda t: t["k"])
+          .withParallelism(4).build())
+    kw = (wf.Keyed_Windows_Builder(win).withCBWindows(8, 4)
+          .withKeyBy(lambda t: t["k"]).withParallelism(4).build())
+    snk = wf.Sink_Builder(sink).withParallelism(2).build()
+    g.add_source(src).add(fm).add(kw).add_sink(snk)
+    g.run()
+    return sorted(results)
+
+
+def test_pool_matches_single_thread_host_graph():
+    assert _host_graph(4) == _host_graph(0)
+
+
+def test_pool_matches_single_thread_mixed_tpu_graph():
+    """Host stages around a TPU reduce: the pooled host replicas stage
+    device batches concurrently (inflight counter is lock-guarded)."""
+
+    def run(workers):
+        acc = {}
+
+        def sink(t):
+            if t is not None:
+                k = int(t["k"])
+                acc[k] = acc.get(k, 0.0) + float(t["v"])
+
+        cfg = wf.Config(host_worker_threads=workers)
+        g = wf.PipeGraph("pool_mixed", wf.ExecutionMode.DEFAULT, config=cfg)
+        src = (wf.Source_Builder(
+                lambda: iter({"k": i % 8, "v": float(i)}
+                             for i in range(4096)))
+               .withOutputBatchSize(256).build())
+        m = (wf.Map_Builder(lambda t: {"k": t["k"], "v": t["v"] * 2})
+             .withParallelism(3).withOutputBatchSize(256).build())
+        red = (wf.ReduceTPU_Builder(
+                lambda a, b: {"k": a["k"], "v": a["v"] + b["v"]})
+               .withKeyBy(lambda t: t["k"]).build())
+        snk = wf.Sink_Builder(sink).build()
+        g.add_source(src).add(m).add(red).add_sink(snk)
+        g.run()
+        return acc
+
+    assert run(4) == run(0)
+
+
+def test_pool_shared_db_stays_on_driver_thread():
+    """Shared-DB persistent replicas are not pool-safe; the graph still
+    runs correctly with the pool on, and the partition excludes them."""
+    import tempfile
+
+    from windflow_tpu.persistent import P_Map_Builder
+
+    with tempfile.TemporaryDirectory() as d:
+        seen = []
+        seen_lock = threading.Lock()
+
+        def fn(t, state):
+            state["sum"] += t["v"]
+            return {"k": t["k"], "v": state["sum"]}
+
+        cfg = wf.Config(host_worker_threads=4)
+        g = wf.PipeGraph("pool_pdb", wf.ExecutionMode.DEFAULT, config=cfg)
+        src = (wf.Source_Builder(
+                lambda: iter({"k": i % 4, "v": 1.0} for i in range(64)))
+               .withOutputBatchSize(16).build())
+        pm = (P_Map_Builder(fn).withDbPath(f"{d}/kv").withSharedDb()
+              .withInitialState({"sum": 0.0})
+              .withKeyBy(lambda t: t["k"]).withParallelism(2).build())
+        snk = wf.Sink_Builder(
+            lambda t: seen.append((t["k"], t["v"]))
+            if t is not None else None).build()
+        g.add_source(src).add(pm).add_sink(snk)
+        g.run()
+        assert pm.replicas[0] in g._main_replicas
+        assert pm.replicas[0] not in g._pool_replicas
+        # every key counted to 16 (per-key serialization held)
+        finals = {}
+        for k, v in seen:
+            finals[k] = max(finals.get(k, 0.0), v)
+        assert finals == {k: 16.0 for k in range(4)}
+
+
+def test_pool_deterministic_mode_matches():
+    """DETERMINISTIC ordering is a collector property, not a scheduling
+    property — pooled drains must not change the released sequence."""
+
+    def run(workers):
+        out = []
+        cfg = wf.Config(host_worker_threads=workers)
+        g = wf.PipeGraph("pool_det", wf.ExecutionMode.DETERMINISTIC,
+                         config=cfg)
+        src = (wf.Source_Builder(lambda: iter(range(2000)))
+               .withParallelism(3).withOutputBatchSize(32).build())
+        m = wf.Map_Builder(lambda x: x * 2).withParallelism(2).build()
+        snk = wf.Sink_Builder(
+            lambda x: out.append(x) if x is not None else None).build()
+        g.add_source(src).add(m).add_sink(snk)
+        g.run()
+        return out
+
+    assert run(4) == run(0)

@@ -131,6 +131,18 @@ class Config:
     dashboard_port: int = int(os.environ.get("WF_TPU_DASHBOARD_PORT", "20207"))
     # Enable runtime tracing (reference compile-time -DWF_TRACING_ENABLED).
     tracing_enabled: bool = bool(int(os.environ.get("WF_TPU_TRACING", "0")))
+    # Host-side worker threads draining host-operator replicas in parallel
+    # (reference: one OS thread per replica via FastFlow,
+    # basic_operator.hpp:54-235, so a CPU-operator pipeline scales across
+    # cores).  0 = the single cooperative dispatch loop (device-heavy
+    # pipelines need nothing more — XLA dispatch is already async).  N > 0
+    # = an N-thread pool drains host replicas each sweep; TPU replicas and
+    # sources stay on the driver thread (stateful device ops share operator
+    # state serialized by construction).  Host operators whose hot work is
+    # numpy/native (GIL-releasing) scale near-linearly; pure-Python
+    # per-tuple functions are GIL-bound, as in any CPython thread pool.
+    host_worker_threads: int = int(os.environ.get("WF_TPU_HOST_WORKERS",
+                                                  "0"))
     # Multi-chip execution: a jax.sharding.Mesh with ("data", "key") axes
     # (see windflow_tpu.parallel.mesh.make_mesh).  When set, staging emitters
     # lay batches out data-sharded across the mesh and mesh-aware TPU

@@ -10,6 +10,16 @@ the loop is already staging N+1; thread-per-replica would add contention, not
 parallelism (SURVEY.md §7 design stance; and see parallel/mesh.py for how
 replication maps to chips instead).
 
+Host-heavy pipelines are the exception: window engines, FlatMaps, sink
+serializers all share the driver thread, capping a CPU-operator pipeline at
+one core where the reference scales thread-per-replica
+(``basic_operator.hpp:54``).  ``Config.host_worker_threads > 0`` restores
+that capability with a worker pool: each sweep, host replicas with pending
+input drain concurrently (one task per replica, so per-replica processing
+stays serial and keyed routing still pins a key to one replica); sources and
+TPU replicas stay on the driver thread.  GIL-releasing host work (numpy,
+native calls) then scales across cores; see ``bench_host.py``.
+
 End of run mirrors ``PipeGraph::wait_end`` (``pipegraph.hpp:703-768``): EOS
 punctuations cascade, window state flushes, and per-operator stats JSON is
 dumped when tracing is enabled.
@@ -64,6 +74,11 @@ class PipeGraph:
         self._throttle_events = 0
         self._max_inbox_seen = 0
         self._max_inflight_device_seen = 0
+        # host worker pool (Config.host_worker_threads): replicas drained
+        # off the driver thread, and the driver-thread remainder
+        self._pool = None
+        self._pool_replicas = []
+        self._main_replicas = []
 
     # -- construction --------------------------------------------------------
     def add_source(self, source: Source) -> MultiPipe:
@@ -97,13 +112,13 @@ class PipeGraph:
         return out
 
     def _check_fixed_capacity_ops(self):
-        """Fixed-capacity device operators (FfatWindowsTPU: its compiled
-        state layout is tied to ONE batch capacity) fed by several upstream
+        """Fixed-capacity device operators (``Operator.fixed_capacity_label``
+        is set: FfatWindowsTPU pane state, StatefulMap/FilterTPU slot
+        tables, dense-key ReduceTPU cross-chip tables — each compiles a
+        state layout tied to ONE batch capacity) fed by several upstream
         paths — a merge relayed through capacity-preserving TPU stages —
         must see ONE capacity; surface the mismatch at build time with the
         offending sizes instead of a mid-run step error."""
-        from windflow_tpu.windows.ffat_tpu import FfatWindowsTPU
-
         upstreams = {}
         for edge in self._edges():
             if edge[0] == "op":
@@ -133,13 +148,14 @@ class PipeGraph:
             return caps
 
         for _, (op, ups) in upstreams.items():
-            if isinstance(op, FfatWindowsTPU):
+            label = op.fixed_capacity_label
+            if label is not None:
                 caps = set()
                 for up in ups:
                     caps |= effective_caps(up)
                 if len(caps) > 1:
                     raise WindFlowError(
-                        f"'{op.name}' (FfatWindowsTPU) compiles for one "
+                        f"'{op.name}' ({label}) compiles for one "
                         f"fixed batch capacity but its upstream paths "
                         f"deliver {sorted(caps)}; give the merged branches "
                         "equal withOutputBatchSize")
@@ -228,6 +244,24 @@ class PipeGraph:
                         f"operator '{op.name}' has no downstream consumer — "
                         "every MultiPipe must end in a Sink")
 
+        # 4. host worker pool partition: host (non-source, pool-safe)
+        #    replicas drain concurrently; sources tick on the driver thread
+        #    and TPU replicas stay there too (stateful device operators
+        #    share state across replicas, serialized by construction —
+        #    the role of the reference's spinlock, map_gpu.hpp:114-115)
+        if self.config.host_worker_threads > 0:
+            from concurrent.futures import ThreadPoolExecutor
+            self._pool = ThreadPoolExecutor(
+                max_workers=self.config.host_worker_threads,
+                thread_name_prefix=f"wf-{self.name}")
+            for op in self._operators:
+                pooled = (not op.is_tpu and op.host_pool_safe
+                          and not isinstance(op, Source))
+                (self._pool_replicas if pooled
+                 else self._main_replicas).extend(op.replicas)
+        else:
+            self._main_replicas = self._all_replicas
+
     # -- execution -----------------------------------------------------------
     def run(self) -> "PipeGraph":
         """Build, then drive the whole graph to completion — the
@@ -243,12 +277,16 @@ class PipeGraph:
         deployment would call :meth:`step` from its own loop instead."""
         if not self._started:
             raise WindFlowError("wait_end before start")
-        while not self.is_done():
-            if not self.step():
-                raise WindFlowError(
-                    "PipeGraph stalled: no replica made progress but the "
-                    "graph has not terminated (routing bug?)")
-        self._finalize()
+        try:
+            while not self.is_done():
+                if not self.step():
+                    raise WindFlowError(
+                        "PipeGraph stalled: no replica made progress but "
+                        "the graph has not terminated (routing bug?)")
+        finally:
+            # always release the worker pool / monitor, also on operator
+            # errors re-raised out of step()
+            self._finalize()
         return self
 
     def start(self) -> None:
@@ -289,9 +327,20 @@ class PipeGraph:
                 # is in flight anyway, so watermarks advance with it.
                 sr.maybe_punctuate()
         limit = self.config.sweep_drain_limit
-        for rep in self._all_replicas:
+        if self._pool is not None:
+            # one task per replica-with-work: per-replica processing stays
+            # serial (single consumer per inbox), cross-replica it runs on
+            # the pool; the sweep barrier below keeps the topological
+            # drain of the driver-thread replicas race-free
+            futures = [self._pool.submit(rep.drain, limit)
+                       for rep in self._pool_replicas if rep.inbox]
+        for rep in self._main_replicas:
             if rep.drain(limit):
                 progress = True
+        if self._pool is not None:
+            for f in futures:
+                if f.result():
+                    progress = True
         if not progress:
             # Sources were deferred but nothing drained (e.g. limit=0 edge
             # cases): force one tick so the graph cannot deadlock on its own
@@ -325,6 +374,9 @@ class PipeGraph:
         return all(r.done for r in self._all_replicas)
 
     def _finalize(self) -> None:
+        if self._pool is not None:
+            self._pool.shutdown(wait=True)
+            self._pool = None
         if self._monitor is not None:
             self._monitor.stop()
             self._monitor = None
@@ -365,10 +417,12 @@ class PipeGraph:
             "Max_inflight_device_batches_seen":
                 self._max_inflight_device_seen,
             "Non_blocking": "ON",     # async XLA dispatch
-            "Thread_pinning": "OFF",  # single dispatch loop, no pinning
+            "Thread_pinning": "OFF",  # driver loop + pool, no pinning
+            "Host_worker_threads": self.config.host_worker_threads,
             "Dropped_tuples": self.get_num_dropped_tuples(),
             "Operator_number": len(self._operators),
-            "Thread_number": 1 + (1 if self._monitor is not None else 0),
+            "Thread_number": 1 + self.config.host_worker_threads
+                               + (1 if self._monitor is not None else 0),
             "rss_size_kb": _rss_kb(),
             "Operators": [op.dump_stats() for op in self._operators],
         }

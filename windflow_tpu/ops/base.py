@@ -18,6 +18,7 @@ its emitter, forwards EOS, and terminates.
 from __future__ import annotations
 
 import copy
+import threading
 from collections import deque
 from typing import Any, Callable, List, Optional
 
@@ -45,8 +46,12 @@ class Replica:
         self.inbox: deque = deque()
         #: outstanding device batches in this inbox — the per-operator
         #: in-transit count the host driver throttles against (reference
-        #: ``inTransit_counter``, ``recycling_gpu.hpp:88-126``)
+        #: ``inTransit_counter``, ``recycling_gpu.hpp:88-126``).  Guarded
+        #: by a lock: with the host worker pool several producer replicas
+        #: may stage batches into this inbox concurrently (deque appends
+        #: are atomic; the int += is not).
         self.inflight_device = 0
+        self._inflight_lock = threading.Lock()
         self.collector: Optional[Collector] = None  # wired by the graph
         self.emitter: Optional[Emitter] = None      # wired by the graph
         self.config = default_config                # PipeGraph overrides
@@ -75,7 +80,8 @@ class Replica:
     def receive(self, channel: int, msg) -> None:
         self.inbox.append((channel, msg))
         if isinstance(msg, DeviceBatch):
-            self.inflight_device += 1
+            with self._inflight_lock:
+                self.inflight_device += 1
 
     def drain(self, limit: int = 0) -> bool:
         """Process pending inbox messages (at most ``limit`` when > 0; the
@@ -90,7 +96,8 @@ class Replica:
             n += 1
             channel, msg = self.inbox.popleft()
             if isinstance(msg, DeviceBatch):
-                self.inflight_device -= 1
+                with self._inflight_lock:
+                    self.inflight_device -= 1
             progressed = True
             if isinstance(msg, Punctuation) and msg.is_eos:
                 self._handle_channel_eos(channel)
@@ -199,6 +206,18 @@ class Operator:
     #: replica termination with the replica's RuntimeContext (arity 1) or
     #: no arguments (arity 0)
     closing_func = None
+    #: host operators whose replicas may be drained concurrently by the
+    #: host worker pool (Config.host_worker_threads); operators with
+    #: cross-replica shared mutable state (e.g. a shared persistent DB
+    #: handle) clear this to stay on the driver thread
+    host_pool_safe = True
+    #: non-None for device operators whose compiled state layout is tied to
+    #: ONE batch capacity (FfatWindowsTPU pane state, stateful slot tables,
+    #: dense-key mesh reduce tables): PipeGraph rejects merged upstream
+    #: paths delivering unequal capacities at BUILD time (parity:
+    #: ``multipipe.hpp:441-444`` rejects bad GPU predecessors at build).
+    #: The value is the label used in the error message.
+    fixed_capacity_label = None
 
     def __init__(self, name: str, parallelism: int,
                  routing: RoutingMode = RoutingMode.FORWARD,

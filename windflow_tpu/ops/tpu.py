@@ -231,6 +231,10 @@ class ReduceTPU(Operator):
         self.max_keys = max_keys
         self.sum_like = sum_like
         self._jit_steps = {}
+        # dense-key variant (withMaxKeys): the cross-chip partial tables
+        # are compiled for one batch capacity — build-time capacity check
+        if max_keys is not None:
+            self.fixed_capacity_label = "ReduceTPU[withMaxKeys]"
         # device scalar accumulating mesh-path key drops (tuples whose key
         # falls outside [0, max_keys) cannot live in the dense cross-chip
         # tables); read lazily at stats time, never on the step path

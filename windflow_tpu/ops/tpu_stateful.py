@@ -209,6 +209,13 @@ class _StatefulTPUBase(Operator):
 
     _is_filter = False
 
+    @property
+    def fixed_capacity_label(self):
+        # slot-table programs (and their intern padding) are compiled for
+        # one batch capacity; mixed capacities would silently retrace per
+        # batch or fail inside the scan — reject the merge at build
+        return type(self).__name__
+
     def __init__(self, fn: Callable, initial_state: Any, name: str,
                  parallelism: int, key_extractor: Callable,
                  num_key_slots: int = 4096, dense_keys: bool = False,

@@ -225,12 +225,20 @@ class KSlackCollector(Collector):
         self._open = num_channels
 
     def _release(self, limit: int) -> List[HostBatch]:
-        out = []
+        # one HostBatch per release run (the OrderingCollector batches its
+        # release runs the same way): a K-slack burst must not turn into
+        # per-tuple singleton batches that tax every downstream stage
+        items, tss = [], []
+        shared = False
         while self._heap and self._heap[0][0] <= limit:
             ts, _, item, _, sh = heapq.heappop(self._heap)
             self._frontier = max(self._frontier, ts)
-            out.append(HostBatch([item], [ts], self._frontier, shared=sh))
-        return out
+            items.append(item)
+            tss.append(ts)
+            shared |= sh
+        if not items:
+            return []
+        return [HostBatch(items, tss, self._frontier, shared=shared)]
 
     def on_message(self, channel, msg):
         if isinstance(msg, Punctuation):

@@ -92,6 +92,7 @@ class FfatWindowsTPU(Operator):
     kernels, ``ffat_replica_gpu.hpp:92-216``)."""
 
     replica_class = FfatTPUReplica
+    fixed_capacity_label = "FfatWindowsTPU"
 
     def __init__(self, lift: Callable, comb: Callable, spec: WindowSpec, *,
                  max_keys: int, name: str = "ffat_windows_tpu",
