@@ -147,3 +147,31 @@ def test_pool_deterministic_mode_matches():
         return out
 
     assert run(4) == run(0)
+
+
+def test_operator_error_propagates_and_releases_pool():
+    """An operator exception mid-run must propagate as the root cause —
+    not be masked by an error-path stats dump — and must release the
+    worker pool and monitor."""
+    import tempfile
+
+    class Boom(RuntimeError):
+        pass
+
+    def bad(t):
+        if t >= 64:
+            raise Boom("user fn failed")
+        return t
+
+    with tempfile.TemporaryDirectory() as d:
+        cfg = wf.Config(host_worker_threads=2, tracing_enabled=True,
+                        log_dir=d)
+        g = wf.PipeGraph("err_path", wf.ExecutionMode.DEFAULT, config=cfg)
+        g.add_source(wf.Source_Builder(lambda: iter(range(256)))
+                     .withOutputBatchSize(32).build()) \
+         .add(wf.Map(bad)) \
+         .add_sink(wf.Sink_Builder(lambda t: None).build())
+        with pytest.raises(Boom):
+            g.run()
+        assert g._pool is None
+        assert g._monitor is None
