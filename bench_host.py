@@ -18,6 +18,7 @@ serializers, window folds over arrays).
 """
 
 import json
+import os
 import statistics
 import time
 
@@ -25,11 +26,11 @@ import numpy as np
 
 import windflow_tpu as wf
 
-N_TUPLES = 24_000
+N_TUPLES = int(os.environ.get("BENCH_HOST_TUPLES", 24_000))
 N_KEYS = 32
-VEC = 8192
+VEC = int(os.environ.get("BENCH_HOST_VEC", 8192))
 WIN, SLIDE = 16, 8
-REPS = 3
+REPS = int(os.environ.get("BENCH_HOST_REPS", 3))
 
 
 def _base_blocks():
@@ -75,7 +76,6 @@ def run_once(par: int, workers: int, blocks) -> float:
 
 
 def main():
-    import os
     cores = (len(os.sched_getaffinity(0))
              if hasattr(os, "sched_getaffinity") else os.cpu_count() or 1)
     blocks = _base_blocks()

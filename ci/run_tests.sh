@@ -9,3 +9,5 @@ cd "$(dirname "$0")/.."
 python -m pytest tests/ -q
 python __graft_entry__.py 8
 BENCH_PLATFORM=cpu BENCH_E2E_TUPLES=131072 python bench.py
+# host worker-pool smoke (reduced size; reports pool overhead on 1 core)
+BENCH_HOST_TUPLES=4000 BENCH_HOST_VEC=2048 BENCH_HOST_REPS=1 python bench_host.py

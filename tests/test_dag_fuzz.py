@@ -24,6 +24,7 @@ reference's sweeps do:
 Integer payloads keep every aggregation exact, so equality is bitwise."""
 
 import random
+import threading
 
 import pytest
 
@@ -82,6 +83,7 @@ def _run_dag(seed, config_rnd):
             else wf.ExecutionMode.DEFAULT)
 
     accs = {}
+    acc_lock = threading.Lock()   # sink replicas may run on pool threads
 
     def mk_sink(name):
         accs[name] = [0, 0]
@@ -90,12 +92,16 @@ def _run_dag(seed, config_rnd):
             if r is None:
                 return
             v = r.value if hasattr(r, "value") else r["value"]
-            accs[name][0] += 1
-            accs[name][1] += int(v)
+            with acc_lock:
+                accs[name][0] += 1
+                accs[name][1] += int(v)
         return wf.Sink_Builder(s).withParallelism(
             config_rnd.randint(1, 2)).build()
 
-    g = wf.PipeGraph("fuzz", mode, wf.TimePolicy.EVENT)
+    # the host worker pool is a CONFIG dimension: pooled drains must
+    # reproduce run 0's results bit-for-bit across every topology
+    cfg = wf.Config(host_worker_threads=config_rnd.choice([0, 0, 2, 4]))
+    g = wf.PipeGraph("fuzz", mode, wf.TimePolicy.EVENT, config=cfg)
     src_batch = config_rnd.randint(1, 64)
     mp = g.add_source(
         wf.Source_Builder(lambda: iter(stream(seed)))

@@ -7,6 +7,7 @@ fixed-capacity state carry the guarantee)."""
 
 import os
 import sys
+import threading
 
 import numpy as np
 import pytest
@@ -65,3 +66,48 @@ def test_soak_rss_bounded():
     late = sum(samples[-q:]) / q
     growth_mb = (late - early) / 1024
     assert growth_mb < 256, (early, late, growth_mb)
+
+
+@pytest.mark.skipif(sys.platform != "linux", reason="/proc RSS sampling")
+def test_soak_rss_bounded_host_pool():
+    """Host-pipeline soak under the worker pool: half a million tuples
+    through Source -> keyed FlatMap -> KeyedWindows -> Sink with 4 pool
+    threads; RSS must stay bounded (catches queue pileups or per-sweep
+    future/descriptor leaks in the pool path) and counts must be exact."""
+    n_tuples, n_keys = 524_288, 64
+    samples = []
+
+    def gen():
+        for i in range(n_tuples):
+            if i % 65_536 == 0:
+                samples.append(_rss_kb())
+            yield {"k": i % n_keys, "v": 1}
+
+    got = [0, 0]
+    lock = threading.Lock()
+
+    def sink(r):
+        if r is not None:
+            with lock:
+                got[0] += 1
+                got[1] += int(r.value)
+
+    cfg = wf.Config(host_worker_threads=4)
+    g = wf.PipeGraph("soak_pool", wf.ExecutionMode.DEFAULT, config=cfg)
+    g.add_source(wf.Source_Builder(gen).withOutputBatchSize(512).build()) \
+     .add(wf.FlatMap_Builder(lambda t, s: s.push(t))
+          .withKeyBy(lambda t: t["k"]).withParallelism(4).build()) \
+     .add(wf.Keyed_Windows_Builder(lambda t, acc: (acc or 0) + t["v"])
+          .withCBWindows(64, 64).withKeyBy(lambda t: t["k"])
+          .withParallelism(4).build()) \
+     .add_sink(wf.Sink_Builder(sink).withParallelism(2).build())
+    g.run()
+
+    # tumbling 64/64 over n/keys tuples per key: every window sums to 64
+    per_key = n_tuples // n_keys
+    assert got[0] == n_keys * (per_key // 64)
+    assert got[1] == got[0] * 64
+    q = max(1, len(samples) // 4)
+    early = sum(samples[q:2 * q]) / q
+    late = sum(samples[-q:]) / q
+    assert (late - early) / 1024 < 128, (early, late)
