@@ -1,0 +1,227 @@
+"""The O(n) dense-key grouping permutation (windows/grouping.py) and its
+wiring into the FFAT steps (Config.ffat_grouping).
+
+Three layers of evidence, mirroring how the argsort path earned trust:
+1. the permutation itself is bit-identical to ``jnp.argsort(stable=True)``
+   across bucket widths (single-digit, radix), batch sizes (chunk-padding
+   edges), and skews;
+2. the CB and TB FFAT steps produce bit-identical outputs AND state under
+   both groupings — including a NON-commutative combiner, which fails if
+   arrival order within a key is ever perturbed;
+3. a whole graph run under ``ffat_grouping="rank_scatter"`` matches the
+   pure-Python oracle (the config plumbing, not just the kernel).
+
+Reference anchor: the grouping the reference buys with
+``thrust::sort_by_key`` (``keyby_emitter_gpu.hpp:519-583``).
+"""
+
+import random
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import windflow_tpu as wf
+from windflow_tpu.windows.ffat_kernels import (agg_spec_for, make_ffat_state,
+                                               make_ffat_step,
+                                               make_ffat_tb_state,
+                                               make_ffat_tb_step)
+from windflow_tpu.windows.grouping import counting_order
+
+
+@pytest.mark.parametrize("B,nbuckets", [
+    (4096, 257),      # bench digit width
+    (1000, 7),        # few buckets
+    (64, 257),        # one chunk exactly
+    (63, 3),          # sub-chunk + padding
+    (31, 5),          # below one chunk
+    (4096, 70000),    # radix (3 digits)
+    (300, 1),         # all ids equal
+    (512, 300),       # radix (2 digits)
+])
+def test_counting_order_matches_stable_argsort(B, nbuckets):
+    rng = np.random.default_rng(B * 31 + nbuckets)
+    ids = jnp.asarray(rng.integers(0, nbuckets, B), jnp.int32)
+    got = jax.jit(lambda x: counting_order(x, nbuckets))(ids)
+    want = jnp.argsort(ids, stable=True)
+    assert (got == want).all()
+
+
+def test_counting_order_skewed_and_sorted_inputs():
+    for ids_np in [
+        np.zeros(500, np.int32),                       # one hot bucket
+        np.arange(500, dtype=np.int32) % 3,            # round-robin
+        np.sort(np.random.default_rng(0).integers(0, 9, 500)).astype(
+            np.int32),                                 # already grouped
+        np.concatenate([np.full(499, 7, np.int32), [0]]),  # tail singleton
+    ]:
+        ids = jnp.asarray(ids_np)
+        got = counting_order(ids, int(ids_np.max()) + 1)
+        want = jnp.argsort(ids, stable=True)
+        assert (got == want).all()
+
+
+# -- kernel-level equivalence ----------------------------------------------
+
+def _random_batches(rng, cap, K, n_batches, ts_jitter=False):
+    for i in range(n_batches):
+        n = rng.integers(cap // 2, cap + 1)
+        keys = rng.integers(0, K + 2, cap)      # includes out-of-range keys
+        vals = rng.random(cap).astype(np.float32)
+        ts = np.arange(cap, dtype=np.int64) * 1000 + i * cap * 1000
+        if ts_jitter:
+            ts = ts + rng.integers(-2000, 2000, cap)
+        valid = np.zeros(cap, bool)
+        valid[:n] = True
+        yield (jnp.asarray(keys, jnp.int32), jnp.asarray(vals),
+               jnp.asarray(ts), jnp.asarray(valid))
+
+
+# non-commutative, associative: 2x2 matrix product over (value, 1) lifts
+def _mat_lift(x):
+    v = x["v"]
+    one = jnp.ones((), v.dtype)
+    return {"a": one, "b": v, "c": jnp.zeros((), v.dtype), "d": one}
+
+
+def _mat_comb(m1, m2):
+    return {"a": m1["a"] * m2["a"] + m1["b"] * m2["c"],
+            "b": m1["a"] * m2["b"] + m1["b"] * m2["d"],
+            "c": m1["c"] * m2["a"] + m1["d"] * m2["c"],
+            "d": m1["c"] * m2["b"] + m1["d"] * m2["d"]}
+
+
+@pytest.mark.parametrize("comb_kind", ["sum", "noncommutative"])
+def test_cb_step_bitwise_equal_across_groupings(comb_kind):
+    cap, K, P, R, D = 96, 5, 4, 4, 1
+    if comb_kind == "sum":
+        lift, comb = (lambda x: x["v"]), (lambda a, b: a + b)
+    else:
+        lift, comb = _mat_lift, _mat_comb
+    key_fn = lambda x: x["k"]
+    steps = {
+        g: jax.jit(make_ffat_step(cap, K, P, R, D, lift, comb, key_fn,
+                                  grouping=g))
+        for g in ("rank_scatter", "argsort")
+    }
+    spec = agg_spec_for(lift, {"k": jnp.zeros((cap,), jnp.int32),
+                               "v": jnp.zeros((cap,), jnp.float32)})
+    states = {g: make_ffat_state(spec, K, R) for g in steps}
+    rngs = {g: np.random.default_rng(7) for g in steps}
+    for _ in range(4):
+        outs = {}
+        for g, step in steps.items():
+            keys, vals, ts, valid = next(
+                _random_batches(rngs[g], cap, K, 1))
+            states[g], out, fired, out_ts = step(
+                states[g], {"k": keys, "v": vals}, ts, valid)
+            outs[g] = (out, fired, out_ts)
+        for (a, b) in zip(jax.tree.leaves(outs["rank_scatter"]),
+                          jax.tree.leaves(outs["argsort"])):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        for (a, b) in zip(jax.tree.leaves(states["rank_scatter"]),
+                          jax.tree.leaves(states["argsort"])):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+@pytest.mark.parametrize("comb_kind", ["sum", "noncommutative"])
+def test_tb_step_bitwise_equal_across_groupings(comb_kind):
+    cap, K, P_usec, R, D, NP = 96, 5, 1000, 4, 2, 32
+    if comb_kind == "sum":
+        lift, comb = (lambda x: x["v"]), (lambda a, b: a + b)
+    else:
+        lift, comb = _mat_lift, _mat_comb
+    key_fn = lambda x: x["k"]
+    steps = {
+        g: jax.jit(make_ffat_tb_step(cap, K, P_usec, R, D, NP, lift, comb,
+                                     key_fn, grouping=g))
+        for g in ("rank_scatter", "argsort")
+    }
+    spec = agg_spec_for(lift, {"k": jnp.zeros((cap,), jnp.int32),
+                               "v": jnp.zeros((cap,), jnp.float32)})
+    states = {g: make_ffat_tb_state(spec, K, NP) for g in steps}
+    rngs = {g: np.random.default_rng(11) for g in steps}
+    for i in range(4):
+        outs = {}
+        for g, step in steps.items():
+            keys, vals, ts, valid = next(
+                _random_batches(rngs[g], cap, K, 1, ts_jitter=True))
+            wm = jnp.int64((i + 1) * cap * 1000 // P_usec - R)
+            states[g], out, fired, out_ts, n_adv = step(
+                states[g], {"k": keys, "v": vals}, ts, valid, wm)
+            outs[g] = (out, fired, out_ts, n_adv)
+        for (a, b) in zip(jax.tree.leaves(outs["rank_scatter"]),
+                          jax.tree.leaves(outs["argsort"])):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        for (a, b) in zip(jax.tree.leaves(states["rank_scatter"]),
+                          jax.tree.leaves(states["argsort"])):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+# -- graph-level: config plumbing + oracle ---------------------------------
+
+N_KEYS = 3
+LENGTH = 240
+
+
+def _stream():
+    return [{"key": i % N_KEYS, "value": i, "ts": i * 1000}
+            for i in range(LENGTH)]
+
+
+def _oracle_cb(win, slide):
+    per_key = {}
+    for t in _stream():
+        per_key.setdefault(t["key"], []).append(t["value"])
+    exp = {}
+    for k, vals in per_key.items():
+        w = 0
+        while w * slide < len(vals):
+            seg = vals[w * slide: w * slide + win]
+            if seg:
+                exp[(k, w)] = sum(seg)
+            w += 1
+    return exp
+
+
+@pytest.mark.parametrize("grouping", ["rank_scatter", "argsort"])
+def test_graph_ffat_grouping_config(grouping):
+    import dataclasses
+
+    got = {}
+    src = (wf.Source_Builder(lambda: iter(_stream()))
+           .withTimestampExtractor(lambda t: t["ts"])
+           .withOutputBatchSize(31).build())
+    op = (wf.Ffat_WindowsTPU_Builder(lambda t: t["value"],
+                                     lambda a, b: a + b)
+          .withKeyBy(lambda t: t["key"]).withMaxKeys(N_KEYS)
+          .withCBWindows(16, 4).build())
+    snk = wf.Sink_Builder(
+        lambda r: got.__setitem__((r["key"], r["wid"]), r["value"])
+        if r is not None else None).build()
+    cfg = dataclasses.replace(wf.default_config, ffat_grouping=grouping)
+    g = wf.PipeGraph("grouping_cfg", wf.ExecutionMode.DEFAULT,
+                     wf.TimePolicy.EVENT, config=cfg)
+    g.add_source(src).add(op).add_sink(snk)
+    g.run()
+    assert got == _oracle_cb(16, 4)
+
+
+def test_unknown_grouping_rejected():
+    import dataclasses
+
+    src = (wf.Source_Builder(lambda: iter(_stream()))
+           .withTimestampExtractor(lambda t: t["ts"])
+           .withOutputBatchSize(31).build())
+    op = (wf.Ffat_WindowsTPU_Builder(lambda t: t["value"],
+                                     lambda a, b: a + b)
+          .withKeyBy(lambda t: t["key"]).withMaxKeys(N_KEYS)
+          .withCBWindows(16, 4).build())
+    snk = wf.Sink_Builder(lambda r: None).build()
+    cfg = dataclasses.replace(wf.default_config, ffat_grouping="bogus")
+    g = wf.PipeGraph("grouping_bad", wf.ExecutionMode.DEFAULT,
+                     wf.TimePolicy.EVENT, config=cfg)
+    g.add_source(src).add(op).add_sink(snk)
+    with pytest.raises(wf.WindFlowError, match="ffat_grouping"):
+        g.run()

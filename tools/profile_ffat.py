@@ -5,6 +5,7 @@ shapes) into its pipeline stages and times each as a standalone jitted
 program, so the dominant component is MEASURED before any kernel work:
 
   key_extract_argsort   stable argsort of the key lane (the sort pass)
+  grouping_rank_scatter the O(n) counting permutation (windows/grouping.py)
   sort_gather           argsort + payload/lift gather (sort + data motion)
   rank_scan             segment-start max-scan -> per-lane rank
   pane_cells            segmented scan + scatter into [K+1, NP] pane cells
@@ -47,6 +48,12 @@ def build_components(jax, jnp, CAP, K, Pn, R):
         keys = payload["k"]
         sk = jnp.where(valid & (keys >= 0) & (keys < K), keys, K)
         return jnp.argsort(sk, stable=True)
+
+    def grouping_rank_scatter(payload, valid):
+        from windflow_tpu.windows.grouping import counting_order
+        keys = payload["k"]
+        sk = jnp.where(valid & (keys >= 0) & (keys < K), keys, K)
+        return counting_order(sk, K + 1)
 
     def sort_gather(payload, valid):
         keys = payload["k"]
@@ -107,6 +114,7 @@ def build_components(jax, jnp, CAP, K, Pn, R):
 
     return {
         "key_extract_argsort": key_extract_argsort,
+        "grouping_rank_scatter": grouping_rank_scatter,
         "sort_gather": sort_gather,
         "rank_scan": rank_scan,
         "pane_cells": pane_cells,
@@ -162,6 +170,7 @@ def main():
 
     arg_map = {
         "key_extract_argsort": (payload, valid),
+        "grouping_rank_scatter": (payload, valid),
         "sort_gather": (payload, valid),
         "rank_scan": (sk_sorted,),
         "pane_cells": (sk_sorted, v_sorted, pane_rel),
@@ -186,30 +195,34 @@ def main():
         rates.sort()
         return rates[len(rates) // 2]
 
-    # full step reference point (the bench kernel)
+    # full step reference points (the bench kernel), one per grouping
     from windflow_tpu.windows.ffat_kernels import (make_ffat_state,
                                                    make_ffat_step)
-    step = jax.jit(make_ffat_step(CAP, K, Pn, R, D, lambda x: x["v"],
-                                  lambda a, b: a + b, lambda x: x["k"]))
-    state = jax.device_put(
-        make_ffat_state(jnp.zeros((), jnp.float32), K, R), dev)
     ts = jax.device_put(jnp.arange(CAP, dtype=jnp.int64), dev)
+    full_by_grouping = {}
+    for grouping in ("rank_scatter", "argsort"):
+        step = jax.jit(make_ffat_step(CAP, K, Pn, R, D, lambda x: x["v"],
+                                      lambda a, b: a + b,
+                                      lambda x: x["k"], grouping=grouping))
+        state = jax.device_put(
+            make_ffat_state(jnp.zeros((), jnp.float32), K, R), dev)
 
-    def full(state):
-        st, out, fired, _ = step(state, payload, ts, valid)
-        return st
+        def full(state):
+            st, out, fired, _ = step(state, payload, ts, valid)
+            return st
 
-    st = full(state)
-    jax.block_until_ready(st)
-    rates = []
-    for _ in range(5):
-        t0 = time.perf_counter()
-        for _ in range(args.steps):
-            st = full(st)
+        st = full(state)
         jax.block_until_ready(st)
-        rates.append((time.perf_counter() - t0) / args.steps)
-    rates.sort()
-    full_s = rates[len(rates) // 2]
+        rates = []
+        for _ in range(5):
+            t0 = time.perf_counter()
+            for _ in range(args.steps):
+                st = full(st)
+            jax.block_until_ready(st)
+            rates.append((time.perf_counter() - t0) / args.steps)
+        rates.sort()
+        full_by_grouping[grouping] = rates[len(rates) // 2]
+    full_s = full_by_grouping["rank_scatter"]
 
     result = {
         "platform": platform, "device": str(dev),
@@ -217,9 +230,17 @@ def main():
                    "panes": NP1, "R": R},
         "full_step_ms": round(full_s * 1e3, 4),
         "full_step_tuples_per_sec": round(CAP / full_s, 1),
+        "full_step_argsort_ms": round(
+            full_by_grouping["argsort"] * 1e3, 4),
+        "full_step_argsort_tuples_per_sec": round(
+            CAP / full_by_grouping["argsort"], 1),
+        "rank_scatter_speedup": round(
+            full_by_grouping["argsort"] / full_s, 4),
         "components_ms": {},
         "note": ("components are timed standalone; inside the fused step "
-                 "XLA overlaps/fuses them, so shares are indicative"),
+                 "XLA overlaps/fuses them, so shares are indicative; "
+                 "full_step uses grouping=rank_scatter, "
+                 "full_step_argsort the comparison-sort baseline"),
     }
     for name, fn in comps.items():
         t = time_fn(fn, arg_map[name])

@@ -143,6 +143,16 @@ class Config:
     # per-tuple functions are GIL-bound, as in any CPython thread pool.
     host_worker_threads: int = int(os.environ.get("WF_TPU_HOST_WORKERS",
                                                   "0"))
+    # FFAT batch-grouping algorithm: "rank_scatter" (default) groups each
+    # batch by key with the O(n) dense-key counting permutation
+    # (windows/grouping.py — no comparison sort; the reference pays
+    # thrust::sort_by_key for the same grouping); "argsort" keeps the
+    # stable-comparison-sort baseline (bit-identical results, both order
+    # by (key, arrival)).  Time-based steps whose (key, pane) id space
+    # exceeds int32 (max_keys * pane_capacity >= 2^31) fall back to
+    # argsort regardless — the counting ids are int32.
+    ffat_grouping: str = os.environ.get("WF_TPU_FFAT_GROUPING",
+                                        "rank_scatter")
     # Multi-chip execution: a jax.sharding.Mesh with ("data", "key") axes
     # (see windflow_tpu.parallel.mesh.make_mesh).  When set, staging emitters
     # lay batches out data-sharded across the mesh and mesh-aware TPU

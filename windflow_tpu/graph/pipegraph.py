@@ -188,6 +188,7 @@ class PipeGraph:
                     op.ordinal = len(self._operators)  # stable topo index
                     self._operators.append(op)
                     op.mesh = self.config.mesh
+                    op.config = self.config
                     op.build_replicas(self.mode, self.time_policy)
         for op in self._operators:
             self._all_replicas.extend(op.replicas)

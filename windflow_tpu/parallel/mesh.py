@@ -301,7 +301,8 @@ def _ffat_shard_layout(mesh: Mesh, capacity: int, K: int):
 def make_sharded_ffat_step(mesh: Mesh, capacity: int, K: int, Pn: int, R: int,
                            D: int, lift: Callable, comb: Callable,
                            key_fn: Optional[Callable],
-                           sum_like: bool = False):
+                           sum_like: bool = False,
+                           grouping: str = "rank_scatter"):
     """Compile one FFAT window step sharded over the mesh.
 
     State tables are split along ``key`` (chip *i* owns keys
@@ -312,7 +313,7 @@ def make_sharded_ffat_step(mesh: Mesh, capacity: int, K: int, Pn: int, R: int,
     K_local, key_base_fn, gather = _ffat_shard_layout(mesh, capacity, K)
     step_local = make_ffat_step(capacity, K_local, Pn, R, D, lift, comb,
                                 key_fn, key_base_fn=key_base_fn,
-                                sum_like=sum_like)
+                                sum_like=sum_like, grouping=grouping)
 
     def local(state, payload, ts, valid):
         payload, ts, valid = gather(payload, ts, valid)
@@ -441,7 +442,8 @@ def make_sharded_ffat_tb_state(agg_spec, K: int, NP: int, mesh: Mesh):
 def make_sharded_ffat_tb_step(mesh: Mesh, capacity: int, K: int, P_usec: int,
                               R: int, D: int, NP: int, lift: Callable,
                               comb: Callable, key_fn: Optional[Callable],
-                              drop_tainted: bool = False):
+                              drop_tainted: bool = False,
+                              grouping: str = "rank_scatter"):
     """Compile one time-based FFAT step sharded over the mesh.
 
     Same layout as the CB variant (:func:`make_sharded_ffat_step`): state
@@ -455,7 +457,8 @@ def make_sharded_ffat_tb_step(mesh: Mesh, capacity: int, K: int, P_usec: int,
     step_local = make_ffat_tb_step(capacity, K_local, P_usec, R, D, NP,
                                    lift, comb, key_fn,
                                    key_base_fn=key_base_fn,
-                                   drop_tainted=drop_tainted)
+                                   drop_tainted=drop_tainted,
+                                   grouping=grouping)
 
     def local(state, payload, ts, valid, wm_pane):
         payload, ts, valid = gather(payload, ts, valid)

@@ -14,6 +14,16 @@ import jax
 import jax.numpy as jnp
 
 from windflow_tpu.utils.dtypes import cast_state_update
+from windflow_tpu.windows.grouping import counting_order
+
+
+def _group_order(ids, nbuckets: int, grouping: str):
+    """Stable grouping permutation: ``rank_scatter`` is the O(n) dense-key
+    counting sort (grouping.py), ``argsort`` the comparison-sort baseline
+    it is bit-identical to (both order by (id, arrival))."""
+    if grouping == "rank_scatter":
+        return counting_order(ids, nbuckets)
+    return jnp.argsort(ids, stable=True)
 
 
 def _seg_scan(comb, flags, values):
@@ -150,7 +160,7 @@ def make_ffat_step(capacity: int, K: int, P: int, R: int, D: int,
                    lift: Callable, comb: Callable,
                    key_fn: Optional[Callable],
                    key_base_fn: Optional[Callable[[], Any]] = None,
-                   sum_like: bool = False):
+                   sum_like: bool = False, grouping: str = "rank_scatter"):
     """Build the (un-jitted) FFAT per-batch program.
 
     Pure-function form of the operator step so the multi-chip layer
@@ -183,7 +193,7 @@ def make_ffat_step(capacity: int, K: int, P: int, R: int, D: int,
             keys = keys - jnp.int32(kb)
         ok = valid & (keys >= 0) & (keys < K)
         skey_for_sort = jnp.where(ok, keys, K)
-        order = jnp.argsort(skey_for_sort, stable=True)
+        order = _group_order(skey_for_sort, K + 1, grouping)
         sk = skey_for_sort[order]
         slift = jax.tree.map(lambda a: a[order],
                              jax.vmap(lift)(payload))
@@ -216,13 +226,17 @@ def make_ffat_step(capacity: int, K: int, P: int, R: int, D: int,
         cell_has = jnp.zeros((K + 1, NP1), bool) \
             .at[row, col].set(ends)[:K]
 
-        # merge continuation cell with the carried partial pane
-        def merge0(cur_leaf, cell_leaf):
-            both = comb(cur_leaf, cell_leaf[:, 0])
+        # merge continuation cell with the carried partial pane; comb is a
+        # WHOLE-PYTREE combiner (cross-leaf combines are legal — matrix
+        # products etc.), so it runs once on the tree, not per leaf
+        cell0 = jax.tree.map(lambda cl: cl[:, 0], cells)
+        both0 = comb(state["cur"], cell0)
+
+        def merge0(cur_leaf, cell_leaf, both_leaf):
             use_cur = state["cur_valid"]
             use_cell = cell_has[:, 0]
-            v = jnp.where(_b(use_cur & use_cell, both), both,
-                          jnp.where(_b(use_cur, both), cur_leaf,
+            v = jnp.where(_b(use_cur & use_cell, both_leaf), both_leaf,
+                          jnp.where(_b(use_cur, both_leaf), cur_leaf,
                                     cell_leaf[:, 0]))
             # carried state may be wider than the batch-derived cells (e.g.
             # an f64 agg_spec under x64 vs f32 lifts); the cell dtype is
@@ -230,9 +244,7 @@ def make_ffat_step(capacity: int, K: int, P: int, R: int, D: int,
             # and a kind-crossing cast is state corruption (utils.dtypes)
             return cell_leaf.at[:, 0].set(
                 cast_state_update(v, cell_leaf.dtype, "FFAT pane merge"))
-        cells = jax.tree.map(
-            lambda cur_leaf, cell_leaf: merge0(cur_leaf, cell_leaf),
-            state["cur"], cells)
+        cells = jax.tree.map(merge0, state["cur"], cells, both0)
 
         m_k = ((state["cur_fill"] + n_k) // P).astype(jnp.int32)
         new_fill = ((state["cur_fill"] + n_k) % P).astype(jnp.int32)
@@ -352,7 +364,8 @@ def make_ffat_tb_step(capacity: int, K: int, P_usec: int, R: int, D: int,
                       NP: int, lift: Callable, comb: Callable,
                       key_fn: Optional[Callable],
                       key_base_fn: Optional[Callable[[], Any]] = None,
-                      drop_tainted: bool = False):
+                      drop_tainted: bool = False,
+                      grouping: str = "rank_scatter"):
     """Time-based FFAT per-batch program.
 
     Window ``w`` covers panes ``[w*D, w*D + R)`` — times
@@ -524,7 +537,10 @@ def make_ffat_tb_step(capacity: int, K: int, P_usec: int, R: int, D: int,
         rel_c = jnp.clip(rel, 0, NP - 1).astype(jnp.int32)
         sid = jnp.where(ok, keys.astype(jnp.int64) * NP + rel_c,
                         jnp.int64(K) * NP)
-        order = jnp.argsort(sid, stable=True)
+        if K * NP + 1 < (1 << 31):   # counting ids are int32
+            order = _group_order(sid.astype(jnp.int32), K * NP + 1, grouping)
+        else:
+            order = jnp.argsort(sid, stable=True)
         ssid = sid[order]
         slift = jax.tree.map(lambda a: a[order], jax.vmap(lift)(payload))
         starts = jnp.concatenate([jnp.array([True]), ssid[1:] != ssid[:-1]])
@@ -540,12 +556,15 @@ def make_ffat_tb_step(capacity: int, K: int, P_usec: int, R: int, D: int,
         partial = jax.tree.map(scat, scanned)
         partial_has = jnp.zeros((K + 1, NP), bool).at[row, col].set(ends)[:K]
 
-        def merge(old_leaf, new_leaf):
-            both = comb(old_leaf, new_leaf)
-            return jnp.where(_b(cell_valid & partial_has, both), both,
-                             jnp.where(_b(partial_has, both), new_leaf,
+        # comb is a whole-pytree combiner (see CB merge above)
+        both_cells = comb(cells, partial)
+
+        def merge(old_leaf, new_leaf, both_leaf):
+            return jnp.where(_b(cell_valid & partial_has, both_leaf),
+                             both_leaf,
+                             jnp.where(_b(partial_has, both_leaf), new_leaf,
                                        old_leaf))
-        cells = jax.tree.map(merge, cells, partial)
+        cells = jax.tree.map(merge, cells, partial, both_cells)
         cell_valid = cell_valid | partial_has
 
         # 4. pass B: fire what this batch completed under the watermark

@@ -200,24 +200,36 @@ class FfatWindowsTPU(Operator):
                     self.mesh, capacity, self.max_keys, self.P, self.R,
                     self.D, self.NP, self.lift, self.comb,
                     self.key_extractor,
-                    drop_tainted=self.overflow_policy == "drop")
+                    drop_tainted=self.overflow_policy == "drop",
+                    grouping=self._grouping())
             return make_sharded_ffat_step(
                 self.mesh, capacity, self.max_keys, self.P, self.R, self.D,
                 self.lift, self.comb, self.key_extractor,
-                sum_like=self.sum_like)
+                sum_like=self.sum_like, grouping=self._grouping())
         if self.is_tb:
             step = make_ffat_tb_step(capacity, self.max_keys, self.P,
                                      self.R, self.D, self.NP,
                                      self.lift, self.comb,
                                      self.key_extractor,
                                      drop_tainted=self.overflow_policy
-                                     == "drop")
+                                     == "drop",
+                                     grouping=self._grouping())
         else:
             step = make_ffat_step(capacity, self.max_keys, self.P, self.R,
                                   self.D, self.lift, self.comb,
                                   self.key_extractor,
-                                  sum_like=self.sum_like)
+                                  sum_like=self.sum_like,
+                                  grouping=self._grouping())
         return jax.jit(step, donate_argnums=(0,))
+
+    def _grouping(self) -> str:
+        """Batch-grouping algorithm from the graph config (rank_scatter |
+        argsort — Config.ffat_grouping), validated at step-build time."""
+        mode = getattr(self.config, "ffat_grouping", "rank_scatter")
+        if mode not in ("rank_scatter", "argsort"):
+            raise WindFlowError(
+                f"unknown ffat_grouping '{mode}' (rank_scatter | argsort)")
+        return mode
 
     # -- operator plumbing ---------------------------------------------------
     @property
