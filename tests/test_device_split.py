@@ -88,7 +88,6 @@ def test_python_split_to_host_branches_ok_with_tpu_branch_elsewhere():
     keeps working even when another branch is device-only — the host
     fallback raises lazily, per routed tuple, not eagerly at the first
     device batch."""
-    import pytest
     host_seen = []
     g = wf.PipeGraph("lazy_split_guard")
     src = (wf.Source_Builder(lambda: iter({"v": i} for i in range(128)))

@@ -159,6 +159,76 @@ def test_tb_step_bitwise_equal_across_groupings(comb_kind):
             np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
 
 
+def test_cb_step_scatter_add_fast_path_matches_sorted():
+    """sum_like + rank_scatter takes the scatter-add bypass (no
+    permutation); with integer-valued floats addition is exact in any
+    order, so outputs and state must EQUAL the argsort path's."""
+    cap, K, P, R, D = 96, 5, 4, 4, 1
+    lift, comb = (lambda x: x["v"]), (lambda a, b: a + b)
+    key_fn = lambda x: x["k"]
+    steps = {
+        g: jax.jit(make_ffat_step(cap, K, P, R, D, lift, comb, key_fn,
+                                  sum_like=True, grouping=g))
+        for g in ("rank_scatter", "argsort")
+    }
+    spec = agg_spec_for(lift, {"k": jnp.zeros((cap,), jnp.int32),
+                               "v": jnp.zeros((cap,), jnp.float32)})
+    states = {g: make_ffat_state(spec, K, R) for g in steps}
+    rng = np.random.default_rng(23)
+    for _ in range(5):
+        n = rng.integers(cap // 2, cap + 1)
+        keys = rng.integers(0, K + 2, cap)
+        vals = rng.integers(0, 1000, cap).astype(np.float32)
+        valid = np.zeros(cap, bool)
+        valid[:n] = True
+        batch = ({"k": jnp.asarray(keys, jnp.int32),
+                  "v": jnp.asarray(vals)},
+                 jnp.asarray(np.arange(cap, dtype=np.int64)),
+                 jnp.asarray(valid))
+        outs = {}
+        for g, step in steps.items():
+            states[g], out, fired, out_ts = step(states[g], *batch)
+            outs[g] = (out, fired, out_ts)
+        for (a, b) in zip(jax.tree.leaves(outs["rank_scatter"]),
+                          jax.tree.leaves(outs["argsort"])):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        for (a, b) in zip(jax.tree.leaves(states["rank_scatter"]),
+                          jax.tree.leaves(states["argsort"])):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_cb_step_scatter_add_float_tolerance():
+    """Random floats: scatter-add order may differ, so results are close,
+    not bitwise (the psum tolerance the declaration implies)."""
+    cap, K, P, R, D = 128, 7, 4, 8, 2
+    lift, comb = (lambda x: x["v"]), (lambda a, b: a + b)
+    key_fn = lambda x: x["k"]
+    steps = {
+        g: jax.jit(make_ffat_step(cap, K, P, R, D, lift, comb, key_fn,
+                                  sum_like=True, grouping=g))
+        for g in ("rank_scatter", "argsort")
+    }
+    spec = agg_spec_for(lift, {"k": jnp.zeros((cap,), jnp.int32),
+                               "v": jnp.zeros((cap,), jnp.float32)})
+    states = {g: make_ffat_state(spec, K, R) for g in steps}
+    rng = np.random.default_rng(29)
+    for i in range(4):
+        keys = rng.integers(0, K, cap)
+        vals = rng.random(cap).astype(np.float32)
+        batch = ({"k": jnp.asarray(keys, jnp.int32), "v": jnp.asarray(vals)},
+                 jnp.asarray(np.arange(cap, dtype=np.int64)),
+                 jnp.ones(cap, bool))
+        outs = {}
+        for g, step in steps.items():
+            states[g], out, fired, out_ts = step(states[g], *batch)
+            outs[g] = (out, fired)
+        np.testing.assert_array_equal(np.asarray(outs["rank_scatter"][1]),
+                                      np.asarray(outs["argsort"][1]))
+        np.testing.assert_allclose(
+            np.asarray(outs["rank_scatter"][0]["value"]),
+            np.asarray(outs["argsort"][0]["value"]), rtol=1e-5, atol=1e-4)
+
+
 # -- graph-level: config plumbing + oracle ---------------------------------
 
 N_KEYS = 3

@@ -175,3 +175,27 @@ def test_operator_error_propagates_and_releases_pool():
             g.run()
         assert g._pool is None
         assert g._monitor is None
+
+
+def test_source_start_failure_releases_pool():
+    """start() failing AFTER the worker pool exists (a source generator
+    factory raising) must shut the non-daemon pool down, not leak its
+    threads (advisor r4)."""
+    class BootBoom(RuntimeError):
+        pass
+
+    def bad_gen():
+        raise BootBoom("generator factory failed")
+
+    cfg = wf.Config(host_worker_threads=2)
+    g = wf.PipeGraph("start_err", wf.ExecutionMode.DEFAULT, config=cfg)
+    g.add_source(wf.Source_Builder(bad_gen)
+                 .withOutputBatchSize(32).build()) \
+     .add(wf.Map(lambda t: t)) \
+     .add_sink(wf.Sink_Builder(lambda t: None).build())
+    with pytest.raises(BootBoom):
+        g.run()
+    assert g._pool is None
+    alive = [t.name for t in threading.enumerate()
+             if t.name.startswith("wf-start_err")]
+    assert not alive, alive

@@ -357,9 +357,12 @@ class ReduceTPU_Builder(_BuilderBase):
         return self
 
     def withSumCombiner(self):
-        """Declare the combiner sum-like (zero-absorbing on every leaf), so
-        the cross-chip combine can ride ``lax.psum`` instead of
-        all_gather + fold.  Mesh execution only."""
+        """Declare the combiner leafwise ADDITION (``comb(a, b) == a + b``
+        on every leaf), so the cross-chip combine can ride ``lax.psum``
+        instead of all_gather + fold.  This is strictly additive, not
+        merely zero-absorbing: psum literally sums partials without
+        calling ``comb``, so any other combiner (max, min, ...) silently
+        computes sums — do not declare it.  Mesh execution only."""
         self._sum_like = True
         return self
 
@@ -552,13 +555,20 @@ class Ffat_WindowsTPU_Builder(_WindowBuilderBase):
         return self
 
     def withSumCombiner(self):
-        """Declare the combiner zero-absorbing on every leaf
-        (``comb(x, 0) == x`` — sum and friends): count-based windows then
-        run a flagless sliding fold with half the operand traffic.  Same
-        declaration knob as ReduceTPU_Builder.withSumCombiner.  CB-only:
-        the TB firing path already folds over value panes without
-        per-operand flags, so the declaration has nothing to speed up
-        there (``build()`` warns if combined with ``withTBWindows``)."""
+        """Declare the combiner leafwise ADDITION (``comb(a, b) == a + b``
+        on every leaf — the same strictly-additive contract as
+        ReduceTPU_Builder.withSumCombiner, whose mesh path rides
+        ``lax.psum``): count-based windows then run a flagless sliding
+        fold with half the operand traffic AND, under the default
+        ``rank_scatter`` grouping, skip the batch permutation entirely —
+        lifts scatter-add straight into pane cells (float rounding order
+        may differ from the sequential fold, exactly as under psum).
+        Strictly additive: a merely zero-absorbing combiner (max over
+        non-negatives, ...) would silently compute sums on the
+        scatter-add path — do not declare it.  CB-only: the TB firing
+        path already folds over value panes without per-operand flags, so
+        the declaration has nothing to speed up there (``build()`` warns
+        if combined with ``withTBWindows``)."""
         self._sum_like = True
         return self
 

@@ -298,14 +298,23 @@ class PipeGraph:
             raise WindFlowError("PipeGraph already started")
         self._started = True
         self._build()
-        if self.config.tracing_enabled:
-            # reference: tracing spawns a MonitoringThread at run()
-            # (pipegraph.hpp:676-678)
-            from windflow_tpu.monitoring.monitor import MonitoringThread
-            self._monitor = MonitoringThread(self)
-            self._monitor.start()
-        for sr in self._source_replicas:
-            sr.start()
+        try:
+            if self.config.tracing_enabled:
+                # reference: tracing spawns a MonitoringThread at run()
+                # (pipegraph.hpp:676-678)
+                from windflow_tpu.monitoring.monitor import MonitoringThread
+                self._monitor = MonitoringThread(self)
+                self._monitor.start()
+            for sr in self._source_replicas:
+                sr.start()
+        except BaseException:
+            # _build() created the (non-daemon) worker pool; a failing
+            # monitor/source start must not leak its threads.  Streaming
+            # deployments that drive step() directly instead of wait_end()
+            # carry the same duty: call _finalize(dump=False) when
+            # abandoning a started graph on error.
+            self._finalize(dump=False)
+            raise
 
     def step(self) -> bool:
         """One scheduler sweep: pull a chunk from each live source (unless
@@ -360,7 +369,14 @@ class PipeGraph:
 
     def _backpressured(self) -> bool:
         """True when any replica inbox is at the in-transit cap.  Also folds
-        the high-water marks reported by :meth:`stats`."""
+        the high-water marks reported by :meth:`stats`.
+
+        The ``inflight_device``/``inbox`` reads are deliberately lock-free:
+        pool threads mutate them under the replica's inflight lock, but
+        CPython guarantees tear-free reads, so throttling sees an at most
+        one-sweep-stale value — the cap is a soft bound, not an invariant,
+        and taking K locks per sweep would serialize the pool on its
+        hottest path."""
         cfg = self.config
         hit = False
         for rep in self._all_replicas:

@@ -217,7 +217,7 @@ class KSlackCollector(Collector):
 
     def __init__(self, num_channels: int) -> None:
         super().__init__(num_channels)
-        self._heap: List = []  # (ts, seq, item, wm)
+        self._heap: List = []  # (ts, seq, item, wm, shared)
         self._seq = 0
         self._k = 0
         self._max_ts = WM_NONE

@@ -14,7 +14,7 @@ import jax
 import jax.numpy as jnp
 
 from windflow_tpu.utils.dtypes import cast_state_update
-from windflow_tpu.windows.grouping import counting_order
+from windflow_tpu.windows.grouping import DIGIT, counting_order, dense_rank
 
 
 def _group_order(ids, nbuckets: int, grouping: str):
@@ -179,10 +179,23 @@ def make_ffat_step(capacity: int, K: int, P: int, R: int, D: int,
     running sum + searchsorted — never a dense-grid scatter (a dense-grid
     device→host copy per step would dominate any end-to-end pipeline; the
     reference's ``numWinsPerBatch`` output buffer is likewise sized to
-    fired windows, not the worst case, ``flatfat_gpu.hpp:60-139``)."""
+    fired windows, not the worst case, ``flatfat_gpu.hpp:60-139``).
+
+    Declared-sum fast path: ``sum_like`` declares the combiner leafwise
+    addition-compatible (the same contract the mesh reduce commits to when
+    it rides ``lax.psum``, parallel/mesh.py), so with ``rank_scatter``
+    grouping the step skips the permutation entirely — each lane's
+    within-key rank (grouping.dense_rank) gives its pane cell and lifts
+    scatter-ADD straight into the [K, NP1] grid.  No sorted layout, no
+    segmented scan, no run-end detection.  Addition is commutative, so
+    only float rounding order differs from the sequential fold (exactly
+    the tolerance psum already implies)."""
     NP1 = capacity // P + 2           # pane cells incl. continuation cell
     # total fired across all keys: sum_k panes_k/D + per-key partials
     MAXO = capacity // (P * D) + 2 * K + 8
+    # the direct scatter-add needs a single-digit dense rank
+    scatter_add = (sum_like and grouping == "rank_scatter"
+                   and K + 1 <= DIGIT + 1)
 
     def step(state, payload, ts, valid):
         B = capacity
@@ -193,58 +206,87 @@ def make_ffat_step(capacity: int, K: int, P: int, R: int, D: int,
             keys = keys - jnp.int32(kb)
         ok = valid & (keys >= 0) & (keys < K)
         skey_for_sort = jnp.where(ok, keys, K)
-        order = _group_order(skey_for_sort, K + 1, grouping)
-        sk = skey_for_sort[order]
-        slift = jax.tree.map(lambda a: a[order],
-                             jax.vmap(lift)(payload))
-        pos = jnp.arange(B)
-        starts = jnp.concatenate([jnp.array([True]), sk[1:] != sk[:-1]])
-        seg_start_pos = jax.lax.associative_scan(
-            jnp.maximum, jnp.where(starts, pos, 0))
-        rank = pos - seg_start_pos
 
-        n_k = jax.ops.segment_sum(ok[order].astype(jnp.int32), sk,
-                                  num_segments=K + 1)[:K]
-        fill0 = state["cur_fill"][jnp.minimum(sk, K - 1)]
-        pane_rel = ((fill0 + rank) // P).astype(jnp.int32)
+        if scatter_add:
+            rank_p, counts, _, _ = dense_rank(skey_for_sort, K + 1)
+            rank_u = rank_p[:B]
+            n_k = counts[:K]
+            lifts = jax.vmap(lift)(payload)
+            fill0_u = state["cur_fill"][jnp.minimum(skey_for_sort, K - 1)]
+            col_u = jnp.where(
+                ok, ((fill0_u + rank_u) // P).astype(jnp.int32), 0)
 
-        # pane partials: segmented scan over (key, pane) runs
-        pane_starts = starts | jnp.concatenate(
-            [jnp.array([True]), pane_rel[1:] != pane_rel[:-1]])
-        scanned = _seg_scan(comb, pane_starts, slift)
-        ends = jnp.concatenate(
-            [(sk[1:] != sk[:-1]) | (pane_rel[1:] != pane_rel[:-1]),
-             jnp.array([True])])
-        # scatter segment-end partials into dense [K+1, NP1] cells
-        row = jnp.where(ends, sk, K)
-        col = jnp.where(ends, pane_rel, 0)
-        def scat(leaf):
-            buf = jnp.zeros((K + 1, NP1) + leaf.shape[1:], leaf.dtype)
-            return buf.at[row, col].set(
-                jnp.where(_b(ends, leaf), leaf, 0))[:K]
-        cells = jax.tree.map(scat, scanned)
-        cell_has = jnp.zeros((K + 1, NP1), bool) \
-            .at[row, col].set(ends)[:K]
+            def scat_add(leaf):
+                buf = jnp.zeros((K + 1, NP1) + leaf.shape[1:], leaf.dtype)
+                return buf.at[skey_for_sort, col_u].add(
+                    jnp.where(_b(ok, leaf), leaf, 0))[:K]
+            cells = jax.tree.map(scat_add, lifts)
 
-        # merge continuation cell with the carried partial pane; comb is a
-        # WHOLE-PYTREE combiner (cross-leaf combines are legal — matrix
-        # products etc.), so it runs once on the tree, not per leaf
-        cell0 = jax.tree.map(lambda cl: cl[:, 0], cells)
-        both0 = comb(state["cur"], cell0)
+            # carried partial pane merges by addition (empty cells hold
+            # the sum identity 0, so no has-mask is needed)
+            def merge0_add(cur_leaf, cell_leaf):
+                add = jnp.where(_b(state["cur_valid"], cur_leaf),
+                                cur_leaf, 0)
+                return cell_leaf.at[:, 0].add(cast_state_update(
+                    add, cell_leaf.dtype, "FFAT pane merge"))
+            cells = jax.tree.map(merge0_add, state["cur"], cells)
+        else:
+            order = _group_order(skey_for_sort, K + 1, grouping)
+            sk = skey_for_sort[order]
+            slift = jax.tree.map(lambda a: a[order],
+                                 jax.vmap(lift)(payload))
+            pos = jnp.arange(B)
+            starts = jnp.concatenate([jnp.array([True]), sk[1:] != sk[:-1]])
+            seg_start_pos = jax.lax.associative_scan(
+                jnp.maximum, jnp.where(starts, pos, 0))
+            rank = pos - seg_start_pos
 
-        def merge0(cur_leaf, cell_leaf, both_leaf):
-            use_cur = state["cur_valid"]
-            use_cell = cell_has[:, 0]
-            v = jnp.where(_b(use_cur & use_cell, both_leaf), both_leaf,
-                          jnp.where(_b(use_cur, both_leaf), cur_leaf,
-                                    cell_leaf[:, 0]))
-            # carried state may be wider than the batch-derived cells (e.g.
-            # an f64 agg_spec under x64 vs f32 lifts); the cell dtype is
-            # authoritative — a promoting scatter errors in future JAX,
-            # and a kind-crossing cast is state corruption (utils.dtypes)
-            return cell_leaf.at[:, 0].set(
-                cast_state_update(v, cell_leaf.dtype, "FFAT pane merge"))
-        cells = jax.tree.map(merge0, state["cur"], cells, both0)
+            n_k = jax.ops.segment_sum(ok[order].astype(jnp.int32), sk,
+                                      num_segments=K + 1)[:K]
+            fill0 = state["cur_fill"][jnp.minimum(sk, K - 1)]
+            pane_rel = ((fill0 + rank) // P).astype(jnp.int32)
+
+            # pane partials: segmented scan over (key, pane) runs
+            pane_starts = starts | jnp.concatenate(
+                [jnp.array([True]), pane_rel[1:] != pane_rel[:-1]])
+            scanned = _seg_scan(comb, pane_starts, slift)
+            ends = jnp.concatenate(
+                [(sk[1:] != sk[:-1]) | (pane_rel[1:] != pane_rel[:-1]),
+                 jnp.array([True])])
+            # scatter segment-end partials into dense [K+1, NP1] cells
+            row = jnp.where(ends, sk, K)
+            col = jnp.where(ends, pane_rel, 0)
+
+            def scat(leaf):
+                buf = jnp.zeros((K + 1, NP1) + leaf.shape[1:], leaf.dtype)
+                return buf.at[row, col].set(
+                    jnp.where(_b(ends, leaf), leaf, 0))[:K]
+            cells = jax.tree.map(scat, scanned)
+            cell_has = jnp.zeros((K + 1, NP1), bool) \
+                .at[row, col].set(ends)[:K]
+
+            # merge continuation cell with the carried partial pane; comb
+            # is a WHOLE-PYTREE combiner (cross-leaf combines are legal —
+            # matrix products etc.), so it runs once on the tree, not per
+            # leaf
+            cell0 = jax.tree.map(lambda cl: cl[:, 0], cells)
+            both0 = comb(state["cur"], cell0)
+
+            def merge0(cur_leaf, cell_leaf, both_leaf):
+                use_cur = state["cur_valid"]
+                use_cell = cell_has[:, 0]
+                v = jnp.where(_b(use_cur & use_cell, both_leaf), both_leaf,
+                              jnp.where(_b(use_cur, both_leaf), cur_leaf,
+                                        cell_leaf[:, 0]))
+                # carried state may be wider than the batch-derived cells
+                # (e.g. an f64 agg_spec under x64 vs f32 lifts); the cell
+                # dtype is authoritative — a promoting scatter errors in
+                # future JAX, and a kind-crossing cast is state corruption
+                # (utils.dtypes)
+                return cell_leaf.at[:, 0].set(
+                    cast_state_update(v, cell_leaf.dtype,
+                                      "FFAT pane merge"))
+            cells = jax.tree.map(merge0, state["cur"], cells, both0)
 
         m_k = ((state["cur_fill"] + n_k) // P).astype(jnp.int32)
         new_fill = ((state["cur_fill"] + n_k) % P).astype(jnp.int32)

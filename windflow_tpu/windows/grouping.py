@@ -44,16 +44,21 @@ CHUNK = 32
 DIGIT = 256
 
 
-def _single_digit_order(ids, nbuckets: int):
-    """Stable counting-sort permutation for ids in ``[0, nbuckets)``,
-    ``nbuckets`` one digit wide.  Returns gather indices ``order`` with
-    ``ids[order]`` sorted, ties in arrival order."""
+def dense_rank(ids, nbuckets: int):
+    """Per-lane rank among equal ids in arrival order, plus bucket counts.
+
+    ``rank[i]`` = number of earlier lanes with the same id; ``counts[b]`` =
+    occurrences of id ``b``.  The O(n) core shared by the permutation below
+    and the scatter-add fast path (``make_ffat_step`` with a declared-sum
+    combiner, which needs each tuple's position within its key but never a
+    sorted layout).  Returns ``(rank, counts, idsp, pos)`` where ``rank``,
+    ``idsp`` and ``pos`` are chunk-padded to length ``Bp >= B`` (padding
+    lanes rank 0.. in their own bucket past the real ones); callers slice
+    ``[:B]``."""
     B = ids.shape[0]
     C = CHUNK
     Bp = ((B + C - 1) // C) * C
-    # padding lanes go to a dedicated bucket AFTER every real one; being
-    # the last-arriving members of the last bucket they occupy the tail
-    # of the permutation, so ``order[:B]`` contains exactly the real lanes
+    # padding lanes count into a dedicated bucket after every real one
     nb = nbuckets + 1
     idsp = ids.astype(jnp.int32)
     if Bp != B:
@@ -70,15 +75,31 @@ def _single_digit_order(ids, nbuckets: int):
         shifted = jnp.pad(idsp, (d, 0))[:Bp]
         within = within + ((idsp == shifted) & (lane >= d))
 
-    # 2. per-chunk histograms + exclusive scans (chunk axis, bucket axis)
+    # 2. per-chunk histograms + exclusive scan across chunks
     flat = (pos // C) * nb + idsp
     hist = jnp.zeros(NB * nb, jnp.int32).at[flat].add(1).reshape(NB, nb)
     cross = lax.associative_scan(jnp.add, hist, axis=0) - hist
     counts = jnp.sum(hist, axis=0)
-    start = lax.associative_scan(jnp.add, counts) - counts
+    rank = within + cross.reshape(-1)[flat]
+    return rank, counts[:nbuckets], idsp, pos
+
+
+def _single_digit_order(ids, nbuckets: int):
+    """Stable counting-sort permutation for ids in ``[0, nbuckets)``,
+    ``nbuckets`` one digit wide.  Returns gather indices ``order`` with
+    ``ids[order]`` sorted, ties in arrival order."""
+    B = ids.shape[0]
+    rank, counts, idsp, pos = dense_rank(ids, nbuckets)
+    Bp = pos.shape[0]
+    # padding lanes went to the bucket AFTER every real one; being the
+    # last-arriving members of the last bucket they occupy the tail of
+    # the permutation, so ``order[:B]`` contains exactly the real lanes
+    allc = jnp.concatenate(
+        [counts, jnp.asarray([Bp - B], jnp.int32)])
+    start = lax.associative_scan(jnp.add, allc) - allc
 
     # 3. dest is a permutation of [0, Bp): invert by scattering iota
-    dest = start[idsp] + cross.reshape(-1)[flat] + within
+    dest = start[idsp] + rank
     order = jnp.zeros(Bp, jnp.int32).at[dest].set(pos, unique_indices=True)
     return order[:B]
 
