@@ -40,10 +40,13 @@ CONFIGS = {
     "tpu": dict(cap=262144, keys=1024, win=1024, slide=128,
                 warmup=6, steps=40, lat_steps=20,
                 e2e_tuples=16 * 262144, e2e_warm_tuples=2 * 262144),
-    # CPU fallback: smaller so a diagnostic number lands in minutes
+    # CPU fallback: smaller so a diagnostic number lands in minutes.
+    # e2e_tuples sized so per-run graph re-tracing (~0.6 s, memory
+    # round4-state) amortizes: at r5's ~4.5e6 tup/s steady the 64-batch
+    # run lasts ~1.5 s, putting the steady window at >half the run.
     "cpu": dict(cap=65536, keys=256, win=1024, slide=128,
                 warmup=2, steps=10, lat_steps=5,
-                e2e_tuples=16 * 65536, e2e_warm_tuples=2 * 65536),
+                e2e_tuples=64 * 65536, e2e_warm_tuples=2 * 65536),
 }
 
 
@@ -75,6 +78,60 @@ def probe_tpu() -> tuple:
                     "(axon tunnel unresponsive)")
         attempts.append({"at": stamp, "ok": False, "error": last})
     return False, last, attempts
+
+
+def a100_anchor(cap: int, K: int, win: int, slide: int) -> dict:
+    """Bandwidth-bound throughput ceiling of the REFERENCE's CUDA kernel
+    sequence at this bench shape, on A100-SXM-40GB (1.555e12 B/s HBM2e).
+
+    Per-tuple HBM byte model of the reference CB keyed path (one batch of
+    ``cap`` tuples, ``K`` keys; records 16 B — batch_item_gpu_t carries
+    tuple + u64 timestamp, win_result_t key + gwid + aggregate):
+      sort    thrust::sort_by_key radix over (i32 key, i32 seq): 4 passes
+              x read+write x 8 B   (ffat_replica_gpu.hpp:751; the keyed
+              emitter pays the same sort AGAIN, keyby_emitter_gpu.hpp:548
+              — not counted, keeping the ceiling conservative)
+      lift    read 16 + write 16   (Lifting_Kernel_CB_Keyed, :741)
+      add     leaf copy D2D read+write 16 (flatfat_gpu.hpp add_cb :226)
+      update  ~1 tree combine per inserted leaf: 2 reads + 1 write x 16
+              (Init/Update_TreeLevel_Kernel, flatfat_gpu.hpp:60-89)
+      results per window ~2*log2(win) node reads x 16 + 24 B result write
+              (Compute_Results_Kernel canonical-range walk,
+              flatfat_gpu.hpp:91-139), amortized over ``slide`` tuples
+    The ceiling assumes 100% of peak bandwidth with perfect overlap — a
+    real A100 run sits strictly below it."""
+    rec = 16
+    sort_b = 4 * 2 * 8
+    lift_b = 2 * rec
+    add_b = 2 * rec
+    update_b = 3 * rec
+    results_b = (2 * math.log2(win) * rec + 24) / slide
+    bytes_per_tuple = sort_b + lift_b + add_b + update_b + results_b
+    hbm = 1.555e12
+    ceiling = hbm / bytes_per_tuple
+    return {
+        "bytes_per_tuple": round(bytes_per_tuple, 1),
+        "components_bytes": {"sort": sort_b, "lift": lift_b, "add": add_b,
+                             "tree_update": update_b,
+                             "window_results": round(results_b, 2)},
+        "a100_hbm_b_s": hbm,
+        "a100_tps_ceiling": round(ceiling, 1),
+        "target_a100_tps": round(0.9 * ceiling, 1),
+    }
+
+
+def xla_bytes_accessed(jitted, state, batch) -> float:
+    """MEASURED per-step memory traffic from XLA's compiled cost analysis
+    (bytes accessed across all memory spaces), replacing the 16-B payload
+    floor of earlier rounds.  None when the backend doesn't report it."""
+    try:
+        comp = jitted.lower(state, *batch).compile()
+        ca = comp.cost_analysis()
+        d = ca[0] if isinstance(ca, (list, tuple)) else ca
+        val = d.get("bytes accessed")
+        return float(val) if val else None
+    except Exception:
+        return None
 
 
 def run_bench(platform: str, cfg: dict, jax) -> dict:
@@ -163,22 +220,33 @@ def run_bench(platform: str, cfg: dict, jax) -> dict:
         lats.append(time.perf_counter() - t1)
     p99_ms = float(np.percentile(np.array(lats) * 1e3, 99))
 
-    # Roofline anchor (the vs_baseline field only compares our own prior
-    # rounds): irreducible per-tuple payload traffic is ~16 B (i32 key +
-    # f32 value read + i64 ts read), so achieved payload bandwidth is a
-    # LOWER bound on HBM traffic — the step is argsort-dominated, whose
-    # multi-pass traffic multiplies it several-fold.  v5e peak HBM is
-    # ~819 GB/s; the fraction below is therefore a floor on utilization.
-    roofline = None
-    if platform == "tpu":
-        payload_gb_s = tuples_per_sec * 16 / 1e9
-        roofline = {
-            "payload_bytes_per_tuple": 16,
-            "payload_gb_s": round(payload_gb_s, 1),
-            "hbm_peak_gb_s": 819,
-            "hbm_fraction_floor": round(payload_gb_s / 819, 4),
-            "note": "argsort-dominated; sort passes multiply true traffic",
-        }
+    # Roofline + A100 anchor (BASELINE.md "Concrete A100 anchor" holds the
+    # full derivation).  target_a100_tps makes the ">= 90% of CUDA-A100"
+    # north star falsifiable: it is 90% of the bandwidth-bound CEILING of
+    # the reference's own kernel sequence at this exact shape — sort,
+    # lift, leaf copy, tree update, window walks (flatfat_gpu.hpp:60-139,
+    # ffat_replica_gpu.hpp:741-864) — on A100-SXM-40GB HBM (1.555 TB/s).
+    # A real A100 run sits below its ceiling, so beating the target beats
+    # the reference.  hbm_utilization uses XLA's MEASURED bytes-accessed
+    # for our step (not the 16-B payload floor of earlier rounds).
+    anchor = a100_anchor(CAP, K, cfg["win"], cfg["slide"])
+    step_bytes = xla_bytes_accessed(step, state, batches[0])
+    roofline = {
+        "target_a100_tps": anchor["target_a100_tps"],
+        "a100_ceiling_tps": anchor["a100_tps_ceiling"],
+        "a100_bytes_per_tuple_model": anchor["bytes_per_tuple"],
+        "vs_a100_target": round(tuples_per_sec
+                                / anchor["target_a100_tps"], 4),
+        "payload_bytes_per_tuple": 16,
+    }
+    if step_bytes is not None:
+        roofline["measured_bytes_per_step"] = step_bytes
+        roofline["measured_bytes_per_tuple"] = round(step_bytes / CAP, 1)
+        if platform == "tpu":
+            hbm_bw = 819e9  # v5e peak HBM
+            roofline["hbm_peak_gb_s"] = 819
+            roofline["hbm_utilization"] = round(
+                (tuples_per_sec / CAP) * step_bytes / hbm_bw, 4)
     return {
         "value": round(tuples_per_sec, 1),
         "methodology": "median_of_5_windows",
@@ -219,9 +287,97 @@ def _e2e_graph(cfg: dict, n_tuples: int, chunks, lat_sink):
     return g
 
 
+def _measure_e2e_graph(graph_factory, n_tuples: int, CAP: int,
+                       kernel_tps: float) -> dict:
+    """Time one ``PipeGraph.run()`` built by ``graph_factory(lat_sink)``
+    and estimate the steady-state rate (shared by the staged and
+    device-source e2e modes)."""
+    import numpy as np
+
+    lats = []
+    rows = [0]
+    first_out = [None]
+
+    def lat_sink(c):
+        if c is None:
+            return
+        if first_out[0] is None:
+            # first result: every program of the pipeline is now compiled
+            first_out[0] = time.perf_counter()
+        rows[0] += len(c)
+        now = time.time() * 1e6
+        tss = np.asarray(c.tss, np.float64)
+        tss = tss[tss > 0]      # EOS-flush rows carry ts=0: not steady-state
+        if len(tss):
+            lats.append(now - tss)
+
+    g = graph_factory(lat_sink)
+    t0 = time.perf_counter()
+    g.run()
+    t_end = time.perf_counter()
+    elapsed = t_end - t0
+    # steady-state window: from the first sink result (compilation and
+    # first-batch warmup done) to the end; the first batch's tuples are out
+    # of the window.  The total number is reported alongside.  The steady
+    # estimate is only meaningful when the window covers a real share of
+    # the run — with few batches the deferred sink emits everything near
+    # EOS and the window collapses — otherwise fall back to the full-run
+    # number.
+    steady_s = (t_end - first_out[0]) if first_out[0] else elapsed
+    steady_tuples = max(1, n_tuples - CAP)
+    full_rate = n_tuples / elapsed
+    if steady_s < 0.2 * elapsed or n_tuples < 6 * CAP:
+        steady_rate, estimator = full_rate, "full_run_fallback"
+    else:
+        steady_rate, estimator = steady_tuples / steady_s, "steady"
+    # Sanity guard (VERDICT r3: a collapsed steady window once produced
+    # 4.96e8 tup/s on CPU — 140x the kernel rate, physically impossible):
+    # the pipeline can never beat its own kernel.  The guard is the
+    # kernel rate when known, else a loose multiple of the full-run rate
+    # — steady legitimately exceeds full-run by the trace-time share
+    # (r5: a 2x faster kernel shrank runs until tracing was half the
+    # elapsed time, and a 3x-full-rate guard rejected every honest
+    # steady reading; e2e_tuples was also raised to amortize).
+    implausible = (steady_rate > 2 * kernel_tps if kernel_tps
+                   else steady_rate > 10 * full_rate)
+    if estimator == "steady" and implausible:
+        estimator = (f"full_run_rejected_outlier"
+                     f"(steady={steady_rate:.3g})")
+        steady_rate = full_rate
+    lat_all = (np.concatenate(lats) if lats else np.array([0.0])) / 1e3
+    return {
+        "tuples_per_sec": round(steady_rate, 1),
+        "steady_estimator": estimator,
+        "tuples_per_sec_incl_compile": round(n_tuples / elapsed, 1),
+        "p99_window_latency_ms": round(float(np.percentile(lat_all, 99)), 3),
+        "p50_window_latency_ms": round(float(np.percentile(lat_all, 50)), 3),
+        "window_rows": rows[0],
+        "tuples": n_tuples,
+        "elapsed_s": round(elapsed, 3),
+    }
+
+
+def _median_of_runs(one_run, n_runs: int) -> dict:
+    """Repeat a whole-graph e2e measurement and report the median run with
+    dispersion — the kernel's median-of-windows methodology applied at the
+    run level (VERDICT r4 item 6: a single e2e run could not distinguish
+    the 0.86→0.74 ratio slide from noise)."""
+    runs = [one_run() for _ in range(n_runs)]
+    runs.sort(key=lambda r: r["tuples_per_sec"])
+    med = dict(runs[len(runs) // 2])
+    rates = [r["tuples_per_sec"] for r in runs]
+    med["dispersion"] = {
+        "runs": n_runs, "min": rates[0], "max": rates[-1],
+        "rel_spread": round((rates[-1] - rates[0])
+                            / med["tuples_per_sec"], 4),
+    }
+    return med
+
+
 def run_bench_e2e(platform: str, cfg: dict, jax,
                   kernel_tps: float = 0.0) -> dict:
-    """End-to-end framework throughput + p99 window latency.
+    """End-to-end framework throughput + p99 window latency, median of
+    ``BENCH_E2E_RUNS`` (default 3) full runs.
 
     Tuples enter as binary frame bytes (columnar native ingest) and leave
     through a columnar sink; INGRESS time stamps each tuple's arrival in
@@ -229,13 +385,14 @@ def run_bench_e2e(platform: str, cfg: dict, jax,
     arrival → window result latency through staging, emitters, the driver
     loop, device programs, and egress.  XLA's persistent compilation cache
     is enabled and a small warmup graph (same shapes) is run first so the
-    timed run measures the framework, not the compiler."""
+    timed runs measure the framework, not the compiler."""
     import numpy as np
 
     _setup_compile_cache(jax)
 
     CAP, K = cfg["cap"], cfg["keys"]
     n_tuples = int(os.environ.get("BENCH_E2E_TUPLES", cfg["e2e_tuples"]))
+    n_runs = int(os.environ.get("BENCH_E2E_RUNS", "3"))
     rng = np.random.default_rng(1)
 
     def make_blob(n):
@@ -257,65 +414,71 @@ def run_bench_e2e(platform: str, cfg: dict, jax,
                       lambda c: None)
     warm.run()
 
-    lats = []
-    rows = [0]
-    first_out = [None]
-
-    def lat_sink(c):
-        if c is None:
-            return
-        if first_out[0] is None:
-            # first result: every program of the pipeline is now compiled
-            first_out[0] = time.perf_counter()
-        rows[0] += len(c)
-        now = time.time() * 1e6
-        tss = np.asarray(c.tss, np.float64)
-        tss = tss[tss > 0]      # EOS-flush rows carry ts=0: not steady-state
-        if len(tss):
-            lats.append(now - tss)
-
     blob = make_blob(n_tuples)
-    g = _e2e_graph(cfg, n_tuples, chunker(blob), lat_sink)
-    t0 = time.perf_counter()
-    g.run()
-    t_end = time.perf_counter()
-    elapsed = t_end - t0
-    # steady-state window: from the first sink result (compilation and
-    # first-batch warmup done) to the end; the first batch's tuples are out
-    # of the window.  The total number is reported alongside.  The steady
-    # estimate is only meaningful when the window covers a real share of
-    # the run — with few batches the deferred sink emits everything near
-    # EOS and the window collapses — otherwise fall back to the full-run
-    # number.
-    steady_s = (t_end - first_out[0]) if first_out[0] else elapsed
-    steady_tuples = max(1, n_tuples - CAP)
-    full_rate = n_tuples / elapsed
-    if steady_s < 0.2 * elapsed or n_tuples < 6 * CAP:
-        steady_rate, estimator = full_rate, "full_run_fallback"
-    else:
-        steady_rate, estimator = steady_tuples / steady_s, "steady"
-    # Sanity guard (VERDICT r3: a collapsed steady window once produced
-    # 4.96e8 tup/s on CPU — 140x the kernel rate, physically impossible):
-    # the pipeline can never beat its own kernel, and a steady estimate
-    # far above the full-run rate means the window didn't cover the run.
-    # Reject such readings rather than record garbage.
-    implausible = (steady_rate > 3 * full_rate
-                   or (kernel_tps and steady_rate > 2 * kernel_tps))
-    if estimator == "steady" and implausible:
-        estimator = (f"full_run_rejected_outlier"
-                     f"(steady={steady_rate:.3g})")
-        steady_rate = full_rate
-    lat_all = (np.concatenate(lats) if lats else np.array([0.0])) / 1e3
-    return {
-        "tuples_per_sec": round(steady_rate, 1),
-        "steady_estimator": estimator,
-        "tuples_per_sec_incl_compile": round(n_tuples / elapsed, 1),
-        "p99_window_latency_ms": round(float(np.percentile(lat_all, 99)), 3),
-        "p50_window_latency_ms": round(float(np.percentile(lat_all, 50)), 3),
-        "window_rows": rows[0],
-        "tuples": n_tuples,
-        "elapsed_s": round(elapsed, 3),
-    }
+    return _median_of_runs(
+        lambda: _measure_e2e_graph(
+            lambda lat_sink: _e2e_graph(cfg, n_tuples, chunker(blob),
+                                        lat_sink),
+            n_tuples, CAP, kernel_tps),
+        n_runs)
+
+
+def run_bench_e2e_device(platform: str, cfg: dict, jax,
+                         kernel_tps: float = 0.0) -> dict:
+    """Device-resident-source e2e (VERDICT r4 item 3): the same pipeline
+    shape as :func:`run_bench_e2e` but the source batches are GENERATED ON
+    DEVICE (io/device_source.py), so no host→device staging is on the hot
+    path.  ``ratio_vs_kernel`` here measures pure framework dispatch
+    (driver loop, emitters, program launches); the gap between this and
+    the staged e2e number is the staging/link share — the decomposition
+    that turns the r3/r4 'link-bound' hypothesis into a measurement."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    import windflow_tpu as wf
+
+    _setup_compile_cache(jax)
+    CAP, K = cfg["cap"], cfg["keys"]
+    n_tuples = int(os.environ.get("BENCH_E2E_TUPLES", cfg["e2e_tuples"]))
+    n_runs = int(os.environ.get("BENCH_E2E_RUNS", "3"))
+    NB = max(1, n_tuples // CAP)
+    n_tuples = NB * CAP
+
+    def batch_fn(i):
+        # cheap on-device synth: lane-derived keys/values, index-mixed so
+        # batches differ; matches the staged blob's key range
+        lane = jnp.arange(CAP, dtype=jnp.int32)
+        mixed = (lane * 2654435761 + i * 40503) & 0x7FFFFFFF
+        return {"key": mixed % K,
+                "v0": (mixed % 1024).astype(jnp.float32) / 1024.0}
+
+    def build(lat_sink, nb=None):
+        src = (wf.DeviceSource_Builder(batch_fn)
+               .withCapacity(CAP).withNumBatches(nb or NB).build())
+        m = wf.MapTPU_Builder(
+            lambda t: {"key": t["key"], "v0": t["v0"] * 1.5 + 1.0}).build()
+        f = wf.FilterTPU_Builder(lambda t: (t["key"] & 7) != 7).build()
+        w = (wf.Ffat_WindowsTPU_Builder(lambda t: t["v0"],
+                                        lambda a, b: a + b)
+             .withCBWindows(cfg["win"], cfg["slide"])
+             .withKeyBy(lambda t: t["key"]).withMaxKeys(K).build())
+        snk = wf.Sink_Builder(lat_sink).withColumnarSink(defer=4).build()
+        g = wf.PipeGraph("bench_e2e_dev", wf.ExecutionMode.DEFAULT,
+                         wf.TimePolicy.INGRESS)
+        pipe = g.add_source(src)
+        pipe.add(m)
+        pipe.chain(f)
+        pipe.add(w).add_sink(snk)
+        return g
+
+    # warmup: compile the program shapes with a 2-batch stream (the
+    # staged path's e2e_warm_tuples idea — not a discarded full run)
+    warm_nb = min(2, NB)
+    _measure_e2e_graph(lambda ls: build(ls, nb=warm_nb),
+                       warm_nb * CAP, CAP, kernel_tps)
+    return _median_of_runs(
+        lambda: _measure_e2e_graph(build, n_tuples, CAP, kernel_tps),
+        n_runs)
 
 
 def scaling_step(jax, n: int, K: int, per_chip: int, seed: int = 2):
@@ -587,6 +750,34 @@ def main() -> None:
     except Exception as e:
         result["e2e_error"] = f"{type(e).__name__}: {e}"[:400]
 
+    # device-resident-source e2e: same pipeline, batches born in HBM — the
+    # staged-vs-device delta decomposes e2e overhead into staging/link
+    # share vs framework-dispatch share (VERDICT r4 item 3)
+    try:
+        e2e_dev = run_bench_e2e_device(platform, CONFIGS[platform], jax,
+                                       kernel_tps=result["value"])
+        e2e_dev["ratio_vs_kernel"] = round(
+            e2e_dev["tuples_per_sec"] / result["value"], 4) \
+            if result["value"] else 0.0
+        e2e = result.get("e2e")
+        if e2e:
+            staged, dev = e2e["tuples_per_sec"], e2e_dev["tuples_per_sec"]
+            if dev > 0 and staged > 0:
+                # per-tuple time decomposition: staged-run time = dispatch
+                # time + staging time (to first order)
+                stage_share = max(0.0, 1.0 - staged / dev)
+                e2e_dev["decomposition"] = {
+                    "staged_tps": staged,
+                    "device_source_tps": dev,
+                    "staging_share_of_staged_run": round(stage_share, 4),
+                    "note": ("device-source run has no host->device "
+                             "staging; the delta is the staging/link cost "
+                             "the staged e2e pays"),
+                }
+        result["e2e_device_source"] = e2e_dev
+    except Exception as e:
+        result["e2e_device_source_error"] = f"{type(e).__name__}: {e}"[:400]
+
     now = time.time()
     hist = load_history()
     runs = hist.setdefault(platform, [])
@@ -600,6 +791,7 @@ def main() -> None:
                  "sum_decl_value": result.get("sum_decl_value"),
                  "p99_batch_latency_ms": result["p99_batch_latency_ms"],
                  "e2e": result.get("e2e"),
+                 "e2e_device_source": result.get("e2e_device_source"),
                  "ysb": result.get("ysb"),
                  "t": now,
                  "recorded_at": time.strftime("%Y-%m-%d %H:%M:%S")})

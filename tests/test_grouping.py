@@ -197,6 +197,36 @@ def test_cb_step_scatter_add_fast_path_matches_sorted():
             np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
 
 
+def test_cb_step_scatter_add_wide_keyspace():
+    """K > one radix digit (256) still takes the scatter-add path (the
+    rank pass is a single counting sweep whatever K is; gate is 4096)."""
+    cap, K, P, R, D = 128, 300, 4, 4, 1
+    lift, comb = (lambda x: x["v"]), (lambda a, b: a + b)
+    key_fn = lambda x: x["k"]
+    steps = {
+        g: jax.jit(make_ffat_step(cap, K, P, R, D, lift, comb, key_fn,
+                                  sum_like=True, grouping=g))
+        for g in ("rank_scatter", "argsort")
+    }
+    spec = agg_spec_for(lift, {"k": jnp.zeros((cap,), jnp.int32),
+                               "v": jnp.zeros((cap,), jnp.float32)})
+    states = {g: make_ffat_state(spec, K, R) for g in steps}
+    rng = np.random.default_rng(31)
+    for _ in range(3):
+        keys = rng.integers(0, K, cap)
+        vals = rng.integers(0, 100, cap).astype(np.float32)
+        batch = ({"k": jnp.asarray(keys, jnp.int32), "v": jnp.asarray(vals)},
+                 jnp.asarray(np.arange(cap, dtype=np.int64)),
+                 jnp.ones(cap, bool))
+        outs = {}
+        for g, step in steps.items():
+            states[g], out, fired, out_ts = step(states[g], *batch)
+            outs[g] = (out, fired, out_ts)
+        for (a, b) in zip(jax.tree.leaves(outs["rank_scatter"]),
+                          jax.tree.leaves(outs["argsort"])):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
 def test_cb_step_scatter_add_float_tolerance():
     """Random floats: scatter-add order may differ, so results are close,
     not bitwise (the psum tolerance the declaration implies)."""

@@ -27,6 +27,7 @@ from windflow_tpu.batch import (DeviceBatch, HostBatch, Punctuation,
                                 device_to_host, host_to_device)
 from windflow_tpu.context import LocalStorage, RuntimeContext
 from windflow_tpu.graph.builders import (Ffat_Windows_Builder,
+                                         DeviceSource_Builder,
                                          Ffat_WindowsTPU_Builder,
                                          Filter_Builder, FilterTPU_Builder,
                                          FlatMap_Builder,
@@ -71,7 +72,8 @@ __all__ = [
     "PipeGraph", "Operator", "Replica", "Source", "Map", "Filter", "FlatMap",
     "Shipper", "Reduce", "Sink", "SinkColumns", "MapTPU", "FilterTPU", "ReduceTPU",
     "StatefulMapTPU", "StatefulFilterTPU",
-    "Source_Builder", "Map_Builder", "Filter_Builder", "FlatMap_Builder",
+    "Source_Builder", "DeviceSource_Builder", "Map_Builder",
+    "Filter_Builder", "FlatMap_Builder",
     "Reduce_Builder", "Sink_Builder", "MapTPU_Builder", "FilterTPU_Builder",
     "ReduceTPU_Builder",
     "WindowSpec", "WindowResult", "KeyedWindows", "ParallelWindows",

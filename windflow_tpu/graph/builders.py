@@ -131,6 +131,58 @@ class Source_Builder(_BuilderBase):
                       ts_extractor=self._ts_extractor)
 
 
+class DeviceSource_Builder(_BuilderBase):
+    """Source whose batches are generated ON DEVICE by a jitted program —
+    no host staging on the hot path (io/device_source.py; the reference
+    has no analogue: its GPU sources stage host tuples,
+    ``batch_gpu_t.hpp:51-229``).  ``batch_fn(i)`` is JAX-traceable,
+    int32 batch index -> payload pytree of [capacity] leaves."""
+
+    _default_name = "device_source"
+
+    def __init__(self, batch_fn: Callable) -> None:
+        super().__init__()
+        self._batch_fn = batch_fn
+        self._capacity = 0
+        self._n_batches = 0
+        self._ts_fn = None
+        self._wm_fn = None
+
+    def withCapacity(self, n: int):
+        """Lanes per generated batch (the compiled batch shape)."""
+        self._capacity = n
+        return self
+
+    def withNumBatches(self, n: int):
+        """Total batches across all replicas (replicas stride the index)."""
+        self._n_batches = n
+        return self
+
+    def withTimestampFn(self, ts_fn: Callable, wm_fn: Callable[[int], int]):
+        """EVENT time: ``ts_fn(i) -> int64[capacity]`` device lane (traced
+        into the generator program) + ``wm_fn(i) -> int`` host frontier —
+        the host never reads device lanes back to learn time."""
+        self._ts_fn = ts_fn
+        self._wm_fn = wm_fn
+        return self
+
+    def withKeyBy(self, *_):
+        raise WindFlowError("a Source has no input to key by")
+
+    def withRebalancing(self):
+        raise WindFlowError("a Source has no input to rebalance")
+
+    def withOutputBatchSize(self, n: int):
+        raise WindFlowError(
+            "DeviceSource batch size IS its capacity (withCapacity)")
+
+    def build(self):
+        from windflow_tpu.io.device_source import DeviceSource
+        return DeviceSource(self._batch_fn, self._capacity, self._n_batches,
+                            name=self._name, parallelism=self._parallelism,
+                            ts_fn=self._ts_fn, wm_fn=self._wm_fn)
+
+
 class Map_Builder(_BroadcastMixin, _BuilderBase):
     _default_name = "map"
 
@@ -560,7 +612,8 @@ class Ffat_WindowsTPU_Builder(_WindowBuilderBase):
         ReduceTPU_Builder.withSumCombiner, whose mesh path rides
         ``lax.psum``): count-based windows then run a flagless sliding
         fold with half the operand traffic AND, under the default
-        ``rank_scatter`` grouping, skip the batch permutation entirely —
+        ``rank_scatter`` grouping with ``withMaxKeys <= 4096`` (the bound
+        on the rank table), skip the batch permutation entirely —
         lifts scatter-add straight into pane cells (float rounding order
         may differ from the sequential fold, exactly as under psum).
         Strictly additive: a merely zero-absorbing combiner (max over

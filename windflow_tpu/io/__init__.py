@@ -1,3 +1,4 @@
 """Bulk IO: native-parsed ingestion sources (the framework's data loaders)."""
 
+from windflow_tpu.io.device_source import DeviceSource
 from windflow_tpu.io.frames import FrameSource

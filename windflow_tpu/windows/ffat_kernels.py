@@ -14,7 +14,7 @@ import jax
 import jax.numpy as jnp
 
 from windflow_tpu.utils.dtypes import cast_state_update
-from windflow_tpu.windows.grouping import DIGIT, counting_order, dense_rank
+from windflow_tpu.windows.grouping import counting_order, dense_rank
 
 
 def _group_order(ids, nbuckets: int, grouping: str):
@@ -193,9 +193,11 @@ def make_ffat_step(capacity: int, K: int, P: int, R: int, D: int,
     NP1 = capacity // P + 2           # pane cells incl. continuation cell
     # total fired across all keys: sum_k panes_k/D + per-key partials
     MAXO = capacity // (P * D) + 2 * K + 8
-    # the direct scatter-add needs a single-digit dense rank
-    scatter_add = (sum_like and grouping == "rank_scatter"
-                   and K + 1 <= DIGIT + 1)
+    # dense_rank runs one counting pass over K+1 buckets whatever K is;
+    # the gate only bounds its [capacity/CHUNK, K+1] chunk-histogram
+    # (int32) to a sane size — 4096 keys at the TPU bench capacity is a
+    # ~134 MB table.  Beyond it the permutation path still applies.
+    scatter_add = (sum_like and grouping == "rank_scatter" and K + 1 <= 4096)
 
     def step(state, payload, ts, valid):
         B = capacity
