@@ -120,6 +120,106 @@ def main() -> None:
     assert got_w == exp_w, (len(got_w), len(exp_w))
     print(f"proc {proc_id}: FFAT windows across {nproc} processes OK",
           flush=True)
+
+    # -- WHOLE PipeGraph.run() spanning the process boundary ---------------
+    # (VERDICT r4 item 5: drive the framework layers, not just the mesh
+    # primitives).  Every process builds the SAME graph over the multihost
+    # mesh; its Source yields only the tuples THIS process ingests, the
+    # staging emitter assembles global batches shard-locally, the
+    # key-sharded FFAT runs as a collective program, and each process's
+    # sink receives the windows of its OWN key shards.  Lockstep contract:
+    # identical batch cadence per process (equal stream lengths, count
+    # punctuation disabled) — the sharded steps are collective programs.
+    import dataclasses
+
+    import windflow_tpu as wf
+
+    KG, OBS, NBATCH = 8, 128, 4
+    local_cap = OBS // nproc
+    n_local = NBATCH * local_cap
+
+    def gen():
+        # global record g = (key g%KG, value g); process p ingests the
+        # odd/even interleave so both ingest streams are non-trivial
+        for j in range(n_local):
+            g = j * nproc + proc_id
+            yield {"k": g % KG, "v": float(g), "ts": g * 1000}
+
+    got = {}
+    src = (wf.Source_Builder(gen)
+           .withTimestampExtractor(lambda t: t["ts"])
+           .withOutputBatchSize(OBS).build())
+    win = (wf.Ffat_WindowsTPU_Builder(lambda t: t["v"],
+                                      lambda a, b: a + b)
+           .withKeyBy(lambda t: t["k"]).withMaxKeys(KG)
+           .withCBWindows(16, 8).build())
+    snk = wf.Sink_Builder(
+        lambda r: got.__setitem__((r["key"], r["wid"]), r["value"])
+        if r is not None else None).build()
+    cfg = dataclasses.replace(wf.default_config, mesh=mesh,
+                              punctuation_interval_usec=1 << 50)
+    g = wf.PipeGraph("dcn_graph", wf.ExecutionMode.DEFAULT,
+                     wf.TimePolicy.EVENT, config=cfg)
+    g.add_source(src).add(win).add_sink(snk)
+    g.run()
+
+    # oracle: the SAME graph run single-chip (no mesh) in-process over the
+    # LOGICAL staged lane order, restricted to this process's key range.
+    # Logical order under fully-sharded staging: lanes land at each
+    # process's (data, key) blocks in block-index order (batch.py
+    # _stage_soa), so logical block i of a batch holds a block-size run
+    # of the rows of the process owning key column i % kk.  A whole-graph
+    # oracle keeps EOS partial-window flush semantics identical by
+    # construction.
+    dd, kk = mesh.shape["data"], mesh.shape["key"]
+    n_blk, bsz = dd * kk, OBS // (dd * kk)
+    lk = kk // nproc
+    blocks_of = {p: [i for i in range(n_blk) if (i % kk) // lk == p]
+                 for p in range(nproc)}
+
+    def gen_logical():
+        for b in range(NBATCH):
+            for blk in range(n_blk):
+                p = (blk % kk) // lk
+                bi = blocks_of[p].index(blk)
+                for r_ in range(bsz):
+                    j = b * local_cap + bi * bsz + r_
+                    gidx = j * nproc + p
+                    yield {"k": gidx % KG, "v": float(gidx),
+                           "ts": gidx * 1000}
+
+    ref_got = {}
+    src_r = (wf.Source_Builder(gen_logical)
+             .withTimestampExtractor(lambda t: t["ts"])
+             .withOutputBatchSize(OBS).build())
+    win_r = (wf.Ffat_WindowsTPU_Builder(lambda t: t["v"],
+                                        lambda a, b: a + b)
+             .withKeyBy(lambda t: t["k"]).withMaxKeys(KG)
+             .withCBWindows(16, 8).build())
+    snk_r = wf.Sink_Builder(
+        lambda r: ref_got.__setitem__((r["key"], r["wid"]), r["value"])
+        if r is not None else None).build()
+    g_ref = wf.PipeGraph("dcn_graph_oracle", wf.ExecutionMode.DEFAULT,
+                         wf.TimePolicy.EVENT)
+    g_ref.add_source(src_r).add(win_r).add_sink(snk_r)
+    g_ref.run()
+    klo = proc_id * KG // nproc
+    khi = (proc_id + 1) * KG // nproc
+    exp_g = {kw: v for kw, v in ref_got.items() if klo <= kw[0] < khi}
+    if got.keys() != exp_g.keys():
+        print("DIFF only-got:", sorted(got.keys() - exp_g.keys())[:6],
+              "only-exp:", sorted(exp_g.keys() - got.keys())[:6],
+              flush=True)
+    else:
+        for kw in exp_g:
+            if abs(got[kw] - exp_g[kw]) >= 1e-4:
+                print("DIFF val", kw, got[kw], exp_g[kw], flush=True)
+    assert got.keys() == exp_g.keys(), (proc_id, len(got), len(exp_g))
+    for kw in exp_g:
+        assert abs(got[kw] - exp_g[kw]) < 1e-4, kw
+    print(f"proc {proc_id}: whole PipeGraph.run() across {nproc} "
+          f"processes OK ({len(got)} windows on local key shards)",
+          flush=True)
     print(f"proc {proc_id}: DCN_WORKER_OK", flush=True)
 
 

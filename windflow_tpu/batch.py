@@ -209,6 +209,30 @@ def _stage_soa(soa, tss, n: int, capacity: int, watermark: int,
     buffer, re-typed on device by a cached program; the validity mask is
     derived on device from ``n``, never transferred."""
     leaves, treedef = jax.tree.flatten(soa)
+    if isinstance(device, jax.sharding.Sharding) and jax.process_count() > 1:
+        # multi-host staging: `capacity` is the GLOBAL lane count; this
+        # process contributes its local slice (capacity / process_count
+        # lanes) and the global batch is assembled shard-locally — the
+        # graph-level form of parallel/multihost.stage_local.  Every
+        # process must stage batches in lockstep (same count, same order):
+        # the sharded programs downstream are collective.
+        nproc = jax.process_count()
+        local_cap = capacity // nproc
+        if n > local_cap:
+            raise ValueError(
+                f"local batch of {n} exceeds per-process capacity "
+                f"{local_cap} (= {capacity}/{nproc})")
+
+        def assemble(a):
+            a = _pad_leading(np.ascontiguousarray(a), local_cap)
+            return jax.make_array_from_process_local_data(
+                device, a, (capacity,) + a.shape[1:])
+
+        payload = jax.tree.map(assemble, soa)
+        ts = assemble(np.asarray(tss, dtype=np.int64))
+        valid = assemble(np.arange(local_cap) < n)
+        return DeviceBatch(payload, ts, valid, watermark=watermark,
+                           size=None, frontier=frontier)
     packable = (
         device is None or isinstance(device, jax.Device)
     ) and all(l.ndim == 1 and _packable_dtype(l.dtype) for l in leaves)
@@ -319,11 +343,29 @@ def device_to_columns(batch: DeviceBatch):
     return r[0]
 
 
+def _np_local(a):
+    """Device→host view of an array that may span processes (multi-host
+    run): a fully-addressable array transfers whole; otherwise this
+    process reads ONLY its addressable shards — deduplicated by shard
+    index (axis replication repeats content per device) and concatenated
+    in index order.  Each host's sink thereby consumes the rows its own
+    key shards produced (SURVEY §5.8: per-process sinks)."""
+    if isinstance(a, jax.Array) and not a.is_fully_addressable:
+        seen = {}
+        for s in a.addressable_shards:
+            key = tuple((sl.start or 0, sl.stop) for sl in s.index)
+            seen.setdefault(key, s.data)
+        parts = [np.asarray(d) for _, d in sorted(seen.items())]
+        return np.concatenate(parts, axis=0)
+    return np.asarray(a)
+
+
 def _egress_packable(batch: DeviceBatch):
     leaves, treedef = jax.tree.flatten(batch.payload)
     cap = batch.capacity
     ok = all(getattr(l, "ndim", 0) == 1 and l.shape[0] == cap
              and (_packable_dtype(l.dtype) or l.dtype == jnp.bool_)
+             and (not isinstance(l, jax.Array) or l.is_fully_addressable)
              for l in leaves)
     return ok, leaves, treedef, cap
 
@@ -419,15 +461,16 @@ def device_to_columns_multi(batches):
 
 
 def _columns_fallback(batch: DeviceBatch):
-    valid = np.asarray(batch.valid)
+    valid = _np_local(batch.valid)
     n = batch.known_size
-    if n is not None and bool(valid[:n].all()):
+    if n is not None and len(valid) == batch.capacity \
+            and bool(valid[:n].all()):
         # staged batches carry prefix validity: slice, no gather
-        cols = jax.tree.map(lambda a: np.asarray(a)[:n], batch.payload)
-        return cols, np.asarray(batch.ts)[:n]
+        cols = jax.tree.map(lambda a: _np_local(a)[:n], batch.payload)
+        return cols, _np_local(batch.ts)[:n]
     idx = np.nonzero(valid)[0]
-    cols = jax.tree.map(lambda a: np.asarray(a)[idx], batch.payload)
-    return cols, np.asarray(batch.ts)[idx]
+    cols = jax.tree.map(lambda a: _np_local(a)[idx], batch.payload)
+    return cols, _np_local(batch.ts)[idx]
 
 
 def device_to_host(batch: DeviceBatch) -> HostBatch:
@@ -438,11 +481,11 @@ def device_to_host(batch: DeviceBatch) -> HostBatch:
     the reference's single pinned D2H copy — and record construction uses
     ``tolist()`` + ``dict(zip(...))`` on the common flat-dict payload shape
     rather than per-tuple pytree calls."""
-    valid = np.asarray(batch.valid)
+    valid = _np_local(batch.valid)
     idx = np.nonzero(valid)[0]
-    tss = np.asarray(batch.ts)[idx].tolist()
+    tss = _np_local(batch.ts)[idx].tolist()
     if isinstance(batch.payload, dict):
-        cols = {n: np.asarray(a)[idx] for n, a in batch.payload.items()}
+        cols = {n: _np_local(a)[idx] for n, a in batch.payload.items()}
         if all(c.ndim == 1 for c in cols.values()):
             names = list(cols)
             items = [dict(zip(names, vals))
@@ -450,7 +493,7 @@ def device_to_host(batch: DeviceBatch) -> HostBatch:
             return HostBatch(items=items, tss=tss,
                              watermark=batch.watermark)
     treedef = jax.tree.structure(batch.payload)
-    cols = [np.asarray(leaf)[idx] for leaf in jax.tree.leaves(batch.payload)]
+    cols = [_np_local(leaf)[idx] for leaf in jax.tree.leaves(batch.payload)]
     items = [jax.tree.unflatten(treedef, [c[i] for c in cols])
              for i in range(len(idx))]
     # Unwrap 0-d numpy scalars for ergonomic host-side records.

@@ -86,7 +86,8 @@ class DeviceSourceReplica(BaseSourceReplica):
         self.stats.outputs_sent += self.op.capacity
         self.stats.device_programs_launched += 1
         self.emitter.emit_device_batch(
-            DeviceBatch(payload, ts, valid, watermark=self.current_wm))
+            DeviceBatch(payload, ts, valid, watermark=self.current_wm,
+                        size=self.op.capacity))
         self._i += self.op.parallelism
         self._count_toward_punctuation(self.op.capacity)
         return True

@@ -356,13 +356,31 @@ class DeviceStageEmitter(Emitter):
         # so downstream sharded programs consume them without a reshard
         # (parallel/mesh.py batch_sharding).
         self._stage_target = None
+        #: lanes THIS process contributes per staged batch: equals the
+        #: batch capacity single-process; on a multi-host mesh each of the
+        #: P processes stages capacity/P local lanes and the global batch
+        #: is assembled shard-locally (batch.py _stage_soa; SURVEY §5.8)
+        self._local_cap = output_batch_size
         if mesh is not None:
+            import jax as _jax
+
             from windflow_tpu.parallel.mesh import batch_sharding
             if output_batch_size % math.prod(mesh.devices.shape):
                 raise WindFlowError(
                     f"output batch size {output_batch_size} not divisible "
                     f"by the mesh's {math.prod(mesh.devices.shape)} devices")
             self._stage_target = batch_sharding(mesh)
+            if _jax.process_count() > 1:
+                # fully-sharded staging: each process's lanes land at its
+                # own (data, key) blocks (batch.py _stage_soa); consumers
+                # gather over both axes (mesh.py ingest="flat")
+                from jax.sharding import (NamedSharding,
+                                          PartitionSpec as _P)
+
+                from windflow_tpu.parallel.mesh import DATA_AXIS, KEY_AXIS
+                self._stage_target = NamedSharding(
+                    mesh, _P((DATA_AXIS, KEY_AXIS)))
+                self._local_cap = output_batch_size // _jax.process_count()
 
     def _advance_frontier(self, wm):
         if wm != WM_NONE and wm > self._frontier:
@@ -374,7 +392,7 @@ class DeviceStageEmitter(Emitter):
         # `tid` is dropped — device edges are DEFAULT-mode only.
         self._advance_frontier(wm)
         self._ob.add(item, ts, wm)
-        if len(self._ob.items) >= self.output_batch_size:
+        if len(self._ob.items) >= self._local_cap:
             self.flush(wm)
 
     def emit_columns(self, cols, tss, wm, row_wms=None):
@@ -389,7 +407,7 @@ class DeviceStageEmitter(Emitter):
                 row_wms[-1] = wm
         self._col_chunks.append((cols, tss, row_wms))
         self._col_rows += len(tss)
-        cap = self.output_batch_size
+        cap = self._local_cap
         if self._col_rows < cap:
             return
         names = list(self._col_chunks[0][0])

@@ -41,7 +41,8 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from windflow_tpu.basic import WindFlowError
 from windflow_tpu.batch import DeviceBatch, HostBatch, host_to_device
-from windflow_tpu.windows.ffat_kernels import (_b, _masked_reduce_last, _seg_scan,
+from windflow_tpu.windows.ffat_kernels import (_b, _masked_reduce_last,
+                                           _seg_scan, make_ffat_flush,
                                            make_ffat_state, make_ffat_step,
                                            make_ffat_tb_state,
                                            make_ffat_tb_step)
@@ -274,11 +275,26 @@ def make_sharded_keyed_reduce(mesh: Mesh, capacity: int, K: int,
 # key subset; here shards of one dense state table own key ranges).
 # ---------------------------------------------------------------------------
 
-def _ffat_shard_layout(mesh: Mesh, capacity: int, K: int):
+def _ffat_shard_layout(mesh: Mesh, capacity: int, K: int,
+                       ingest: str = "data"):
     """Shared guards + layout for key-sharded FFAT variants: returns
-    ``(K_local, key_base_fn, gather)`` where ``gather`` replicates the
-    data-sharded batch lanes across the ``data`` axis (one all_gather over
-    ICI; identity on a 1-wide data axis)."""
+    ``(K_local, key_base_fn, gather, batch_spec)``.
+
+    ``ingest`` picks the staged-batch layout the step consumes:
+
+    * ``"data"`` (single-host default): lanes split along ``data``,
+      replicated along ``key`` — ``gather`` is one all_gather over the
+      data axis, entirely within a host's ICI domain (identity on a
+      1-wide data axis).
+    * ``"flat"`` (multi-host graphs): lanes fully sharded over
+      ``(data, key)`` — the only layout a process can assemble from the
+      lanes IT ingested (batch.py ``_stage_soa``) — and ``gather``
+      reconstructs the logical lane order with an all_gather over
+      ``key`` then ``data`` (data-major block order = the logical
+      P((data, key)) order).  The key-axis hop crosses DCN; when ingest
+      can be key-aligned upstream (e.g. Kafka partition assignment per
+      host), prefer routing tuples to their key's owner and the
+      ``data`` layout instead."""
     kk = mesh.shape[KEY_AXIS]
     dd = mesh.shape[DATA_AXIS]
     if K % kk:
@@ -286,8 +302,27 @@ def _ffat_shard_layout(mesh: Mesh, capacity: int, K: int):
     if capacity % dd:
         raise WindFlowError(
             f"capacity {capacity} not divisible by data axis {dd}")
+    if ingest not in ("data", "flat"):
+        raise WindFlowError(f"unknown ffat ingest layout '{ingest}'")
     K_local = K // kk
     key_base_fn = lambda: jax.lax.axis_index(KEY_AXIS) * K_local
+
+    if ingest == "flat":
+        if capacity % (dd * kk):
+            raise WindFlowError(
+                f"capacity {capacity} not divisible by the mesh's "
+                f"{dd * kk} devices")
+
+        def gather(payload, ts, valid):
+            def ag(a):
+                a = jax.lax.all_gather(a, KEY_AXIS, axis=0, tiled=True)
+                if dd > 1:
+                    a = jax.lax.all_gather(a, DATA_AXIS, axis=0,
+                                           tiled=True)
+                return a
+            return jax.tree.map(ag, payload), ag(ts), ag(valid)
+
+        return K_local, key_base_fn, gather, P((DATA_AXIS, KEY_AXIS))
 
     def gather(payload, ts, valid):
         if dd == 1:
@@ -295,14 +330,15 @@ def _ffat_shard_layout(mesh: Mesh, capacity: int, K: int):
         ag = lambda a: jax.lax.all_gather(a, DATA_AXIS, axis=0, tiled=True)
         return jax.tree.map(ag, payload), ag(ts), ag(valid)
 
-    return K_local, key_base_fn, gather
+    return K_local, key_base_fn, gather, P(DATA_AXIS)
 
 
 def make_sharded_ffat_step(mesh: Mesh, capacity: int, K: int, Pn: int, R: int,
                            D: int, lift: Callable, comb: Callable,
                            key_fn: Optional[Callable],
                            sum_like: bool = False,
-                           grouping: str = "rank_scatter"):
+                           grouping: str = "rank_scatter",
+                           ingest: str = "data"):
     """Compile one FFAT window step sharded over the mesh.
 
     State tables are split along ``key`` (chip *i* owns keys
@@ -310,7 +346,8 @@ def make_sharded_ffat_step(mesh: Mesh, capacity: int, K: int, Pn: int, R: int,
     ``all_gather``-ed across ``data`` inside the program so every key shard
     sees every tuple exactly once over ICI.  Fired-window outputs come back
     key-sharded, one row block per chip."""
-    K_local, key_base_fn, gather = _ffat_shard_layout(mesh, capacity, K)
+    K_local, key_base_fn, gather, bspec = _ffat_shard_layout(
+        mesh, capacity, K, ingest)
     step_local = make_ffat_step(capacity, K_local, Pn, R, D, lift, comb,
                                 key_fn, key_base_fn=key_base_fn,
                                 sum_like=sum_like, grouping=grouping)
@@ -321,10 +358,32 @@ def make_sharded_ffat_step(mesh: Mesh, capacity: int, K: int, Pn: int, R: int,
 
     fn = jax.shard_map(
         local, mesh=mesh,
-        in_specs=(P(KEY_AXIS), P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS)),
+        in_specs=(P(KEY_AXIS), bspec, bspec, bspec),
         out_specs=(P(KEY_AXIS), P(KEY_AXIS), P(KEY_AXIS), P(KEY_AXIS)),
         check_vma=False)
     return jax.jit(fn, donate_argnums=(0,))
+
+
+def make_sharded_ffat_flush(mesh: Mesh, K: int, Pn: int, R: int, D: int,
+                            comb: Callable):
+    """EOS flush of the key-sharded CB state as an explicit shard_map:
+    each key shard flushes its own rows (keys rebased by the shard's
+    base) and the outputs stay key-sharded — so each host's sink reads
+    exactly its own keys' partial windows (a plain jit lets XLA pick the
+    output layout, which scrambled per-process reads)."""
+    kk = mesh.shape[KEY_AXIS]
+    if K % kk:
+        raise WindFlowError(f"max_keys {K} not divisible by key axis {kk}")
+    K_local = K // kk
+    key_base_fn = lambda: jax.lax.axis_index(KEY_AXIS) * K_local
+    flush_local = make_ffat_flush(K_local, Pn, R, D, comb,
+                                  key_base_fn=key_base_fn)
+    fn = jax.shard_map(
+        flush_local, mesh=mesh,
+        in_specs=(P(KEY_AXIS),),
+        out_specs=(P(KEY_AXIS), P(KEY_AXIS), P(KEY_AXIS)),
+        check_vma=False)
+    return jax.jit(fn)
 
 
 def make_sharded_ffat_state(agg_spec, K: int, R: int, mesh: Mesh):
@@ -443,7 +502,8 @@ def make_sharded_ffat_tb_step(mesh: Mesh, capacity: int, K: int, P_usec: int,
                               R: int, D: int, NP: int, lift: Callable,
                               comb: Callable, key_fn: Optional[Callable],
                               drop_tainted: bool = False,
-                              grouping: str = "rank_scatter"):
+                              grouping: str = "rank_scatter",
+                              ingest: str = "data"):
     """Compile one time-based FFAT step sharded over the mesh.
 
     Same layout as the CB variant (:func:`make_sharded_ffat_step`): state
@@ -453,7 +513,8 @@ def make_sharded_ffat_tb_step(mesh: Mesh, capacity: int, K: int, P_usec: int,
     watermark pane frontier passed replicated (it is host metadata, identical
     on every chip).  Reference: ``Ffat_Windows_GPU`` TB replicas each owning
     a key subset with quantum panes, ``ffat_replica_gpu.hpp:92-216,438-514``."""
-    K_local, key_base_fn, gather = _ffat_shard_layout(mesh, capacity, K)
+    K_local, key_base_fn, gather, bspec = _ffat_shard_layout(
+        mesh, capacity, K, ingest)
     step_local = make_ffat_tb_step(capacity, K_local, P_usec, R, D, NP,
                                    lift, comb, key_fn,
                                    key_base_fn=key_base_fn,
@@ -479,7 +540,7 @@ def make_sharded_ffat_tb_step(mesh: Mesh, capacity: int, K: int, P_usec: int,
              ("cells", "cell_valid", "horizon") + _TB_SCALARS}
     fn = jax.shard_map(
         local, mesh=mesh,
-        in_specs=(sspec, P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS), P()),
+        in_specs=(sspec, bspec, bspec, bspec, P()),
         out_specs=(sspec, P(KEY_AXIS), P(KEY_AXIS), P(KEY_AXIS), P()),
         check_vma=False)
     return jax.jit(fn, donate_argnums=(0,))

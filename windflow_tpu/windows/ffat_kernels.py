@@ -197,7 +197,7 @@ def make_ffat_step(capacity: int, K: int, P: int, R: int, D: int,
     # the gate only bounds its [capacity/CHUNK, K+1] chunk-histogram
     # (int32) to a sane size — 4096 keys at the TPU bench capacity is a
     # ~134 MB table.  Beyond it the permutation path still applies.
-    scatter_add = (sum_like and grouping == "rank_scatter" and K + 1 <= 4096)
+    scatter_add = (sum_like and grouping == "rank_scatter" and K <= 4096)
 
     def step(state, payload, ts, valid):
         B = capacity
@@ -375,6 +375,69 @@ def make_ffat_step(capacity: int, K: int, P: int, R: int, D: int,
         return new_state, out, out_valid, out_ts
 
     return step
+
+
+def make_ffat_flush(K: int, P: int, R: int, D: int, comb: Callable,
+                    key_base_fn: Optional[Callable[[], Any]] = None):
+    """Build the (un-jitted) CB EOS flush: fire every remaining partial
+    window from the carried pane history (reference EOS flush of open
+    windows).  Pure-function form so the mesh layer can trace it inside
+    ``shard_map`` with a per-shard key base — a plain ``jit`` over the
+    key-sharded state lets XLA choose the OUTPUT layout, and each
+    process's sink would read whichever key rows XLA happened to place
+    locally (found by the two-process graph test)."""
+    MWF = R // D + 2
+
+    def flush(state):
+        kb = key_base_fn() if key_base_fn is not None else None
+        # total panes including the partial pane
+        has_cur = state["cur_valid"]
+        total = state["pane_base"] + has_cur.astype(jnp.int64)
+        # available pane history: carry (R-1) + cur  -> [K, R]
+        hist = jax.tree.map(
+            lambda c, cur: jnp.concatenate([c, cur[:, None]], axis=1),
+            state["carry"], state["cur"])
+        hist_valid = jnp.concatenate(
+            [state["carry_valid"], has_cur[:, None]], axis=1)
+        # hist column i holds pane (pane_base - (R-1) + i)
+        j = jnp.arange(MWF, dtype=jnp.int64)
+        e = state["win_next"][:, None] + j[None, :] * D
+        start = e - R
+        fire = start < total[:, None]
+        # gather window panes from hist: local = pane - pane_base + R-1
+        lidx = (start[:, :, None] + jnp.arange(R)[None, None, :]
+                - state["pane_base"][:, None, None] + (R - 1))
+        inb = (lidx >= 0) & (lidx < R)
+        lidx_c = jnp.clip(lidx, 0, R - 1).astype(jnp.int32)
+        pane_ok = jnp.take_along_axis(
+            jnp.broadcast_to(hist_valid[:, None], (K, MWF, R)),
+            lidx_c, axis=2) & inb
+        # panes must also be < total (cur counts once)
+        pane_abs = start[:, :, None] + jnp.arange(R)[None, None, :]
+        pane_ok = pane_ok & (pane_abs < total[:, None, None]) \
+            & (pane_abs >= 0)
+
+        def gather_leaf(a):
+            expanded = jnp.broadcast_to(a[:, None], (K, MWF) + a.shape[1:])
+            idx = lidx_c.reshape(K, MWF, R, *([1] * (a.ndim - 2)))
+            idx = jnp.broadcast_to(idx, (K, MWF, R) + a.shape[2:])
+            return jnp.take_along_axis(expanded, idx, axis=2)
+        wpanes = jax.tree.map(gather_leaf, hist)
+        any_ok, wvals = _masked_reduce_last(comb, pane_ok, wpanes, axis=2)
+        fired = fire & any_ok
+        wid = (e - R) // D
+        out = {
+            "key": (jnp.broadcast_to(
+                jnp.arange(K, dtype=jnp.int32)[:, None], (K, MWF))
+                + (jnp.int32(kb) if kb is not None else 0)).reshape(-1),
+            "wid": wid.reshape(-1),
+            "value": jax.tree.map(
+                lambda a: a.reshape((K * MWF,) + a.shape[2:]), wvals),
+        }
+        ts = jnp.zeros((K * MWF,), jnp.int64)
+        return out, fired.reshape(-1), ts
+
+    return flush
 
 
 def make_ffat_tb_state(agg_spec, K: int, NP: int):

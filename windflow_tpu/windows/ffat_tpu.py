@@ -43,8 +43,9 @@ from windflow_tpu.batch import WM_NONE, DeviceBatch
 from windflow_tpu.ops.base import Operator
 from windflow_tpu.ops.tpu import _TPUReplica
 from windflow_tpu.windows.engine import WindowSpec
-from windflow_tpu.windows.ffat_kernels import (_masked_reduce_last,
-                                               agg_spec_for, make_ffat_state,
+from windflow_tpu.windows.ffat_kernels import (agg_spec_for,
+                                               make_ffat_flush,
+                                               make_ffat_state,
                                                make_ffat_step,
                                                make_ffat_tb_state,
                                                make_ffat_tb_step)
@@ -195,17 +196,23 @@ class FfatWindowsTPU(Operator):
             # Config.mesh is how the graph API reaches the sharded kernels.
             from windflow_tpu.parallel.mesh import (make_sharded_ffat_step,
                                                     make_sharded_ffat_tb_step)
+            # multi-process graphs stage batches fully sharded over
+            # (data, key) — the only layout each process can assemble from
+            # the lanes IT ingested — so the step gathers over both axes
+            # (mesh.py _ffat_shard_layout "flat")
+            ingest = "flat" if jax.process_count() > 1 else "data"
             if self.is_tb:
                 return make_sharded_ffat_tb_step(
                     self.mesh, capacity, self.max_keys, self.P, self.R,
                     self.D, self.NP, self.lift, self.comb,
                     self.key_extractor,
                     drop_tainted=self.overflow_policy == "drop",
-                    grouping=self._grouping())
+                    grouping=self._grouping(), ingest=ingest)
             return make_sharded_ffat_step(
                 self.mesh, capacity, self.max_keys, self.P, self.R, self.D,
                 self.lift, self.comb, self.key_extractor,
-                sum_like=self.sum_like, grouping=self._grouping())
+                sum_like=self.sum_like, grouping=self._grouping(),
+                ingest=ingest)
         if self.is_tb:
             step = make_ffat_tb_step(capacity, self.max_keys, self.P,
                                      self.R, self.D, self.NP,
@@ -477,55 +484,10 @@ class FfatWindowsTPU(Operator):
         return st
 
     def _build_flush(self):
-        K, P, R, D = self.max_keys, self.P, self.R, self.D
-        MWF = R // D + 2
-        comb = self.comb
-
-        def flush(state):
-            # total panes including the partial pane
-            has_cur = state["cur_valid"]
-            total = state["pane_base"] + has_cur.astype(jnp.int64)
-            # available pane history: carry (R-1) + cur  -> [K, R]
-            hist = jax.tree.map(
-                lambda c, cur: jnp.concatenate([c, cur[:, None]], axis=1),
-                state["carry"], state["cur"])
-            hist_valid = jnp.concatenate(
-                [state["carry_valid"], has_cur[:, None]], axis=1)
-            # hist column i holds pane (pane_base - (R-1) + i)
-            j = jnp.arange(MWF, dtype=jnp.int64)
-            e = state["win_next"][:, None] + j[None, :] * D
-            start = e - R
-            fire = start < total[:, None]
-            # gather window panes from hist: local = pane - pane_base + R-1
-            lidx = (start[:, :, None] + jnp.arange(R)[None, None, :]
-                    - state["pane_base"][:, None, None] + (R - 1))
-            inb = (lidx >= 0) & (lidx < R)
-            lidx_c = jnp.clip(lidx, 0, R - 1).astype(jnp.int32)
-            pane_ok = jnp.take_along_axis(
-                jnp.broadcast_to(hist_valid[:, None], (K, MWF, R)),
-                lidx_c, axis=2) & inb
-            # panes must also be < total (cur counts once)
-            pane_abs = start[:, :, None] + jnp.arange(R)[None, None, :]
-            pane_ok = pane_ok & (pane_abs < total[:, None, None]) \
-                & (pane_abs >= 0)
-            def gather_leaf(a):
-                expanded = jnp.broadcast_to(a[:, None], (K, MWF) + a.shape[1:])
-                idx = lidx_c.reshape(K, MWF, R, *([1] * (a.ndim - 2)))
-                idx = jnp.broadcast_to(idx, (K, MWF, R) + a.shape[2:])
-                return jnp.take_along_axis(expanded, idx, axis=2)
-            wpanes = jax.tree.map(gather_leaf, hist)
-            any_ok, wvals = _masked_reduce_last(comb, pane_ok, wpanes, axis=2)
-            fired = fire & any_ok
-            wid = (e - R) // D
-            out = {
-                "key": jnp.broadcast_to(
-                    jnp.arange(K, dtype=jnp.int32)[:, None],
-                    (K, MWF)).reshape(-1),
-                "wid": wid.reshape(-1),
-                "value": jax.tree.map(
-                    lambda a: a.reshape((K * MWF,) + a.shape[2:]), wvals),
-            }
-            ts = jnp.zeros((K * MWF,), jnp.int64)
-            return out, fired.reshape(-1), ts
-
-        return jax.jit(flush)
+        if self.mesh is not None:
+            from windflow_tpu.parallel.mesh import make_sharded_ffat_flush
+            return make_sharded_ffat_flush(self.mesh, self.max_keys,
+                                           self.P, self.R, self.D,
+                                           self.comb)
+        return jax.jit(make_ffat_flush(self.max_keys, self.P, self.R,
+                                       self.D, self.comb))
