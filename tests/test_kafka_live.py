@@ -1,0 +1,161 @@
+"""LIVE-broker Kafka e2e (VERDICT r4 item 8): the real-client adapters
+(`kafka/client.py` ConfluentConsumer/ConfluentProducer) against an actual
+broker — the four semantics the in-process fake cannot prove
+(kafka/client.py "VALIDATION STATUS") get their first real exercise here.
+
+Skips cleanly unless BOTH hold:
+* ``confluent_kafka`` is importable (not in the zero-egress build image;
+  installed in ``dockerimages/Dockerfile_cpu``);
+* a broker answers at ``KAFKA_BOOTSTRAP`` (default ``localhost:9092``)
+  within 5 s.
+
+Run via the CPU docker image (which starts a single-node KRaft broker
+before the suite) or against any reachable cluster:
+
+    KAFKA_BOOTSTRAP=host:9092 python -m pytest tests/test_kafka_live.py
+"""
+
+import os
+import time
+import uuid
+
+import pytest
+
+import windflow_tpu as wf
+from windflow_tpu.kafka import (KafkaSink_Builder, KafkaSinkMessage,
+                                KafkaSource_Builder)
+
+BOOTSTRAP = os.environ.get("KAFKA_BOOTSTRAP", "localhost:9092")
+IDLE_USEC = 8_000_000          # 8 s of real-broker silence = end of stream
+
+
+def _broker_available():
+    if "KAFKA_BOOTSTRAP" not in os.environ:
+        # no explicit opt-in: skip WITHOUT probing, so hosts that happen
+        # to have confluent_kafka installed don't pay a 5 s dead-connect
+        # stall on every collection of the normal suite
+        return False, "set KAFKA_BOOTSTRAP to enable live-broker tests"
+    try:
+        from confluent_kafka.admin import AdminClient
+    except ImportError:
+        return False, "confluent_kafka not installed"
+    try:
+        admin = AdminClient({"bootstrap.servers": BOOTSTRAP,
+                             "socket.timeout.ms": 4000})
+        md = admin.list_topics(timeout=5)
+        return True, f"broker {md.orig_broker_name}"
+    except Exception as e:
+        return False, f"no broker at {BOOTSTRAP}: {e}"
+
+
+_OK, _WHY = _broker_available()
+pytestmark = pytest.mark.skipif(not _OK, reason=_WHY)
+
+
+def _fresh_topic(partitions: int) -> str:
+    from confluent_kafka.admin import AdminClient, NewTopic
+    name = f"wf-live-{uuid.uuid4().hex[:12]}"
+    admin = AdminClient({"bootstrap.servers": BOOTSTRAP})
+    fs = admin.create_topics([NewTopic(name, num_partitions=partitions,
+                                       replication_factor=1)])
+    for f in fs.values():
+        f.result(timeout=15)
+    time.sleep(0.5)            # let metadata propagate to the one broker
+    return name
+
+
+def _consume_all(topic: str, group: str, parallelism: int = 1):
+    """Drain ``topic`` through a KafkaSource graph until the broker stays
+    silent for IDLE_USEC; returns the int payloads seen."""
+    got = []
+
+    def deser(msg, shipper):
+        if msg is None:
+            return False           # idle: end the stream (reference EOS)
+        shipper.push({"v": int(msg.value.decode())})
+        return True
+
+    src = (KafkaSource_Builder(deser).withBrokers(BOOTSTRAP)
+           .withTopics(topic).withGroupID(group)
+           .withIdleness(IDLE_USEC)
+           .withParallelism(parallelism)
+           .withOutputBatchSize(32).build())
+    g = wf.PipeGraph(f"live_consume_{group}", wf.ExecutionMode.DEFAULT)
+    g.add_source(src).add_sink(
+        wf.Sink_Builder(lambda t: got.append(t["v"])
+                        if t is not None else None).build())
+    g.run()
+    return got
+
+
+def test_live_sink_then_source_roundtrip():
+    """Producer graph → real broker → consumer graph: every record
+    arrives exactly once per group across 2 real partitions (real
+    rebalance callbacks, auto-commit, the librdkafka poll loop —
+    unverified items 1/2/4 of kafka/client.py)."""
+    topic = _fresh_topic(partitions=2)
+    n = 400
+
+    def gen():
+        for i in range(n):
+            yield {"k": i % 7, "v": i}
+
+    def ser(item):
+        return KafkaSinkMessage(topic=topic,
+                                payload=str(item["v"]).encode(),
+                                key=str(item["k"]).encode())
+
+    snk = KafkaSink_Builder(ser).withBrokers(BOOTSTRAP).build()
+    g1 = wf.PipeGraph("live_producer", wf.ExecutionMode.DEFAULT)
+    g1.add_source(wf.Source_Builder(gen).withOutputBatchSize(64).build()) \
+      .add_sink(snk)
+    g1.run()
+
+    got = _consume_all(topic, f"wf-live-{uuid.uuid4().hex[:8]}")
+    assert sorted(got) == list(range(n)), (len(got), len(set(got)))
+
+
+def test_live_two_replicas_cover_partitions():
+    """Two source replicas in one real consumer group must split the
+    topic's partitions and together consume everything (real group
+    coordinator + cooperative-sticky assignment — unverified item 1)."""
+    topic = _fresh_topic(partitions=2)
+    n = 200
+
+    from confluent_kafka import Producer
+    p = Producer({"bootstrap.servers": BOOTSTRAP})
+    for i in range(n):
+        p.produce(topic, key=str(i % 2).encode(), value=str(i).encode())
+    p.flush(15)
+
+    got = _consume_all(topic, f"wf-live-{uuid.uuid4().hex[:8]}",
+                       parallelism=2)
+    # COVERAGE assertion, deliberately not exactly-once: the adapter is
+    # at-least-once (auto-commit; a cooperative rebalance while the
+    # second replica joins may re-deliver an uncommitted tail —
+    # kafka/client.py VALIDATION STATUS item 2)
+    assert set(got) == set(range(n)), (len(got), len(set(got)))
+
+
+def test_live_offset_resume_after_commit():
+    """A second run of the SAME group resumes past committed offsets
+    (real offset persistence across consumer lifetimes — unverified
+    item 2): it must see only the records produced after the first
+    run."""
+    topic = _fresh_topic(partitions=1)
+    group = f"wf-live-{uuid.uuid4().hex[:8]}"
+
+    from confluent_kafka import Producer
+    p = Producer({"bootstrap.servers": BOOTSTRAP})
+    for i in range(50):
+        p.produce(topic, value=str(i).encode())
+    p.flush(15)
+
+    first = _consume_all(topic, group)
+    assert sorted(first) == list(range(50))
+    for i in range(50, 80):
+        p.produce(topic, value=str(i).encode())
+    p.flush(15)
+    time.sleep(1)       # let the committed offsets land broker-side
+    second = _consume_all(topic, group)
+    assert sorted(second) == list(range(50, 80)), second[:10]

@@ -313,6 +313,15 @@ class InMemoryConsumer(ConsumerClient):
 # 4. Broker-side errors (session timeouts, coordinator migration,
 #    msg.error() codes other than _PARTITION_EOF) pass through the
 #    poll loop untested.
+#
+# tests/test_kafka_live.py now exercises items 1, 2 and 4 against a REAL
+# broker (roundtrip across partitions, two-replica group assignment,
+# committed-offset resume); it skips unless confluent_kafka + a broker at
+# KAFKA_BOOTSTRAP are available — dockerimages/Dockerfile_cpu provides
+# both (single-node KRaft via ci/run_tests_with_kafka.sh).  Item 3
+# (real consumer-lag timing of the watermark grace path) remains
+# environment-untested.  In THIS build environment (zero egress, no
+# broker) the adapters stay validated only against the fake.
 # ---------------------------------------------------------------------------
 
 def _require_confluent():
