@@ -46,6 +46,11 @@ def test_counting_order_matches_stable_argsort(B, nbuckets):
     got = jax.jit(lambda x: counting_order(x, nbuckets))(ids)
     want = jnp.argsort(ids, stable=True)
     assert (got == want).all()
+    # the helpers built on it: auto_order picks an algorithm but must be
+    # bit-identical; invert_perm must invert any permutation sort-free
+    from windflow_tpu.windows.grouping import auto_order, invert_perm
+    assert (auto_order(ids, nbuckets) == want).all()
+    assert (invert_perm(got) == jnp.argsort(got)).all()
 
 
 def test_counting_order_skewed_and_sorted_inputs():

@@ -125,7 +125,9 @@ def test_live_two_replicas_cover_partitions():
     from confluent_kafka import Producer
     p = Producer({"bootstrap.servers": BOOTSTRAP})
     for i in range(n):
-        p.produce(topic, key=str(i % 2).encode(), value=str(i).encode())
+        # explicit partition: key hashing could land both key streams on
+        # one partition and leave the second replica unexercised
+        p.produce(topic, value=str(i).encode(), partition=i % 2)
     p.flush(15)
 
     got = _consume_all(topic, f"wf-live-{uuid.uuid4().hex[:8]}",

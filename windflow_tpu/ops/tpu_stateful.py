@@ -53,6 +53,7 @@ from windflow_tpu.ops.base import Operator
 from windflow_tpu.ops.tpu import _TPUReplica, _bshape
 from windflow_tpu.parallel.emitters import KeyInterner
 from windflow_tpu.utils.dtypes import cast_state_update as _cast_update
+from windflow_tpu.windows.grouping import auto_order, invert_perm
 
 _KEY_SENTINEL = np.int32(2**31 - 1)
 
@@ -75,7 +76,7 @@ def _wavefront_body(fn: Callable, capacity: int,
         # the ordering guarantee of the reference's per-key chain walk.
         sort_key = jnp.where(valid & (slots < num_slots), slots,
                              jnp.int32(num_slots))
-        order = jnp.argsort(sort_key, stable=True)
+        order = auto_order(sort_key, num_slots + 1)
         s_slots = sort_key[order]
         s_valid = valid[order]
         s_payload = jax.tree.map(lambda a: a[order], payload)
@@ -128,7 +129,7 @@ def _wavefront_body(fn: Callable, capacity: int,
         _, state, s_out = jax.lax.while_loop(
             lambda c: c[0] <= max_rank, body, (jnp.int32(0), state, out0))
 
-        inv = jnp.argsort(order)
+        inv = invert_perm(order)
         if is_filter:
             new_valid = valid & s_out[inv]
             return state, payload, new_valid
@@ -156,7 +157,7 @@ def _assoc_body(lift: Callable, comb: Callable, project: Callable,
     def body_fn(state, payload, valid, slots):
         sort_key = jnp.where(valid & (slots < num_slots), slots,
                              jnp.int32(num_slots))
-        order = jnp.argsort(sort_key, stable=True)
+        order = auto_order(sort_key, num_slots + 1)
         s_slots = sort_key[order]
         s_valid = valid[order]
         s_payload = jax.tree.map(lambda a: a[order], payload)
@@ -194,7 +195,7 @@ def _assoc_body(lift: Callable, comb: Callable, project: Callable,
                                         mode="drop"),
             state, state_incl)
 
-        inv = jnp.argsort(order)
+        inv = invert_perm(order)
         if is_filter:
             return state, payload, valid & s_out[inv]
         out_payload = jax.tree.map(lambda a: a[inv], s_out)

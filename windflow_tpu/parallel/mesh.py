@@ -46,6 +46,7 @@ from windflow_tpu.windows.ffat_kernels import (_b, _masked_reduce_last,
                                            make_ffat_state, make_ffat_step,
                                            make_ffat_tb_state,
                                            make_ffat_tb_step)
+from windflow_tpu.windows.grouping import auto_order
 
 DATA_AXIS = "data"
 KEY_AXIS = "key"
@@ -109,7 +110,7 @@ def _dense_keyed_partial(keys, vals, valid, comb, K):
     (``reduce_gpu.hpp:227-258``) producing a *dense* table so cross-chip
     combination is a collective, not a re-shuffle."""
     sk = jnp.where(valid & (keys >= 0) & (keys < K), keys, K)
-    order = jnp.argsort(sk)
+    order = auto_order(sk, K + 1)   # O(n) dense grouping (grouping.py)
     sk_s = sk[order]
     sv = jax.tree.map(lambda a: a[order], vals)
     starts = jnp.concatenate([jnp.array([True]), sk_s[1:] != sk_s[:-1]])
@@ -213,7 +214,7 @@ def make_sharded_reduce_arbitrary(mesh: Mesh, capacity: int, comb: Callable,
                           jnp.int32(n))
         # group local lanes by owner: rank within the owner run indexes the
         # outgoing bucket row (a run can never exceed local_cap lanes)
-        order = jnp.argsort(owner, stable=True)
+        order = auto_order(owner, n + 1)
         so = owner[order]
         sp = jax.tree.map(lambda a: a[order], payload)
         st, sv = ts[order], valid[order]

@@ -104,6 +104,26 @@ def _single_digit_order(ids, nbuckets: int):
     return order[:B]
 
 
+def invert_perm(order):
+    """Invert a permutation in O(n): ``inv[order[i]] = i`` via one scatter
+    of iota — replaces the ``argsort(order)`` idiom (a full comparison
+    sort of something already known to be a permutation)."""
+    n = order.shape[0]
+    return jnp.zeros(n, order.dtype).at[order].set(
+        jnp.arange(n, dtype=order.dtype), unique_indices=True)
+
+
+def auto_order(ids, nbuckets: int):
+    """Stable grouping permutation with an automatic algorithm choice:
+    the O(n) counting permutation while it needs at most two radix passes
+    (bucket spaces up to ``DIGIT^2``), the comparison argsort beyond —
+    at 3+ passes the counting constant catches the O(n log n) sort's.
+    Bit-identical either way (both order by (id, arrival))."""
+    if nbuckets <= DIGIT * DIGIT:
+        return counting_order(ids, nbuckets)
+    return jnp.argsort(ids, stable=True)
+
+
 def counting_order(ids, nbuckets: int):
     """Stable grouping permutation over dense int ids in ``[0, nbuckets)``
     (out-of-range ids must already be clamped by the caller — the FFAT
