@@ -14,15 +14,17 @@ import jax
 import jax.numpy as jnp
 
 from windflow_tpu.utils.dtypes import cast_state_update
-from windflow_tpu.windows.grouping import counting_order, dense_rank
+from windflow_tpu.windows.grouping import auto_order, dense_rank
 
 
 def _group_order(ids, nbuckets: int, grouping: str):
     """Stable grouping permutation: ``rank_scatter`` is the O(n) dense-key
-    counting sort (grouping.py), ``argsort`` the comparison-sort baseline
-    it is bit-identical to (both order by (id, arrival))."""
+    counting sort (grouping.py; beyond two radix passes — TB (key, pane)
+    spaces past DIGIT^2 buckets — auto_order falls back to the sort, where
+    the counting constant no longer wins), ``argsort`` the comparison-sort
+    baseline.  Bit-identical either way (both order by (id, arrival))."""
     if grouping == "rank_scatter":
-        return counting_order(ids, nbuckets)
+        return auto_order(ids, nbuckets)
     return jnp.argsort(ids, stable=True)
 
 
