@@ -568,7 +568,8 @@ def run_bench_ysb(platform: str, cfg: dict, jax) -> dict:
                                           lambda a, b: a + b)
                .withTBWindows(10_000_000, 10_000_000)
                .withKeyBy(lambda e: e["campaign"])
-               .withMaxKeys(n_campaigns).build())
+               .withMaxKeys(n_campaigns)
+               .withSumCombiner().build())   # sort-free TB placement
         snk = (wf.Sink_Builder(
                 lambda c: rows.__setitem__(0, rows[0] + len(c))
                 if c is not None else None)
@@ -797,7 +798,9 @@ def main() -> None:
                  "ysb": result.get("ysb"),
                  "t": now,
                  "recorded_at": time.strftime("%Y-%m-%d %H:%M:%S")})
-    del runs[:-20]  # keep the last 20 runs per platform
+    del runs[:-48]  # retention: debugging reruns can burn through a
+    #                 20-entry window in one session and rotate out the
+    #                 prior round's record the baseline picker needs
     save_history(hist)
     print(json.dumps(result))
 

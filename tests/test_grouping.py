@@ -264,6 +264,77 @@ def test_cb_step_scatter_add_float_tolerance():
             np.asarray(outs["argsort"][0]["value"]), rtol=1e-5, atol=1e-4)
 
 
+def test_tb_step_scatter_add_matches_grouped():
+    """TB sum_like placement (sort-free scatter-add into the pane ring):
+    integer-valued floats make addition order-exact, so outputs and state
+    must EQUAL the grouped path's across batches with late/out-of-order
+    timestamps."""
+    cap, K, P_usec, R, D, NP = 96, 5, 1000, 4, 2, 32
+    lift, comb = (lambda x: x["v"]), (lambda a, b: a + b)
+    key_fn = lambda x: x["k"]
+    steps = {
+        sl: jax.jit(make_ffat_tb_step(cap, K, P_usec, R, D, NP, lift, comb,
+                                      key_fn, sum_like=sl))
+        for sl in (True, False)
+    }
+    spec = agg_spec_for(lift, {"k": jnp.zeros((cap,), jnp.int32),
+                               "v": jnp.zeros((cap,), jnp.float32)})
+    states = {sl: make_ffat_tb_state(spec, K, NP) for sl in steps}
+    rng = np.random.default_rng(41)
+    for i in range(5):
+        n = rng.integers(cap // 2, cap + 1)
+        keys = rng.integers(0, K + 2, cap)
+        vals = rng.integers(0, 500, cap).astype(np.float32)
+        ts = (np.arange(cap, dtype=np.int64) * 1000 + i * cap * 1000
+              + rng.integers(-3000, 3000, cap))
+        valid = np.zeros(cap, bool)
+        valid[:n] = True
+        wm = jnp.int64((i + 1) * cap - R)
+        batch = ({"k": jnp.asarray(keys, jnp.int32), "v": jnp.asarray(vals)},
+                 jnp.asarray(ts), jnp.asarray(valid))
+        outs = {}
+        for sl, step in steps.items():
+            states[sl], out, fired, out_ts, n_adv = step(
+                states[sl], *batch, wm)
+            outs[sl] = (out, fired, out_ts, n_adv)
+        # fired mask + non-value lanes must match exactly; value lanes
+        # only where fired (non-fired rows carry path-dependent garbage,
+        # gated by `fired` for every consumer)
+        f_t, f_f = np.asarray(outs[True][1]), np.asarray(outs[False][1])
+        np.testing.assert_array_equal(f_t, f_f)
+        np.testing.assert_array_equal(np.asarray(outs[True][3]),
+                                      np.asarray(outs[False][3]))
+        for name in outs[True][0]:
+            for la, lb in zip(jax.tree.leaves(outs[True][0][name]),
+                              jax.tree.leaves(outs[False][0][name])):
+                la, lb = np.asarray(la), np.asarray(lb)
+                m = f_f.reshape(f_f.shape + (1,) * (la.ndim - 1))
+                np.testing.assert_array_equal(np.where(m, la, 0),
+                                              np.where(m, lb, 0))
+        np.testing.assert_array_equal(
+            np.where(f_f, np.asarray(outs[True][2]), 0),
+            np.where(f_f, np.asarray(outs[False][2]), 0))
+        # state equality is masked for "cells": the grouped path leaves
+        # stale values in cell_valid==False slots where scatter-add
+        # writes zeros — semantically identical (readers gate on
+        # cell_valid); every other field must match exactly
+        cv = np.asarray(states[False]["cell_valid"])
+        for name in states[False]:
+            a, b = states[True][name], states[False][name]
+            if name == "cells":
+                for la, lb in zip(jax.tree.leaves(a), jax.tree.leaves(b)):
+                    la, lb = np.asarray(la), np.asarray(lb)
+                    np.testing.assert_array_equal(
+                        np.where(cv.reshape(cv.shape + (1,) * (la.ndim - 2)),
+                                 la, 0),
+                        np.where(cv.reshape(cv.shape + (1,) * (lb.ndim - 2)),
+                                 lb, 0))
+            else:
+                for la, lb in zip(jax.tree.leaves(a), jax.tree.leaves(b)):
+                    np.testing.assert_array_equal(np.asarray(la),
+                                                  np.asarray(lb))
+
+
 # -- graph-level: config plumbing + oracle ---------------------------------
 
 N_KEYS = 3

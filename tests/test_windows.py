@@ -704,12 +704,33 @@ def test_ffat_tpu_cb_sum_combiner_fast_path():
         assert (acc.count, acc.total) == exp, batch
 
 
-def test_ffat_tpu_sum_combiner_tb_warns():
-    """withSumCombiner is CB-only; declaring it together with TB windows
-    warns at build() instead of being a silent no-op."""
-    import warnings
-    with warnings.catch_warnings(record=True) as caught:
-        warnings.simplefilter("always")
-        (wf.Ffat_WindowsTPU_Builder(lambda t: t["v"], lambda a, b: a + b)
-         .withTBWindows(1000, 500).withMaxKeys(4).withSumCombiner().build())
-    assert any("count-based" in str(w.message) for w in caught)
+def test_ffat_tpu_sum_combiner_tb_scatter_add_path():
+    """withSumCombiner on TB windows takes the sort-free scatter-add
+    placement (r5): results must match the grouped path's against the
+    oracle.  (Until r5 this combination only warned as a no-op.)"""
+    stream = [{"key": i % 3, "value": i, "ts": i * 1000}
+              for i in range(240)]
+    from conftest import tb_window_sums
+    per_key = {}
+    for t in stream:
+        per_key.setdefault(t["key"], []).append((t["ts"], t["value"]))
+    exp = tb_window_sums(per_key, 16_000, 4_000)
+    for declare in (False, True):
+        got = {}
+        src = (wf.Source_Builder(lambda: iter(stream))
+               .withTimestampExtractor(lambda t: t["ts"])
+               .withOutputBatchSize(31).build())
+        b = (wf.Ffat_WindowsTPU_Builder(lambda t: t["value"],
+                                        lambda a, b: a + b)
+             .withKeyBy(lambda t: t["key"]).withMaxKeys(3)
+             .withTBWindows(16_000, 4_000))
+        if declare:
+            b = b.withSumCombiner()
+        snk = wf.Sink_Builder(
+            lambda r: got.__setitem__((r["key"], r["wid"]), r["value"])
+            if r is not None else None).build()
+        g = wf.PipeGraph("ffat_tb_sum", wf.ExecutionMode.DEFAULT,
+                         wf.TimePolicy.EVENT)
+        g.add_source(src).add(b.build()).add_sink(snk)
+        g.run()
+        assert got == exp, (declare, len(got), len(exp))

@@ -618,10 +618,10 @@ class Ffat_WindowsTPU_Builder(_WindowBuilderBase):
         may differ from the sequential fold, exactly as under psum).
         Strictly additive: a merely zero-absorbing combiner (max over
         non-negatives, ...) would silently compute sums on the
-        scatter-add path — do not declare it.  CB-only: the TB firing
-        path already folds over value panes without per-operand flags, so
-        the declaration has nothing to speed up there (``build()`` warns
-        if combined with ``withTBWindows``)."""
+        scatter-add path — do not declare it.  Time-based windows gain
+        even more: a TB tuple's pane cell is pure timestamp arithmetic,
+        so placement needs no grouping at all and the whole
+        sort/segmented-scan machinery disappears."""
         self._sum_like = True
         return self
 
@@ -642,12 +642,6 @@ class Ffat_WindowsTPU_Builder(_WindowBuilderBase):
         return self
 
     def build(self) -> FfatWindowsTPU:
-        if self._sum_like and self._win_type == WinType.TB:
-            import warnings
-            warnings.warn(
-                "withSumCombiner applies only to count-based FFAT windows; "
-                "it is a no-op for withTBWindows (the TB firing path is "
-                "already flagless)", stacklevel=2)
         return FfatWindowsTPU(
             self._lift, self._comb, self._spec(), max_keys=self._max_keys,
             name=self._name,

@@ -504,7 +504,8 @@ def make_sharded_ffat_tb_step(mesh: Mesh, capacity: int, K: int, P_usec: int,
                               comb: Callable, key_fn: Optional[Callable],
                               drop_tainted: bool = False,
                               grouping: str = "rank_scatter",
-                              ingest: str = "data"):
+                              ingest: str = "data",
+                              sum_like: bool = False):
     """Compile one time-based FFAT step sharded over the mesh.
 
     Same layout as the CB variant (:func:`make_sharded_ffat_step`): state
@@ -520,7 +521,7 @@ def make_sharded_ffat_tb_step(mesh: Mesh, capacity: int, K: int, P_usec: int,
                                    lift, comb, key_fn,
                                    key_base_fn=key_base_fn,
                                    drop_tainted=drop_tainted,
-                                   grouping=grouping)
+                                   grouping=grouping, sum_like=sum_like)
 
     def local(state, payload, ts, valid, wm_pane):
         payload, ts, valid = gather(payload, ts, valid)

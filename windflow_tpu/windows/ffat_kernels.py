@@ -474,7 +474,8 @@ def make_ffat_tb_step(capacity: int, K: int, P_usec: int, R: int, D: int,
                       key_fn: Optional[Callable],
                       key_base_fn: Optional[Callable[[], Any]] = None,
                       drop_tainted: bool = False,
-                      grouping: str = "rank_scatter"):
+                      grouping: str = "rank_scatter",
+                      sum_like: bool = False):
     """Time-based FFAT per-batch program.
 
     Window ``w`` covers panes ``[w*D, w*D + R)`` — times
@@ -513,6 +514,12 @@ def make_ffat_tb_step(capacity: int, K: int, P_usec: int, R: int, D: int,
     firing a wrong partial aggregate; every suppression increments
     ``n_win_dropped``.  The reference never fires a wrong window — it
     grows/blocks instead — so wrong-but-counted is opt-in (``count``).
+
+    ``sum_like`` (withSumCombiner — strictly leafwise addition): TB
+    placement then needs NO grouping at all — the pane cell is timestamp
+    arithmetic, so lifts scatter-ADD into the ring and the whole
+    sort/segmented-scan machinery disappears (float rounding order may
+    differ from the sequential fold, the psum tolerance).
     """
     MW = NP // D + 2
     N_PASSES = 3                     # A1, A2 (pre-place), B (post-place)
@@ -644,36 +651,68 @@ def make_ffat_tb_step(capacity: int, K: int, P_usec: int, R: int, D: int,
         late = ok & (rel < 0)
         ok = ok & (rel >= 0)
         rel_c = jnp.clip(rel, 0, NP - 1).astype(jnp.int32)
-        sid = jnp.where(ok, keys.astype(jnp.int64) * NP + rel_c,
-                        jnp.int64(K) * NP)
-        if K * NP + 1 < (1 << 31):   # counting ids are int32
-            order = _group_order(sid.astype(jnp.int32), K * NP + 1, grouping)
+        if sum_like:
+            # declared leafwise-ADD combiner: a tuple's pane cell is pure
+            # timestamp arithmetic (no within-key rank exists in TB), so
+            # placement needs NO grouping at all — lifts scatter-ADD
+            # straight into the ring (absent cells hold the identity 0).
+            # The reference pays its sort for every TB batch regardless
+            # (thrust::sort_by_key, ffat_replica_gpu.hpp:917).
+            row_u = jnp.where(ok, keys, K)
+            col_u = jnp.where(ok, rel_c, 0)
+
+            def scat_add(leaf):
+                buf = jnp.zeros((K + 1, NP) + leaf.shape[1:], leaf.dtype)
+                return buf.at[row_u, col_u].add(
+                    jnp.where(_b(ok, leaf), leaf, 0))[:K]
+            partial = jax.tree.map(scat_add, jax.vmap(lift)(payload))
+            partial_has = (jnp.zeros((K + 1, NP), jnp.int32)
+                           .at[row_u, col_u].add(ok.astype(jnp.int32))[:K]
+                           > 0)
+
+            def merge_add(old_leaf, new_leaf):
+                # plain addition with dtype PROMOTION, exactly like the
+                # grouped path's comb merge — a wider (e.g. f64) state
+                # stays wide; no scatter is involved so no cast is needed
+                add = jnp.where(_b(cell_valid, old_leaf), old_leaf, 0)
+                return new_leaf + add
+            cells = jax.tree.map(merge_add, cells, partial)
         else:
-            order = jnp.argsort(sid, stable=True)
-        ssid = sid[order]
-        slift = jax.tree.map(lambda a: a[order], jax.vmap(lift)(payload))
-        starts = jnp.concatenate([jnp.array([True]), ssid[1:] != ssid[:-1]])
-        scanned = _seg_scan(comb, starts, slift)
-        ends = jnp.concatenate([ssid[1:] != ssid[:-1], jnp.array([True])])
-        row = jnp.where(ends, ssid // NP, K).astype(jnp.int32)
-        col = jnp.where(ends, ssid % NP, 0).astype(jnp.int32)
+            sid = jnp.where(ok, keys.astype(jnp.int64) * NP + rel_c,
+                            jnp.int64(K) * NP)
+            if K * NP + 1 < (1 << 31):   # counting ids are int32
+                order = _group_order(sid.astype(jnp.int32), K * NP + 1,
+                                     grouping)
+            else:
+                order = jnp.argsort(sid, stable=True)
+            ssid = sid[order]
+            slift = jax.tree.map(lambda a: a[order],
+                                 jax.vmap(lift)(payload))
+            starts = jnp.concatenate([jnp.array([True]),
+                                      ssid[1:] != ssid[:-1]])
+            scanned = _seg_scan(comb, starts, slift)
+            ends = jnp.concatenate([ssid[1:] != ssid[:-1],
+                                    jnp.array([True])])
+            row = jnp.where(ends, ssid // NP, K).astype(jnp.int32)
+            col = jnp.where(ends, ssid % NP, 0).astype(jnp.int32)
 
-        def scat(leaf):
-            buf = jnp.zeros((K + 1, NP) + leaf.shape[1:], leaf.dtype)
-            return buf.at[row, col].set(
-                jnp.where(_b(ends, leaf), leaf, 0))[:K]
-        partial = jax.tree.map(scat, scanned)
-        partial_has = jnp.zeros((K + 1, NP), bool).at[row, col].set(ends)[:K]
+            def scat(leaf):
+                buf = jnp.zeros((K + 1, NP) + leaf.shape[1:], leaf.dtype)
+                return buf.at[row, col].set(
+                    jnp.where(_b(ends, leaf), leaf, 0))[:K]
+            partial = jax.tree.map(scat, scanned)
+            partial_has = jnp.zeros((K + 1, NP), bool) \
+                .at[row, col].set(ends)[:K]
 
-        # comb is a whole-pytree combiner (see CB merge above)
-        both_cells = comb(cells, partial)
+            # comb is a whole-pytree combiner (see CB merge above)
+            both_cells = comb(cells, partial)
 
-        def merge(old_leaf, new_leaf, both_leaf):
-            return jnp.where(_b(cell_valid & partial_has, both_leaf),
-                             both_leaf,
-                             jnp.where(_b(partial_has, both_leaf), new_leaf,
-                                       old_leaf))
-        cells = jax.tree.map(merge, cells, partial, both_cells)
+            def merge(old_leaf, new_leaf, both_leaf):
+                return jnp.where(_b(cell_valid & partial_has, both_leaf),
+                                 both_leaf,
+                                 jnp.where(_b(partial_has, both_leaf),
+                                           new_leaf, old_leaf))
+            cells = jax.tree.map(merge, cells, partial, both_cells)
         cell_valid = cell_valid | partial_has
 
         # 4. pass B: fire what this batch completed under the watermark

@@ -150,8 +150,12 @@ class FfatWindowsTPU(Operator):
         #: "error" raises at the next host checkpoint.  The reference never
         #: fires a wrong window (its FlatFAT grows instead).
         self.overflow_policy = overflow_policy
-        #: declared zero-absorbing combiner (withSumCombiner): the CB
-        #: sliding fold drops its flag lane — half the operand traffic
+        #: declared strictly-ADDITIVE combiner (withSumCombiner,
+        #: comb(a,b) == a+b per leaf): CB drops the fold's flag lane and
+        #: skips the grouping permutation (scatter-add pane cells); TB
+        #: skips grouping entirely — pane placement is timestamp
+        #: arithmetic, lifts scatter-add into the ring.  NOT for merely
+        #: zero-absorbing combiners (max would silently become sum).
         self.sum_like = sum_like
         self._overflow_steps = 0
         self._auto_np = False          # NP chosen by the span estimator
@@ -207,7 +211,8 @@ class FfatWindowsTPU(Operator):
                     self.D, self.NP, self.lift, self.comb,
                     self.key_extractor,
                     drop_tainted=self.overflow_policy == "drop",
-                    grouping=self._grouping(), ingest=ingest)
+                    grouping=self._grouping(), ingest=ingest,
+                    sum_like=self.sum_like)
             return make_sharded_ffat_step(
                 self.mesh, capacity, self.max_keys, self.P, self.R, self.D,
                 self.lift, self.comb, self.key_extractor,
@@ -220,7 +225,8 @@ class FfatWindowsTPU(Operator):
                                      self.key_extractor,
                                      drop_tainted=self.overflow_policy
                                      == "drop",
-                                     grouping=self._grouping())
+                                     grouping=self._grouping(),
+                                     sum_like=self.sum_like)
         else:
             step = make_ffat_step(capacity, self.max_keys, self.P, self.R,
                                   self.D, self.lift, self.comb,
