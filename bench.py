@@ -136,6 +136,18 @@ def xla_bytes_accessed(jitted, state, batch) -> float:
         return None
 
 
+def _median_disp(rates: list) -> tuple:
+    """Median of a list of window rates + the shared dispersion dict
+    (one definition for the per-dispatch and scan-chained loops so the
+    two numbers always carry identical statistics)."""
+    rates = sorted(rates)
+    med = rates[len(rates) // 2]
+    disp = {"windows": len(rates), "min": round(rates[0], 1),
+            "max": round(rates[-1], 1),
+            "rel_spread": round((rates[-1] - rates[0]) / med, 4)}
+    return med, disp
+
+
 def run_bench(platform: str, cfg: dict, jax) -> dict:
     import jax.numpy as jnp
     import numpy as np
@@ -151,8 +163,8 @@ def run_bench(platform: str, cfg: dict, jax) -> dict:
     comb = lambda a, b: a + b
     key_fn = lambda x: x["k"]
 
-    step = jax.jit(make_ffat_step(CAP, K, Pn, R, D, lift, comb, key_fn),
-                   donate_argnums=(0,))
+    step_fn = make_ffat_step(CAP, K, Pn, R, D, lift, comb, key_fn)
+    step = jax.jit(step_fn, donate_argnums=(0,))
 
     rng = np.random.default_rng(0)
     dev = jax.devices()[0]
@@ -192,24 +204,77 @@ def run_bench(platform: str, cfg: dict, jax) -> dict:
                 st, out, fired, _ = stp(st, p, t, v)
             jax.block_until_ready(st)
             rates.append(cfg["steps"] * CAP / (time.perf_counter() - t0))
-        rates.sort()
-        med = rates[len(rates) // 2]
-        disp = {"windows": len(rates), "min": round(rates[0], 1),
-                "max": round(rates[-1], 1),
-                "rel_spread": round((rates[-1] - rates[0]) / med, 4)}
+        med, disp = _median_disp(rates)
         return med, disp, st
 
-    tuples_per_sec, dispersion, state = time_steps(step, state)
+    dispatch_tps, dispatch_disp, state = time_steps(step, state)
+
+    # Scan-chained chip throughput (round-5): the per-dispatch loop above
+    # pays one tunnel round trip PER STEP, and on a remote (axon) chip a
+    # single scheduling stall can halve a whole timing window — r5's
+    # per-dispatch TPU run showed rel_spread 2.7 with the max window 3x
+    # the median.  Chaining `steps` batch-steps under ``lax.scan`` runs
+    # the whole window as ONE device program, so the measurement is chip
+    # throughput, not tunnel-jitter throughput.  A tiny accumulator over
+    # the fired-window outputs is threaded through the carry so XLA
+    # cannot dead-code-eliminate the firing/compaction stages.
+    from jax import lax
+
+    stacked = {
+        "k": jnp.stack([b[0]["k"] for b in batches]),
+        "v": jnp.stack([b[0]["v"] for b in batches]),
+        "ts": jnp.stack([b[1] for b in batches]),
+        "valid": jnp.stack([b[2] for b in batches]),
+    }
+    idxs = jnp.asarray(np.arange(cfg["steps"]) % len(batches), jnp.int32)
+
+    def make_chained(fn):
+        def chained(st, idxs, sb):
+            def body(carry, i):
+                st, acc_n, acc_v = carry
+                p = {"k": lax.dynamic_index_in_dim(sb["k"], i,
+                                                   keepdims=False),
+                     "v": lax.dynamic_index_in_dim(sb["v"], i,
+                                                   keepdims=False)}
+                t = lax.dynamic_index_in_dim(sb["ts"], i, keepdims=False)
+                v = lax.dynamic_index_in_dim(sb["valid"], i,
+                                             keepdims=False)
+                st, out, out_valid, _ = fn(st, p, t, v)
+                acc_n = acc_n + jnp.sum(out_valid).astype(jnp.int32)
+                leaf = jax.tree.leaves(out["value"])[0]
+                acc_v = acc_v + jnp.sum(
+                    jnp.where(out_valid, leaf, 0.0)).astype(jnp.float32)
+                return (st, acc_n, acc_v), None
+            carry0 = (st, jnp.int32(0), jnp.float32(0.0))
+            (st, n, sv), _ = lax.scan(body, carry0, idxs)
+            return st, n, sv
+        return jax.jit(chained, donate_argnums=(0,))
+
+    def time_chained(fn, st):
+        ch = make_chained(fn)
+        st, n, sv = ch(st, idxs, stacked)       # compile + warm
+        jax.block_until_ready(sv)
+        rates = []
+        for _ in range(5):
+            t0 = time.perf_counter()
+            st, n, sv = ch(st, idxs, stacked)
+            jax.block_until_ready(sv)
+            rates.append(cfg["steps"] * CAP / (time.perf_counter() - t0))
+        return _median_disp(rates)
+
+    state2 = jax.device_put(
+        make_ffat_state(jnp.zeros((), jnp.float32), K, R), dev)
+    tuples_per_sec, dispersion = time_chained(step_fn, state2)
 
     # the same workload with the combiner DECLARED sum-like (flagless
     # sliding fold, windows/ffat_kernels._sliding_reduce_plain): reported
     # alongside — `value` stays the default-path number so round-over-round
     # vs_baseline compares like with like
-    step_sum = jax.jit(make_ffat_step(CAP, K, Pn, R, D, lift, comb, key_fn,
-                                      sum_like=True), donate_argnums=(0,))
+    step_sum_fn = make_ffat_step(CAP, K, Pn, R, D, lift, comb, key_fn,
+                                 sum_like=True)
     state_sum = jax.device_put(
         make_ffat_state(jnp.zeros((), jnp.float32), K, R), dev)
-    sum_tps, _, _ = time_steps(step_sum, state_sum)
+    sum_tps, _ = time_chained(step_sum_fn, state_sum)
 
     # p99 per-batch latency: timed with a sync per step (dispatch pipeline
     # drained), so it is an upper bound on steady-state window latency.
@@ -247,12 +312,23 @@ def run_bench(platform: str, cfg: dict, jax) -> dict:
         if platform == "tpu":
             hbm_bw = 819e9  # v5e peak HBM
             roofline["hbm_peak_gb_s"] = 819
-            roofline["hbm_utilization"] = round(
-                (tuples_per_sec / CAP) * step_bytes / hbm_bw, 4)
+            util = (tuples_per_sec / CAP) * step_bytes / hbm_bw
+            roofline["hbm_utilization"] = round(util, 4)
+            if util > 1.0:
+                # cost analysis sums every HLO's operand/result bytes
+                # PRE-fusion; fused producers never touch HBM, so the
+                # "measured" bytes are an upper bound on real traffic
+                roofline["hbm_utilization_note"] = (
+                    "xla cost-analysis bytes are a pre-fusion upper "
+                    "bound; utilization > 1 means fusion elides most of "
+                    "that traffic — treat bytes as bound, not "
+                    "measurement")
     return {
         "value": round(tuples_per_sec, 1),
-        "methodology": "median_of_5_windows",
+        "methodology": "scan_chained_median_of_5",
         "dispersion": dispersion,
+        "dispatch_value": round(dispatch_tps, 1),
+        "dispatch_dispersion": dispatch_disp,
         "sum_decl_value": round(sum_tps, 1),
         "p99_batch_latency_ms": round(p99_ms, 3),
         "roofline": roofline,
@@ -651,14 +727,20 @@ def load_history() -> dict:
         return {}
 
 
-def pick_baseline(runs: list, now: float) -> dict:
+def pick_baseline(runs: list, now: float,
+                  methodology: Optional[str] = None) -> dict:
     """The previous *round's* number, not a minutes-old rerun: the most
     recent run at least 2 hours old (rounds are ~12 h apart; same-round
-    debugging reruns are minutes apart), else the oldest run recorded."""
+    debugging reruns are minutes apart), else the oldest run recorded.
+    Prefers an entry recorded under the SAME methodology so vs_baseline
+    never reports a methodology switch as a speedup."""
     old = [r for r in runs if now - r.get("t", 0) >= 2 * 3600]
-    if old:
-        return old[-1]
-    return runs[0] if runs else {}
+    pool = old if old else (runs[:1] if runs else [])
+    if methodology:
+        same = [r for r in pool if r.get("methodology") == methodology]
+        if same:
+            return same[-1]
+    return pool[-1] if pool else {}
 
 
 def save_history(hist: dict) -> None:
@@ -784,13 +866,28 @@ def main() -> None:
     now = time.time()
     hist = load_history()
     runs = hist.setdefault(platform, [])
-    base = pick_baseline(runs, now)
+    base = pick_baseline(runs, now, result.get("methodology"))
     if base.get("value"):
-        result["vs_baseline"] = round(result["value"] / base["value"], 4)
+        if base.get("methodology") == result.get("methodology") or \
+                not result.get("dispatch_value"):
+            result["vs_baseline"] = round(
+                result["value"] / base["value"], 4)
+        else:
+            # the stored baseline predates scan-chaining and measured
+            # per-dispatch throughput: compare like with like
+            result["vs_baseline"] = round(
+                result["dispatch_value"] / base["value"], 4)
+            result["vs_baseline_note"] = (
+                "baseline entry predates the scan-chained methodology; "
+                "ratio uses dispatch_value (same per-dispatch "
+                "measurement as the baseline)")
         result["prev_value"] = base["value"]
+        result["prev_methodology"] = base.get("methodology")
     runs.append({"value": result["value"],
                  "methodology": result.get("methodology"),
                  "dispersion": result.get("dispersion"),
+                 "dispatch_value": result.get("dispatch_value"),
+                 "dispatch_dispersion": result.get("dispatch_dispersion"),
                  "sum_decl_value": result.get("sum_decl_value"),
                  "p99_batch_latency_ms": result["p99_batch_latency_ms"],
                  "e2e": result.get("e2e"),
