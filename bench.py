@@ -262,9 +262,19 @@ def run_bench(platform: str, cfg: dict, jax) -> dict:
             rates.append(cfg["steps"] * CAP / (time.perf_counter() - t0))
         return _median_disp(rates)
 
-    state2 = jax.device_put(
-        make_ffat_state(jnp.zeros((), jnp.float32), K, R), dev)
-    tuples_per_sec, dispersion = time_chained(step_fn, state2)
+    methodology = "scan_chained_median_of_5"
+    chained_error = None
+    try:
+        state2 = jax.device_put(
+            make_ffat_state(jnp.zeros((), jnp.float32), K, R), dev)
+        tuples_per_sec, dispersion = time_chained(step_fn, state2)
+    except Exception as e:
+        # the axon remote-compile helper intermittently 500s on the
+        # larger scan-chained program; the per-dispatch number is a
+        # jitter-prone but valid fallback — never zero the artifact
+        methodology = "median_of_5_windows(chained_compile_failed)"
+        tuples_per_sec, dispersion = dispatch_tps, dispatch_disp
+        chained_error = f"{type(e).__name__}: {e}"[:300]
 
     # the same workload with the combiner DECLARED sum-like (flagless
     # sliding fold, windows/ffat_kernels._sliding_reduce_plain): reported
@@ -274,7 +284,17 @@ def run_bench(platform: str, cfg: dict, jax) -> dict:
                                  sum_like=True)
     state_sum = jax.device_put(
         make_ffat_state(jnp.zeros((), jnp.float32), K, R), dev)
-    sum_tps, _ = time_chained(step_sum_fn, state_sum)
+    sum_methodology = "scan_chained_median_of_5"
+    try:
+        sum_tps, _ = time_chained(step_sum_fn, state_sum)
+    except Exception:
+        # mark the methodology switch so a per-dispatch sum number is
+        # never read against a chained `value` as a regression
+        sum_methodology = "median_of_5_windows(chained_compile_failed)"
+        step_sum = jax.jit(step_sum_fn, donate_argnums=(0,))
+        state_sum = jax.device_put(
+            make_ffat_state(jnp.zeros((), jnp.float32), K, R), dev)
+        sum_tps, _, _ = time_steps(step_sum, state_sum)
 
     # p99 per-batch latency: timed with a sync per step (dispatch pipeline
     # drained), so it is an upper bound on steady-state window latency.
@@ -323,19 +343,23 @@ def run_bench(platform: str, cfg: dict, jax) -> dict:
                     "bound; utilization > 1 means fusion elides most of "
                     "that traffic — treat bytes as bound, not "
                     "measurement")
-    return {
+    out = {
         "value": round(tuples_per_sec, 1),
-        "methodology": "scan_chained_median_of_5",
+        "methodology": methodology,
         "dispersion": dispersion,
         "dispatch_value": round(dispatch_tps, 1),
         "dispatch_dispersion": dispatch_disp,
         "sum_decl_value": round(sum_tps, 1),
+        "sum_decl_methodology": sum_methodology,
         "p99_batch_latency_ms": round(p99_ms, 3),
         "roofline": roofline,
         "config": {"cap": CAP, "keys": K, "win": cfg["win"],
                    "slide": cfg["slide"], "platform": platform,
                    "device": str(dev)},
     }
+    if chained_error:
+        out["chained_error"] = chained_error
+    return out
 
 
 def _e2e_graph(cfg: dict, n_tuples: int, chunks, lat_sink):
@@ -868,11 +892,18 @@ def main() -> None:
     runs = hist.setdefault(platform, [])
     base = pick_baseline(runs, now, result.get("methodology"))
     if base.get("value"):
-        if base.get("methodology") == result.get("methodology") or \
-                not result.get("dispatch_value"):
+        if base.get("methodology") == result.get("methodology"):
             result["vs_baseline"] = round(
                 result["value"] / base["value"], 4)
-        else:
+        elif result.get("dispatch_value") and base.get("dispatch_value"):
+            # methodologies differ but both runs carry the per-dispatch
+            # number: that is the one series present on both sides
+            result["vs_baseline"] = round(
+                result["dispatch_value"] / base["dispatch_value"], 4)
+            result["vs_baseline_note"] = (
+                "methodology differs from baseline; ratio compares "
+                "dispatch_value on both sides")
+        elif result.get("dispatch_value"):
             # the stored baseline predates scan-chaining and measured
             # per-dispatch throughput: compare like with like
             result["vs_baseline"] = round(
@@ -881,6 +912,12 @@ def main() -> None:
                 "baseline entry predates the scan-chained methodology; "
                 "ratio uses dispatch_value (same per-dispatch "
                 "measurement as the baseline)")
+        else:
+            result["vs_baseline"] = round(
+                result["value"] / base["value"], 4)
+            result["vs_baseline_note"] = (
+                "methodology differs from baseline and no shared "
+                "per-dispatch series exists; ratio is cross-methodology")
         result["prev_value"] = base["value"]
         result["prev_methodology"] = base.get("methodology")
     runs.append({"value": result["value"],

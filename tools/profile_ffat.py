@@ -7,7 +7,8 @@ program, so the dominant component is MEASURED before any kernel work:
   key_extract_argsort   stable argsort of the key lane (the sort pass)
   grouping_rank_scatter the O(n) counting permutation (windows/grouping.py)
   sort_gather           argsort + payload/lift gather (sort + data motion)
-  rank_scan             segment-start max-scan -> per-lane rank
+  rank_scan             segment-start max-scan -> per-lane rank (pre-r5)
+  rank_hist             histogram + [K+1] cumsum -> per-lane rank (live)
   pane_cells            segmented scan + scatter into [K+1, NP] pane cells
   sliding_fold          flag-aware dilated log2(R) fold over pane rows
   sliding_fold_plain    flagless fold (withSumCombiner variant)
@@ -62,12 +63,24 @@ def build_components(jax, jnp, CAP, K, Pn, R):
         return sk[order], payload["v"][order]
 
     def rank_scan(sk_sorted):
+        # the pre-r5 rank stage (kept for comparison): [CAP]-length
+        # associative max-scan over segment starts
         pos = jnp.arange(CAP)
         starts = jnp.concatenate(
             [jnp.array([True]), sk_sorted[1:] != sk_sorted[:-1]])
         seg_start = jax.lax.associative_scan(
             jnp.maximum, jnp.where(starts, pos, 0))
         return pos - seg_start
+
+    def rank_hist(payload, valid, sk_sorted):
+        # the live rank stage (ffat_kernels.py step, permutation branch):
+        # histogram of the UNSORTED keys + [K+1] exclusive cumsum —
+        # rank = pos - bucket_start[sorted key], no [CAP]-length scan
+        keys = payload["k"]
+        sk = jnp.where(valid & (keys >= 0) & (keys < K), keys, K)
+        hist = jnp.zeros(K + 1, jnp.int32).at[sk].add(1)
+        bucket_start = jnp.cumsum(hist) - hist
+        return jnp.arange(CAP) - bucket_start[sk_sorted]
 
     def pane_cells(sk_sorted, v_sorted, pane_rel):
         starts = jnp.concatenate(
@@ -117,6 +130,7 @@ def build_components(jax, jnp, CAP, K, Pn, R):
         "grouping_rank_scatter": grouping_rank_scatter,
         "sort_gather": sort_gather,
         "rank_scan": rank_scan,
+        "rank_hist": rank_hist,
         "pane_cells": pane_cells,
         "sliding_fold": sliding_fold,
         "sliding_fold_plain": sliding_fold_plain,
@@ -173,6 +187,7 @@ def main():
         "grouping_rank_scatter": (payload, valid),
         "sort_gather": (payload, valid),
         "rank_scan": (sk_sorted,),
+        "rank_hist": (payload, valid, sk_sorted),
         "pane_cells": (sk_sorted, v_sorted, pane_rel),
         "sliding_fold": (cells, cell_has),
         "sliding_fold_plain": (cells, cell_has),

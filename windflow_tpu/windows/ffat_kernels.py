@@ -14,7 +14,8 @@ import jax
 import jax.numpy as jnp
 
 from windflow_tpu.utils.dtypes import cast_state_update
-from windflow_tpu.windows.grouping import auto_order, dense_rank
+from windflow_tpu.windows.grouping import (auto_order, dense_rank,
+                                           order_and_hist)
 
 
 def _group_order(ids, nbuckets: int, grouping: str):
@@ -26,6 +27,17 @@ def _group_order(ids, nbuckets: int, grouping: str):
     if grouping == "rank_scatter":
         return auto_order(ids, nbuckets)
     return jnp.argsort(ids, stable=True)
+
+
+def _group_order_hist(ids, nbuckets: int, grouping: str):
+    """``_group_order`` plus the ``[nbuckets]`` histogram of ids — on the
+    single-counting-pass grouping the histogram is the ``dense_rank``
+    byproduct, so the CB step's rank arithmetic costs no extra pass."""
+    if grouping == "rank_scatter":
+        return order_and_hist(ids, nbuckets)
+    order = jnp.argsort(ids, stable=True)
+    return order, jnp.zeros(nbuckets, jnp.int32) \
+        .at[ids.astype(jnp.int32)].add(1)
 
 
 def _seg_scan(comb, flags, values):
@@ -235,18 +247,25 @@ def make_ffat_step(capacity: int, K: int, P: int, R: int, D: int,
                     add, cell_leaf.dtype, "FFAT pane merge"))
             cells = jax.tree.map(merge0_add, state["cur"], cells)
         else:
-            order = _group_order(skey_for_sort, K + 1, grouping)
+            # after a STABLE grouping by dense key, bucket b's lanes
+            # occupy [start_b, start_b + hist_b), so the within-key rank
+            # is index arithmetic off a histogram of the keys — no
+            # [B]-length scan, no segment_sum (r5 TPU profile: the rank
+            # scan was the dominant standalone stage, 0.086 ms of a
+            # 0.100 ms step; a [K+1] cumsum replaces it).  The histogram
+            # itself is the counting permutation's dense_rank byproduct
+            # on the single-pass path — free.
+            order, hist = _group_order_hist(skey_for_sort, K + 1,
+                                            grouping)
             sk = skey_for_sort[order]
             slift = jax.tree.map(lambda a: a[order],
                                  jax.vmap(lift)(payload))
             pos = jnp.arange(B)
-            starts = jnp.concatenate([jnp.array([True]), sk[1:] != sk[:-1]])
-            seg_start_pos = jax.lax.associative_scan(
-                jnp.maximum, jnp.where(starts, pos, 0))
-            rank = pos - seg_start_pos
+            bucket_start = jnp.cumsum(hist) - hist        # exclusive
+            rank = pos - bucket_start[sk]
+            starts = rank == 0
 
-            n_k = jax.ops.segment_sum(ok[order].astype(jnp.int32), sk,
-                                      num_segments=K + 1)[:K]
+            n_k = hist[:K]      # buckets < K hold exactly the ok lanes
             fill0 = state["cur_fill"][jnp.minimum(sk, K - 1)]
             pane_rel = ((fill0 + rank) // P).astype(jnp.int32)
 

@@ -88,6 +88,14 @@ def _single_digit_order(ids, nbuckets: int):
     """Stable counting-sort permutation for ids in ``[0, nbuckets)``,
     ``nbuckets`` one digit wide.  Returns gather indices ``order`` with
     ``ids[order]`` sorted, ties in arrival order."""
+    order, _ = _single_digit_order_counts(ids, nbuckets)
+    return order
+
+
+def _single_digit_order_counts(ids, nbuckets: int):
+    """``_single_digit_order`` plus the ``[nbuckets]`` histogram of ids —
+    the ``dense_rank`` byproduct callers would otherwise recompute with a
+    second full-length scatter-add."""
     B = ids.shape[0]
     rank, counts, idsp, pos = dense_rank(ids, nbuckets)
     Bp = pos.shape[0]
@@ -101,7 +109,7 @@ def _single_digit_order(ids, nbuckets: int):
     # 3. dest is a permutation of [0, Bp): invert by scattering iota
     dest = start[idsp] + rank
     order = jnp.zeros(Bp, jnp.int32).at[dest].set(pos, unique_indices=True)
-    return order[:B]
+    return order[:B], counts
 
 
 def invert_perm(order):
@@ -122,6 +130,19 @@ def auto_order(ids, nbuckets: int):
     if nbuckets <= DIGIT * DIGIT:
         return counting_order(ids, nbuckets)
     return jnp.argsort(ids, stable=True)
+
+
+def order_and_hist(ids, nbuckets: int):
+    """``auto_order`` plus the ``[nbuckets]`` histogram of ids.  On the
+    single-counting-pass path the histogram is the ``dense_rank``
+    byproduct — free; the radix and argsort paths pay one O(n)
+    scatter-add (the per-digit passes count digit buckets, never the
+    full id space, so there is nothing to reuse there)."""
+    if nbuckets <= DIGIT + 1:
+        return _single_digit_order_counts(ids, nbuckets)
+    order = auto_order(ids, nbuckets)
+    hist = jnp.zeros(nbuckets, jnp.int32).at[ids.astype(jnp.int32)].add(1)
+    return order, hist
 
 
 def counting_order(ids, nbuckets: int):
