@@ -1,0 +1,103 @@
+"""The bench's unrolled-chain fallback (bench.make_unrolled_chain) must
+measure the SAME computation as sequential per-dispatch stepping: state
+threads through every unrolled step and the fired-window accumulators
+match the windows the sequential path fires.
+
+The fallback exists because the axon remote-compile helper rejects any
+``lax.scan`` around the FFAT step (HTTP 500 even at scan length 1 — r5
+bisect) — so on that backend the chained kernel number comes from this
+code path, and a silent divergence here would corrupt the headline
+metric.  Distinct batches per unrolled step are part of the contract
+(shared batches let XLA CSE the payload-only grouping stages and the
+chain measures a several-times-lighter program)."""
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import bench
+from windflow_tpu.windows.ffat_kernels import make_ffat_state, make_ffat_step
+
+CAP, K, WIN, SLIDE = 2048, 16, 256, 32
+
+
+def _mk_step():
+    Pn = math.gcd(WIN, SLIDE)
+    R, D = WIN // Pn, SLIDE // Pn
+    step = make_ffat_step(CAP, K, Pn, R, D, lambda x: x["v"],
+                          lambda a, b: a + b, lambda x: x["k"])
+    state = make_ffat_state(jnp.zeros((), jnp.float32), K, R)
+    return step, state
+
+
+def _mk_batches(n, rng):
+    out = []
+    for i in range(n):
+        valid = jnp.asarray(rng.random(CAP) > 0.1)   # some invalid lanes
+        out.append((
+            {"k": jnp.asarray(rng.integers(0, K, CAP), jnp.int32),
+             "v": jnp.asarray(rng.random(CAP, dtype=np.float32))},
+            jnp.asarray(np.arange(CAP) + i * CAP, jnp.int64),
+            valid,
+        ))
+    return out
+
+
+def test_unrolled_chain_matches_sequential_steps():
+    unroll = 3
+    step_fn, state0 = _mk_step()
+    rng = np.random.default_rng(7)
+    batches = _mk_batches(unroll, rng)
+
+    # sequential per-dispatch reference
+    step = jax.jit(step_fn)
+    st = state0
+    n_ref = 0
+    v_ref = 0.0
+    for payload, ts, valid in batches:
+        st, out, out_valid, _ = step(st, payload, ts, valid)
+        n_ref += int(jnp.sum(out_valid))
+        v_ref += float(jnp.sum(jnp.where(out_valid, out["value"], 0.0)))
+    assert n_ref > 0, "shapes must fire windows or the test proves nothing"
+
+    # one unrolled-chain dispatch over the same batches
+    chain = bench.make_unrolled_chain(jax, step_fn, unroll)
+    flat = [x for (p, ts, valid) in batches
+            for x in (p["k"], p["v"], ts, valid)]
+    st_ch, n_ch, v_ch = chain(state0, *flat)
+
+    assert int(n_ch) == n_ref
+    np.testing.assert_allclose(float(v_ch), v_ref, rtol=1e-5)
+    for a, b in zip(jax.tree.leaves(st), jax.tree.leaves(st_ch)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_unrolled_chain_continues_across_dispatches():
+    """Chained dispatches thread state exactly like 2*unroll sequential
+    steps (the timing loop calls the chain repeatedly)."""
+    unroll = 2
+    step_fn, state0 = _mk_step()
+    rng = np.random.default_rng(8)
+    batches = _mk_batches(2 * unroll, rng)
+
+    step = jax.jit(step_fn)
+    st = state0
+    n_ref = 0
+    for payload, ts, valid in batches:
+        st, out, out_valid, _ = step(st, payload, ts, valid)
+        n_ref += int(jnp.sum(out_valid))
+
+    chain = bench.make_unrolled_chain(jax, step_fn, unroll)
+    st_ch = state0
+    n_ch = 0
+    for d in range(2):
+        flat = [x for (p, ts, valid) in batches[d * unroll:(d + 1) * unroll]
+                for x in (p["k"], p["v"], ts, valid)]
+        st_ch, n, _ = chain(st_ch, *flat)
+        n_ch += int(n)
+
+    assert n_ch == n_ref
+    for a, b in zip(jax.tree.leaves(st), jax.tree.leaves(st_ch)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
