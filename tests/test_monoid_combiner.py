@@ -1,0 +1,255 @@
+"""Declared-monoid combiners (withMonoidCombiner: sum | max | min).
+
+The declaration routes count-based FFAT onto the scatter-combine /
+flagless-fold fast paths and time-based FFAT onto the sort-free ring
+placement — for max/min those paths are IDEMPOTENT, so results must be
+bit-identical to the default flag-aware machinery (no float-reorder
+tolerance needed, unlike "sum").
+
+Values are strictly NEGATIVE floats throughout: any slot the kernels
+fill with 0 instead of the monoid identity (-inf for max) would win a
+max and corrupt a window, so these streams catch identity bugs that
+non-negative data hides.  Reference anchor: the CUDA FFAT pays its
+sort/tree machinery for every combiner alike
+(``ffat_replica_gpu.hpp:751,917``); the declared-monoid bypass is
+TPU-side design, not ported behavior.
+"""
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import windflow_tpu as wf
+from windflow_tpu.windows.ffat_kernels import (make_ffat_state,
+                                               make_ffat_step,
+                                               make_ffat_tb_state,
+                                               make_ffat_tb_step)
+
+CAP, K, WIN, SLIDE = 512, 8, 64, 16
+Pn = math.gcd(WIN, SLIDE)
+R, D = WIN // Pn, SLIDE // Pn
+
+
+def _batches(n, rng, negative=True):
+    out = []
+    for i in range(n):
+        vals = rng.random(CAP, dtype=np.float32)
+        if negative:
+            vals = -1.0 - vals          # all < -1: identity bugs surface
+        out.append((
+            {"k": jnp.asarray(rng.integers(0, K, CAP), jnp.int32),
+             "v": jnp.asarray(vals)},
+            jnp.asarray(np.arange(CAP) + i * CAP, jnp.int64),
+            jnp.asarray(rng.random(CAP) > 0.15),     # invalid lanes too
+        ))
+    return out
+
+
+def _run_cb(monoid, comb, batches, grouping="rank_scatter"):
+    step = jax.jit(make_ffat_step(CAP, K, Pn, R, D, lambda x: x["v"], comb,
+                                  lambda x: x["k"], monoid=monoid,
+                                  grouping=grouping))
+    st = make_ffat_state(jnp.zeros((), jnp.float32), K, R)
+    fired = {}
+    for payload, ts, valid in batches:
+        st, out, ov, _ = step(st, payload, ts, valid)
+        ovn = np.asarray(ov)
+        keys = np.asarray(out["key"])[ovn]
+        wids = np.asarray(out["wid"])[ovn]
+        vals = np.asarray(out["value"])[ovn]
+        for k_, w_, v_ in zip(keys, wids, vals):
+            fired[(int(k_), int(w_))] = float(v_)
+    return fired, st
+
+
+@pytest.mark.parametrize("monoid,comb", [
+    ("max", lambda a, b: jnp.maximum(a, b)),
+    ("min", lambda a, b: jnp.minimum(a, b)),
+])
+def test_cb_monoid_scatter_path_bit_identical_to_default(monoid, comb):
+    """Declared max/min (idempotent) on the CB scatter-combine path must
+    equal the undeclared flag-aware path EXACTLY, windows and state."""
+    rng = np.random.default_rng(11)
+    batches = _batches(6, rng)
+    got, st_m = _run_cb(monoid, comb, batches)
+    want, st_d = _run_cb(None, comb, batches)
+    assert got == want and len(got) > 0
+    for a, b in zip(jax.tree.leaves(st_m), jax.tree.leaves(st_d)):
+        if a.dtype == jnp.bool_ or jnp.issubdtype(a.dtype, jnp.integer):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_cb_monoid_flagless_fold_under_argsort_grouping():
+    """monoid + argsort grouping exercises the permutation path with the
+    identity-filled flagless fold (no scatter-combine) — still exact."""
+    rng = np.random.default_rng(12)
+    batches = _batches(5, rng)
+    got, _ = _run_cb("max", lambda a, b: jnp.maximum(a, b), batches,
+                     grouping="argsort")
+    want, _ = _run_cb(None, lambda a, b: jnp.maximum(a, b), batches,
+                      grouping="argsort")
+    assert got == want and len(got) > 0
+
+
+def test_cb_declared_sum_still_matches_int_oracle():
+    """The legacy sum declaration through the generalized plumbing:
+    integer sums are exact, so declared == undeclared bitwise."""
+    rng = np.random.default_rng(13)
+    batches = []
+    for i in range(5):
+        payload = {"k": jnp.asarray(rng.integers(0, K, CAP), jnp.int32),
+                   "v": jnp.asarray(rng.integers(-50, 50, CAP), jnp.int32)}
+        batches.append((payload,
+                        jnp.asarray(np.arange(CAP) + i * CAP, jnp.int64),
+                        jnp.asarray(rng.random(CAP) > 0.1)))
+    step_kw = dict(sum_like=True)    # legacy spelling must still work
+
+    def run(**kw):
+        step = jax.jit(make_ffat_step(
+            CAP, K, Pn, R, D, lambda x: x["v"], lambda a, b: a + b,
+            lambda x: x["k"], **kw))
+        st = make_ffat_state(jnp.zeros((), jnp.int32), K, R)
+        fired = {}
+        for payload, ts, valid in batches:
+            st, out, ov, _ = step(st, payload, ts, valid)
+            m = np.asarray(ov)
+            for k_, w_, v_ in zip(np.asarray(out["key"])[m],
+                                  np.asarray(out["wid"])[m],
+                                  np.asarray(out["value"])[m]):
+                fired[(int(k_), int(w_))] = int(v_)
+        return fired
+    assert run(**step_kw) == run() and len(run()) > 0
+
+
+def test_tb_monoid_scatter_placement_matches_default():
+    """TB max through the sort-free scatter placement == the grouped
+    default, against a python oracle."""
+    stream = [{"key": i % 3, "value": -1.0 - ((i * 37) % 101) / 10.0,
+               "ts": i * 1000} for i in range(240)]
+    per_key = {}
+    for t in stream:
+        per_key.setdefault(t["key"], []).append((t["ts"], t["value"]))
+    # oracle: per-key max over every [w*4000, w*4000+16000) window
+    exp = {}
+    for k_, pts in per_key.items():
+        tmax = max(ts for ts, _ in pts)
+        w = 0
+        while w * 4000 <= tmax:
+            vals = [v for ts, v in pts
+                    if w * 4000 <= ts < w * 4000 + 16000]
+            if vals:
+                exp[(k_, w)] = max(vals)
+            w += 1
+    # windows whose span starts after the last tuple never fire; also the
+    # trailing partials fire at EOS — both covered by comparing sets
+    for declare in (False, True):
+        got = {}
+        src = (wf.Source_Builder(lambda: iter(stream))
+               .withTimestampExtractor(lambda t: t["ts"])
+               .withOutputBatchSize(31).build())
+        b = (wf.Ffat_WindowsTPU_Builder(
+                lambda t: t["value"], lambda a, b: jnp.maximum(a, b))
+             .withKeyBy(lambda t: t["key"]).withMaxKeys(3)
+             .withTBWindows(16_000, 4_000))
+        if declare:
+            b = b.withMonoidCombiner("max")
+        snk = wf.Sink_Builder(
+            lambda r: got.__setitem__((r["key"], r["wid"]), r["value"])
+            if r is not None else None).build()
+        g = wf.PipeGraph("ffat_tb_max", wf.ExecutionMode.DEFAULT,
+                         wf.TimePolicy.EVENT)
+        g.add_source(src).add(b.build()).add_sink(snk)
+        g.run()
+        assert got == exp, (declare, len(got), len(exp))
+
+
+def test_whole_graph_cb_sliding_min_matches_oracle():
+    """Builder plumbing end-to-end: withMonoidCombiner("min") on CB
+    windows through PipeGraph.run() against a python sliding-min oracle."""
+    N, NK, W, S = 4000, 5, 32, 8
+    vals = [-(1.0 + ((i * 13) % 97)) for i in range(N)]
+
+    def gen():
+        for i in range(N):
+            yield {"key": i % NK, "v": vals[i]}
+
+    per_key = {}
+    for i in range(N):
+        per_key.setdefault(i % NK, []).append(vals[i])
+    exp = {}
+    for k_, vs in per_key.items():
+        wid = 0
+        start = 0
+        while start + W <= len(vs):
+            exp[(k_, wid)] = min(vs[start:start + W])
+            wid += 1
+            start += S
+    got = {}
+    src = wf.Source_Builder(gen).withOutputBatchSize(256).build()
+    op = (wf.Ffat_WindowsTPU_Builder(
+            lambda t: t["v"], lambda a, b: jnp.minimum(a, b))
+          .withCBWindows(W, S).withKeyBy(lambda t: t["key"])
+          .withMaxKeys(NK).withMonoidCombiner("min").build())
+    snk = wf.Sink_Builder(
+        lambda r: got.__setitem__((r["key"], r["wid"]), r["value"])
+        if r is not None else None).build()
+    g = wf.PipeGraph("ffat_cb_min", wf.ExecutionMode.DEFAULT)
+    g.add_source(src).add(op).add_sink(snk)
+    g.run()
+    for key, v in exp.items():
+        assert key in got and abs(got[key] - v) < 1e-6, key
+    # EOS flushes trailing partial windows beyond the oracle's full ones
+    assert len(got) >= len(exp)
+
+
+def test_unknown_monoid_rejected():
+    with pytest.raises(wf.WindFlowError, match="monoid"):
+        (wf.Ffat_WindowsTPU_Builder(lambda t: t["v"],
+                                    lambda a, b: a * b)
+         .withCBWindows(32, 8).withMaxKeys(4)
+         .withMonoidCombiner("product").build())
+    with pytest.raises(ValueError, match="monoid"):
+        make_ffat_step(64, 4, 8, 4, 1, lambda x: x["v"],
+                       lambda a, b: a + b, lambda x: x["k"],
+                       monoid="product")
+    with pytest.raises(ValueError, match="monoid"):
+        make_ffat_tb_step(64, 4, 1000, 4, 1, 64, lambda x: x["v"],
+                          lambda a, b: a + b, lambda x: x["k"],
+                          monoid="product")
+
+
+def test_tb_kernel_monoid_min_negative_and_positive():
+    """Direct TB kernel check with mixed-sign values and a min monoid
+    (identity +inf): declared == undeclared exactly."""
+    B, KK, P_usec, RR, DD, NP = 128, 4, 1000, 4, 1, 64
+    rng = np.random.default_rng(14)
+
+    def run(monoid):
+        step = jax.jit(make_ffat_tb_step(
+            B, KK, P_usec, RR, DD, NP, lambda x: x["v"],
+            lambda a, b: jnp.minimum(a, b), lambda x: x["k"],
+            monoid=monoid))
+        st = make_ffat_tb_state(jnp.zeros((), jnp.float32), KK, NP)
+        fired = {}
+        for i in range(4):
+            payload = {"k": jnp.asarray(rng.integers(0, KK, B), jnp.int32),
+                       "v": jnp.asarray(
+                           rng.standard_normal(B).astype(np.float32))}
+            ts = jnp.asarray(np.arange(B) * 250 + i * B * 250, jnp.int64)
+            valid = jnp.asarray(rng.random(B) > 0.2)
+            wm = jnp.asarray((i * B * 250) // P_usec, jnp.int64)
+            st, out, f, _, _ = step(st, payload, ts, valid, wm)
+            m = np.asarray(f)
+            for k_, w_, v_ in zip(np.asarray(out["key"])[m],
+                                  np.asarray(out["wid"])[m],
+                                  np.asarray(out["value"])[m]):
+                fired[(int(k_), int(w_))] = float(v_)
+        return fired
+    rng = np.random.default_rng(14)
+    a = run("min")
+    rng = np.random.default_rng(14)
+    b = run(None)
+    assert a == b and len(a) > 0

@@ -599,7 +599,7 @@ class Ffat_WindowsTPU_Builder(_WindowBuilderBase):
         self._max_keys = 1
         self._pane_capacity = None
         self._overflow_policy = "drop"
-        self._sum_like = False
+        self._monoid = None
 
     def withMaxKeys(self, n: int):
         """Size of the dense device key space [0, n)."""
@@ -610,19 +610,34 @@ class Ffat_WindowsTPU_Builder(_WindowBuilderBase):
         """Declare the combiner leafwise ADDITION (``comb(a, b) == a + b``
         on every leaf — the same strictly-additive contract as
         ReduceTPU_Builder.withSumCombiner, whose mesh path rides
-        ``lax.psum``): count-based windows then run a flagless sliding
-        fold with half the operand traffic AND, under the default
-        ``rank_scatter`` grouping with ``withMaxKeys <= 4096`` (the bound
-        on the rank table), skip the batch permutation entirely —
-        lifts scatter-add straight into pane cells (float rounding order
-        may differ from the sequential fold, exactly as under psum).
-        Strictly additive: a merely zero-absorbing combiner (max over
-        non-negatives, ...) would silently compute sums on the
-        scatter-add path — do not declare it.  Time-based windows gain
-        even more: a TB tuple's pane cell is pure timestamp arithmetic,
-        so placement needs no grouping at all and the whole
-        sort/segmented-scan machinery disappears."""
-        self._sum_like = True
+        ``lax.psum``).  Shorthand for ``withMonoidCombiner("sum")`` —
+        see there for what the declaration buys and its exactness
+        contract (a merely zero-absorbing combiner like max must declare
+        its OWN kind, never "sum")."""
+        self._monoid = "sum"
+        return self
+
+    def withMonoidCombiner(self, kind: str):
+        """Declare the combiner a leafwise commutative monoid —
+        ``"sum"`` (``a + b``), ``"max"`` (``maximum(a, b)``) or ``"min"``
+        (``minimum(a, b)``) on every leaf.  Count-based windows then run
+        a flagless sliding fold with half the operand traffic AND, under
+        the default ``rank_scatter`` grouping with ``withMaxKeys <=
+        4096`` (the bound on the rank table), skip the batch permutation
+        entirely — lifts scatter-combine straight into pane cells (for
+        "sum", float rounding order may differ from the sequential fold,
+        exactly as under psum; max/min are idempotent, so results are
+        identical).  Time-based windows gain even more: a TB tuple's
+        pane cell is pure timestamp arithmetic, so placement needs no
+        grouping at all and the whole sort/segmented-scan machinery
+        disappears.  The declaration must match the combiner EXACTLY on
+        every leaf — declaring the wrong kind silently computes the
+        declared operation instead of the combiner's.  Reference anchor:
+        the CUDA FFAT pays its sort/tree for every combiner alike
+        (``ffat_replica_gpu.hpp:751,917``); declared monoids are the
+        TPU-side win for the common aggregates (sum/count/avg via sum,
+        max, min)."""
+        self._monoid = kind
         return self
 
     def withPaneCapacity(self, n: int):
@@ -649,4 +664,4 @@ class Ffat_WindowsTPU_Builder(_WindowBuilderBase):
             key_extractor=self._key_extractor,
             pane_capacity=self._pane_capacity,
             overflow_policy=self._overflow_policy,
-            sum_like=self._sum_like)
+            monoid=self._monoid)

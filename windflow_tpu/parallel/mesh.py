@@ -339,7 +339,8 @@ def make_sharded_ffat_step(mesh: Mesh, capacity: int, K: int, Pn: int, R: int,
                            key_fn: Optional[Callable],
                            sum_like: bool = False,
                            grouping: str = "rank_scatter",
-                           ingest: str = "data"):
+                           ingest: str = "data",
+                           monoid: Optional[str] = None):
     """Compile one FFAT window step sharded over the mesh.
 
     State tables are split along ``key`` (chip *i* owns keys
@@ -351,7 +352,8 @@ def make_sharded_ffat_step(mesh: Mesh, capacity: int, K: int, Pn: int, R: int,
         mesh, capacity, K, ingest)
     step_local = make_ffat_step(capacity, K_local, Pn, R, D, lift, comb,
                                 key_fn, key_base_fn=key_base_fn,
-                                sum_like=sum_like, grouping=grouping)
+                                sum_like=sum_like, grouping=grouping,
+                                monoid=monoid)
 
     def local(state, payload, ts, valid):
         payload, ts, valid = gather(payload, ts, valid)
@@ -505,7 +507,8 @@ def make_sharded_ffat_tb_step(mesh: Mesh, capacity: int, K: int, P_usec: int,
                               drop_tainted: bool = False,
                               grouping: str = "rank_scatter",
                               ingest: str = "data",
-                              sum_like: bool = False):
+                              sum_like: bool = False,
+                              monoid: Optional[str] = None):
     """Compile one time-based FFAT step sharded over the mesh.
 
     Same layout as the CB variant (:func:`make_sharded_ffat_step`): state
@@ -521,7 +524,8 @@ def make_sharded_ffat_tb_step(mesh: Mesh, capacity: int, K: int, P_usec: int,
                                    lift, comb, key_fn,
                                    key_base_fn=key_base_fn,
                                    drop_tainted=drop_tainted,
-                                   grouping=grouping, sum_like=sum_like)
+                                   grouping=grouping, sum_like=sum_like,
+                                   monoid=monoid)
 
     def local(state, payload, ts, valid, wm_pane):
         payload, ts, valid = gather(payload, ts, valid)

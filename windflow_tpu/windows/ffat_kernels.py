@@ -76,14 +76,15 @@ def _b(mask, ref):
     return mask.reshape(mask.shape + (1,) * (ref.ndim - mask.ndim))
 
 
-def _shift_leaf(a, k: int, axis: int):
+def _shift_leaf(a, k: int, axis: int, fill=0):
     """Shift one leaf along ``axis`` by ``k`` toward higher indices,
-    zero/False-filling the vacated slots."""
+    filling the vacated slots with ``fill`` (0/False by default; the
+    declared-monoid fold passes the monoid identity)."""
     pad = [(0, 0)] * a.ndim
     pad[axis] = (k, 0)
     s = [slice(None)] * a.ndim
     s[axis] = slice(0, a.shape[axis])
-    return jnp.pad(a, pad)[tuple(s)]
+    return jnp.pad(a, pad, constant_values=fill)[tuple(s)]
 
 
 def _shift_right(flags, values, k: int, axis: int):
@@ -139,19 +140,73 @@ def _sliding_reduce(comb, flags, values, R: int, axis: int):
     return res
 
 
-def _sliding_reduce_plain(comb, flags, values, R: int, axis: int):
-    """Flagless dilated sliding fold for ZERO-ABSORBING combiners
-    (declared via withSumCombiner): invalid entries are zero-filled once,
-    then the log2(R) doubling runs on values alone — half the operand
-    traffic of the flag-aware fold.  Only valid when ``comb(x, 0) == x``
-    on every leaf (sum and friends)."""
-    zeroed = jax.tree.map(lambda a: jnp.where(_b(flags, a), a, 0), values)
+#: declared combiner monoids (withMonoidCombiner): one source of truth
+#: mapping kind -> (``.at[]`` scatter method, elementwise combine); the
+#: contract is ``comb(x, identity) == x`` leafwise (identity per dtype
+#: from :func:`_monoid_identity`), so identity-filled slots are absorbed
+#: without a has-mask.  A new kind goes here + ``_monoid_identity``.
+_MONOID_OPS = {
+    "sum": ("add", jnp.add),
+    "max": ("max", jnp.maximum),
+    "min": ("min", jnp.minimum),
+}
+_MONOID_KINDS = tuple(_MONOID_OPS)
 
-    # zero-fill shift: the vacated slots hold the combiner's identity
+
+def resolve_monoid(sum_like: bool, monoid):
+    """Normalize the legacy ``sum_like`` flag into a monoid kind and
+    validate it — the single gatekeeper shared by both kernel builders
+    and the operator layer."""
+    if sum_like and monoid is None:
+        monoid = "sum"
+    if monoid is not None and monoid not in _MONOID_OPS:
+        raise ValueError(f"unknown monoid {monoid!r}; "
+                         f"expected one of {_MONOID_KINDS}")
+    return monoid
+
+
+def _monoid_identity(kind: str, dtype):
+    """The absorbing identity of a declared monoid for one leaf dtype."""
+    dt = jnp.dtype(dtype)
+    if kind == "sum":
+        return jnp.zeros((), dt)
+    if dt == jnp.bool_:
+        # max over bool == any (ident False); min == all (ident True)
+        return jnp.asarray(kind == "min", bool)
+    if jnp.issubdtype(dt, jnp.floating):
+        return jnp.asarray(-jnp.inf if kind == "max" else jnp.inf, dt)
+    info = jnp.iinfo(dt)
+    return jnp.asarray(info.min if kind == "max" else info.max, dt)
+
+
+def _monoid_scatter(buf_at, kind: str):
+    """The scatter-combine method of ``x.at[idx]`` for a monoid kind."""
+    return getattr(buf_at, _MONOID_OPS[kind][0])
+
+
+def _monoid_fill(kind: str, flags, values):
+    """Replace invalid entries with the monoid identity, leafwise."""
+    return jax.tree.map(
+        lambda a: jnp.where(_b(flags, a), a,
+                            _monoid_identity(kind, a.dtype)), values)
+
+
+def _sliding_reduce_plain(comb, flags, values, R: int, axis: int,
+                          monoid: str = "sum"):
+    """Flagless dilated sliding fold for declared-monoid combiners
+    (withSumCombiner / withMonoidCombiner): invalid entries are filled
+    with the monoid identity once, then the log2(R) doubling runs on
+    values alone — half the operand traffic of the flag-aware fold.
+    Only valid when ``comb(x, identity) == x`` on every leaf."""
+    zeroed = _monoid_fill(monoid, flags, values)
+
+    # identity-fill shift: the vacated slots hold the combiner's identity
     def zshift(v, k):
         if k == 0:
             return v
-        return jax.tree.map(lambda a: _shift_leaf(a, k, axis), v)
+        return jax.tree.map(
+            lambda a: _shift_leaf(
+                a, k, axis, fill=_monoid_identity(monoid, a.dtype)), v)
 
     pow2 = [zeroed]
     width = 1
@@ -174,7 +229,8 @@ def make_ffat_step(capacity: int, K: int, P: int, R: int, D: int,
                    lift: Callable, comb: Callable,
                    key_fn: Optional[Callable],
                    key_base_fn: Optional[Callable[[], Any]] = None,
-                   sum_like: bool = False, grouping: str = "rank_scatter"):
+                   sum_like: bool = False, grouping: str = "rank_scatter",
+                   monoid: Optional[str] = None):
     """Build the (un-jitted) FFAT per-batch program.
 
     Pure-function form of the operator step so the multi-chip layer
@@ -195,15 +251,19 @@ def make_ffat_step(capacity: int, K: int, P: int, R: int, D: int,
     reference's ``numWinsPerBatch`` output buffer is likewise sized to
     fired windows, not the worst case, ``flatfat_gpu.hpp:60-139``).
 
-    Declared-sum fast path: ``sum_like`` declares the combiner leafwise
-    addition-compatible (the same contract the mesh reduce commits to when
-    it rides ``lax.psum``, parallel/mesh.py), so with ``rank_scatter``
+    Declared-monoid fast path: ``monoid`` ("sum" | "max" | "min"; the
+    legacy ``sum_like=True`` means ``monoid="sum"``) declares the
+    combiner a leafwise commutative monoid with an absorbing identity
+    (the "sum" contract is the one the mesh reduce commits to when it
+    rides ``lax.psum``, parallel/mesh.py), so with ``rank_scatter``
     grouping the step skips the permutation entirely — each lane's
     within-key rank (grouping.dense_rank) gives its pane cell and lifts
-    scatter-ADD straight into the [K, NP1] grid.  No sorted layout, no
-    segmented scan, no run-end detection.  Addition is commutative, so
-    only float rounding order differs from the sequential fold (exactly
-    the tolerance psum already implies)."""
+    scatter-COMBINE (add/max/min) straight into the [K, NP1] grid.  No
+    sorted layout, no segmented scan, no run-end detection.  The declared
+    op is commutative, so only float rounding order differs from the
+    sequential fold (exactly the tolerance psum already implies; max/min
+    are idempotent — bit-identical either way)."""
+    monoid = resolve_monoid(sum_like, monoid)
     NP1 = capacity // P + 2           # pane cells incl. continuation cell
     # total fired across all keys: sum_k panes_k/D + per-key partials
     MAXO = capacity // (P * D) + 2 * K + 8
@@ -211,7 +271,8 @@ def make_ffat_step(capacity: int, K: int, P: int, R: int, D: int,
     # the gate only bounds its [capacity/CHUNK, K+1] chunk-histogram
     # (int32) to a sane size — 4096 keys at the TPU bench capacity is a
     # ~134 MB table.  Beyond it the permutation path still applies.
-    scatter_add = (sum_like and grouping == "rank_scatter" and K <= 4096)
+    scatter_combine = (monoid is not None and grouping == "rank_scatter"
+                       and K <= 4096)
 
     def step(state, payload, ts, valid):
         B = capacity
@@ -223,7 +284,7 @@ def make_ffat_step(capacity: int, K: int, P: int, R: int, D: int,
         ok = valid & (keys >= 0) & (keys < K)
         skey_for_sort = jnp.where(ok, keys, K)
 
-        if scatter_add:
+        if scatter_combine:
             rank_p, counts, _, _ = dense_rank(skey_for_sort, K + 1)
             rank_u = rank_p[:B]
             n_k = counts[:K]
@@ -232,20 +293,25 @@ def make_ffat_step(capacity: int, K: int, P: int, R: int, D: int,
             col_u = jnp.where(
                 ok, ((fill0_u + rank_u) // P).astype(jnp.int32), 0)
 
-            def scat_add(leaf):
-                buf = jnp.zeros((K + 1, NP1) + leaf.shape[1:], leaf.dtype)
-                return buf.at[skey_for_sort, col_u].add(
-                    jnp.where(_b(ok, leaf), leaf, 0))[:K]
-            cells = jax.tree.map(scat_add, lifts)
+            def scat(leaf):
+                ident = _monoid_identity(monoid, leaf.dtype)
+                buf = jnp.full((K + 1, NP1) + leaf.shape[1:], ident,
+                               leaf.dtype)
+                return _monoid_scatter(
+                    buf.at[skey_for_sort, col_u], monoid)(
+                    jnp.where(_b(ok, leaf), leaf, ident))[:K]
+            cells = jax.tree.map(scat, lifts)
 
-            # carried partial pane merges by addition (empty cells hold
-            # the sum identity 0, so no has-mask is needed)
-            def merge0_add(cur_leaf, cell_leaf):
-                add = jnp.where(_b(state["cur_valid"], cur_leaf),
-                                cur_leaf, 0)
-                return cell_leaf.at[:, 0].add(cast_state_update(
-                    add, cell_leaf.dtype, "FFAT pane merge"))
-            cells = jax.tree.map(merge0_add, state["cur"], cells)
+            # carried partial pane merges by the declared op (empty cells
+            # hold the monoid identity, so no has-mask is needed)
+            def merge0(cur_leaf, cell_leaf):
+                ident = _monoid_identity(monoid, cell_leaf.dtype)
+                upd = jnp.where(_b(state["cur_valid"], cur_leaf),
+                                cur_leaf, ident)
+                return _monoid_scatter(cell_leaf.at[:, 0], monoid)(
+                    cast_state_update(upd, cell_leaf.dtype,
+                                      "FFAT pane merge"))
+            cells = jax.tree.map(merge0, state["cur"], cells)
         else:
             # after a STABLE grouping by dense key, bucket b's lanes
             # occupy [start_b, start_b + hist_b), so the within-key rank
@@ -331,11 +397,12 @@ def make_ffat_step(capacity: int, K: int, P: int, R: int, D: int,
         # [K, R-1+NP1] pane sequence) stays dense; window values are
         # gathered only at the MAXO compacted output slots.
         done = state["pane_base"] + m_k
-        if sum_like:
-            # declared zero-absorbing: the flag lane of the fold is pure
-            # overhead here (the CB step never reads the flag output —
-            # fired windows always contain data)
-            swin = _sliding_reduce_plain(comb, full_valid, full, R, axis=1)
+        if monoid is not None:
+            # declared identity-absorbing: the flag lane of the fold is
+            # pure overhead here (the CB step never reads the flag output
+            # — fired windows always contain data)
+            swin = _sliding_reduce_plain(comb, full_valid, full, R,
+                                         axis=1, monoid=monoid)
         else:
             _, swin = _sliding_reduce(comb, full_valid, full, R, axis=1)
 
@@ -494,7 +561,8 @@ def make_ffat_tb_step(capacity: int, K: int, P_usec: int, R: int, D: int,
                       key_base_fn: Optional[Callable[[], Any]] = None,
                       drop_tainted: bool = False,
                       grouping: str = "rank_scatter",
-                      sum_like: bool = False):
+                      sum_like: bool = False,
+                      monoid: Optional[str] = None):
     """Time-based FFAT per-batch program.
 
     Window ``w`` covers panes ``[w*D, w*D + R)`` — times
@@ -534,12 +602,15 @@ def make_ffat_tb_step(capacity: int, K: int, P_usec: int, R: int, D: int,
     ``n_win_dropped``.  The reference never fires a wrong window — it
     grows/blocks instead — so wrong-but-counted is opt-in (``count``).
 
-    ``sum_like`` (withSumCombiner — strictly leafwise addition): TB
+    ``monoid`` ("sum" | "max" | "min"; legacy ``sum_like=True`` means
+    ``monoid="sum"`` — withSumCombiner / withMonoidCombiner): TB
     placement then needs NO grouping at all — the pane cell is timestamp
-    arithmetic, so lifts scatter-ADD into the ring and the whole
-    sort/segmented-scan machinery disappears (float rounding order may
-    differ from the sequential fold, the psum tolerance).
+    arithmetic, so lifts scatter-COMBINE (add/max/min) into the ring and
+    the whole sort/segmented-scan machinery disappears (for "sum", float
+    rounding order may differ from the sequential fold, the psum
+    tolerance; max/min are idempotent — identical either way).
     """
+    monoid = resolve_monoid(sum_like, monoid)
     MW = NP // D + 2
     N_PASSES = 3                     # A1, A2 (pre-place), B (post-place)
 
@@ -670,32 +741,37 @@ def make_ffat_tb_step(capacity: int, K: int, P_usec: int, R: int, D: int,
         late = ok & (rel < 0)
         ok = ok & (rel >= 0)
         rel_c = jnp.clip(rel, 0, NP - 1).astype(jnp.int32)
-        if sum_like:
-            # declared leafwise-ADD combiner: a tuple's pane cell is pure
-            # timestamp arithmetic (no within-key rank exists in TB), so
-            # placement needs NO grouping at all — lifts scatter-ADD
-            # straight into the ring (absent cells hold the identity 0).
-            # The reference pays its sort for every TB batch regardless
-            # (thrust::sort_by_key, ffat_replica_gpu.hpp:917).
+        if monoid is not None:
+            # declared leafwise-monoid combiner: a tuple's pane cell is
+            # pure timestamp arithmetic (no within-key rank exists in
+            # TB), so placement needs NO grouping at all — lifts
+            # scatter-COMBINE straight into the ring (absent cells hold
+            # the monoid identity).  The reference pays its sort for
+            # every TB batch regardless (thrust::sort_by_key,
+            # ffat_replica_gpu.hpp:917).
             row_u = jnp.where(ok, keys, K)
             col_u = jnp.where(ok, rel_c, 0)
 
-            def scat_add(leaf):
-                buf = jnp.zeros((K + 1, NP) + leaf.shape[1:], leaf.dtype)
-                return buf.at[row_u, col_u].add(
-                    jnp.where(_b(ok, leaf), leaf, 0))[:K]
-            partial = jax.tree.map(scat_add, jax.vmap(lift)(payload))
+            def scat(leaf):
+                ident = _monoid_identity(monoid, leaf.dtype)
+                buf = jnp.full((K + 1, NP) + leaf.shape[1:], ident,
+                               leaf.dtype)
+                return _monoid_scatter(buf.at[row_u, col_u], monoid)(
+                    jnp.where(_b(ok, leaf), leaf, ident))[:K]
+            partial = jax.tree.map(scat, jax.vmap(lift)(payload))
             partial_has = (jnp.zeros((K + 1, NP), jnp.int32)
                            .at[row_u, col_u].add(ok.astype(jnp.int32))[:K]
                            > 0)
+            mop = _MONOID_OPS[monoid][1]
 
-            def merge_add(old_leaf, new_leaf):
-                # plain addition with dtype PROMOTION, exactly like the
+            def merge_m(old_leaf, new_leaf):
+                # declared op with dtype PROMOTION, exactly like the
                 # grouped path's comb merge — a wider (e.g. f64) state
                 # stays wide; no scatter is involved so no cast is needed
-                add = jnp.where(_b(cell_valid, old_leaf), old_leaf, 0)
-                return new_leaf + add
-            cells = jax.tree.map(merge_add, cells, partial)
+                old = jnp.where(_b(cell_valid, old_leaf), old_leaf,
+                                _monoid_identity(monoid, old_leaf.dtype))
+                return mop(new_leaf, old)
+            cells = jax.tree.map(merge_m, cells, partial)
         else:
             sid = jnp.where(ok, keys.astype(jnp.int64) * NP + rel_c,
                             jnp.int64(K) * NP)

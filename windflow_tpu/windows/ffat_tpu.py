@@ -48,7 +48,8 @@ from windflow_tpu.windows.ffat_kernels import (agg_spec_for,
                                                make_ffat_state,
                                                make_ffat_step,
                                                make_ffat_tb_state,
-                                               make_ffat_tb_step)
+                                               make_ffat_tb_step,
+                                               resolve_monoid)
 
 
 class FfatTPUReplica(_TPUReplica):
@@ -101,7 +102,8 @@ class FfatWindowsTPU(Operator):
                  key_extractor: Optional[Callable] = None,
                  pane_capacity: Optional[int] = None,
                  overflow_policy: str = "drop",
-                 sum_like: bool = False) -> None:
+                 sum_like: bool = False,
+                 monoid: Optional[str] = None) -> None:
         routing = (RoutingMode.KEYBY if key_extractor is not None
                    else RoutingMode.FORWARD)
         super().__init__(name, parallelism, routing=routing, is_tpu=True,
@@ -150,13 +152,18 @@ class FfatWindowsTPU(Operator):
         #: "error" raises at the next host checkpoint.  The reference never
         #: fires a wrong window (its FlatFAT grows instead).
         self.overflow_policy = overflow_policy
-        #: declared strictly-ADDITIVE combiner (withSumCombiner,
-        #: comb(a,b) == a+b per leaf): CB drops the fold's flag lane and
-        #: skips the grouping permutation (scatter-add pane cells); TB
-        #: skips grouping entirely — pane placement is timestamp
-        #: arithmetic, lifts scatter-add into the ring.  NOT for merely
-        #: zero-absorbing combiners (max would silently become sum).
-        self.sum_like = sum_like
+        #: declared leafwise-monoid combiner ("sum" | "max" | "min";
+        #: withSumCombiner == monoid "sum", withMonoidCombiner for the
+        #: rest): CB drops the fold's flag lane and skips the grouping
+        #: permutation (scatter-combine pane cells); TB skips grouping
+        #: entirely — pane placement is timestamp arithmetic, lifts
+        #: scatter-combine into the ring.  The declaration must match the
+        #: combiner exactly (declaring "sum" for a max combiner silently
+        #: computes sums).
+        try:
+            self.monoid = resolve_monoid(sum_like, monoid)
+        except ValueError as e:
+            raise WindFlowError(str(e)) from None
         self._overflow_steps = 0
         self._auto_np = False          # NP chosen by the span estimator
         self._np_ceil = None
@@ -212,11 +219,11 @@ class FfatWindowsTPU(Operator):
                     self.key_extractor,
                     drop_tainted=self.overflow_policy == "drop",
                     grouping=self._grouping(), ingest=ingest,
-                    sum_like=self.sum_like)
+                    monoid=self.monoid)
             return make_sharded_ffat_step(
                 self.mesh, capacity, self.max_keys, self.P, self.R, self.D,
                 self.lift, self.comb, self.key_extractor,
-                sum_like=self.sum_like, grouping=self._grouping(),
+                monoid=self.monoid, grouping=self._grouping(),
                 ingest=ingest)
         if self.is_tb:
             step = make_ffat_tb_step(capacity, self.max_keys, self.P,
@@ -226,12 +233,12 @@ class FfatWindowsTPU(Operator):
                                      drop_tainted=self.overflow_policy
                                      == "drop",
                                      grouping=self._grouping(),
-                                     sum_like=self.sum_like)
+                                     monoid=self.monoid)
         else:
             step = make_ffat_step(capacity, self.max_keys, self.P, self.R,
                                   self.D, self.lift, self.comb,
                                   self.key_extractor,
-                                  sum_like=self.sum_like,
+                                  monoid=self.monoid,
                                   grouping=self._grouping())
         return jax.jit(step, donate_argnums=(0,))
 
