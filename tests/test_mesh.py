@@ -53,6 +53,39 @@ def test_sharded_keyed_reduce_psum(data):
                                rtol=1e-6)
 
 
+@pytest.mark.parametrize("monoid,op,ident", [
+    ("max", max, -1e30), ("min", min, 1e30)])
+def test_sharded_keyed_reduce_monoid_collective(monoid, op, ident):
+    """Declared max/min ride one pmax/pmin collective (r5
+    withMonoidCombiner): results must match the oracle on strictly
+    NEGATIVE values (a zero-identity bug would win every max), and the
+    record's key leaf must survive the collective intact (max(i, i) == i
+    across chips — unlike psum, where a key leaf is part of the
+    declared-sum contract)."""
+    cap, K = 64, 16
+    keys, vals = _rand_batch(cap, K)
+    vals = -1.0 - vals        # all < -1
+    mesh = M.make_mesh(8, data=2)
+    payload = {"k": jnp.asarray(keys, jnp.int32), "v": jnp.asarray(vals)}
+    payload, valid = _put(mesh, payload, jnp.ones(cap, bool),
+                          jax.sharding.PartitionSpec(("data", "key")))
+    jop = jnp.maximum if monoid == "max" else jnp.minimum
+    red = M.make_sharded_keyed_reduce(
+        mesh, cap, K, lambda a, b: {"k": b["k"], "v": jop(a["v"], b["v"])},
+        lambda x: x["k"], monoid=monoid)
+    table, has = red(payload, valid)
+    has = np.asarray(has)
+    expect = np.full(K, ident)
+    seen = np.zeros(K, bool)
+    for k, v in zip(keys, vals):
+        expect[k] = op(expect[k], v)
+        seen[k] = True
+    np.testing.assert_array_equal(has, seen)
+    np.testing.assert_allclose(np.asarray(table["v"])[has], expect[has])
+    np.testing.assert_array_equal(np.asarray(table["k"])[has],
+                                  np.arange(K)[has])
+
+
 def test_sharded_keyed_reduce_generic_fold():
     cap, K = 64, 16
     keys, vals = _rand_batch(cap, K)

@@ -7,6 +7,7 @@ Runs on the virtual 8-device CPU mesh (conftest)."""
 import dataclasses
 
 import jax
+import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
 import windflow_tpu as wf
@@ -137,6 +138,38 @@ def test_keyed_reduce_tpu_on_mesh_fold():
     for t in stream():
         per_key[t["key"]] = per_key.get(t["key"], 0) + t["value"]
     assert acc == per_key
+
+
+def test_keyed_reduce_tpu_on_mesh_pmax():
+    """withMonoidCombiner("max"): the cross-chip combine rides ONE pmax
+    collective.  Strictly negative values (a zero-identity bug would win
+    every max) and a real key lane in the record — max(k, k) == k across
+    chips, so the key survives the collective (unlike psum's
+    all-leaves-summed contract)."""
+    got = {}
+    src = (wf.Source_Builder(
+            lambda: iter({"key": i % N_KEYS, "value": -1.0 - (i % 97)}
+                         for i in range(LENGTH)))
+           .withOutputBatchSize(64).build())
+    op = (wf.ReduceTPU_Builder(
+            lambda a, b: {"key": jnp.maximum(a["key"], b["key"]),
+                          "value": jnp.maximum(a["value"], b["value"])})
+          .withKeyBy(lambda t: t["key"]).withMaxKeys(N_KEYS)
+          .withMonoidCombiner("max").build())
+    snk = wf.Sink_Builder(
+        lambda r: got.__setitem__(
+            int(r["key"]), max(got.get(int(r["key"]), -1e30),
+                               float(r["value"])))
+        if r is not None else None).build()
+    g = wf.PipeGraph("red_mesh_pmax", config=_mesh_cfg())
+    g.add_source(src).add(op).add_sink(snk)
+    g.run()
+
+    per_key = {}
+    for i in range(LENGTH):
+        k, v = i % N_KEYS, -1.0 - (i % 97)
+        per_key[k] = max(per_key.get(k, -1e30), v)
+    assert got == per_key
 
 
 def test_keyed_reduce_tpu_on_mesh_psum():

@@ -211,6 +211,10 @@ def test_unknown_monoid_rejected():
                                     lambda a, b: a * b)
          .withCBWindows(32, 8).withMaxKeys(4)
          .withMonoidCombiner("product").build())
+    with pytest.raises(wf.WindFlowError, match="monoid"):
+        (wf.ReduceTPU_Builder(lambda a, b: a)
+         .withKeyBy(lambda t: t["key"]).withMaxKeys(4)
+         .withMonoidCombiner("product").build())
     with pytest.raises(ValueError, match="monoid"):
         make_ffat_step(64, 4, 8, 4, 1, lambda x: x["v"],
                        lambda a, b: a + b, lambda x: x["k"],

@@ -101,7 +101,8 @@ def build_components(jax, jnp, CAP, K, Pn, R):
         return v
 
     def sliding_fold_plain(cells, cell_has):
-        return _sliding_reduce_plain(comb, cell_has, cells, R, axis=1)
+        return _sliding_reduce_plain(comb, cell_has, cells, R, axis=1,
+                                     monoid="sum")
 
     def sliding_fold_cumsum(cells, cell_has):
         # cumsum-diff: out[i] = cs[i] - cs[i-R]; sum-only alternative

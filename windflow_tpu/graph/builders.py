@@ -394,7 +394,7 @@ class ReduceTPU_Builder(_BuilderBase):
         super().__init__()
         self._comb = comb
         self._max_keys = None
-        self._sum_like = False
+        self._monoid = None
 
     def withRebalancing(self):
         raise WindFlowError(
@@ -409,20 +409,28 @@ class ReduceTPU_Builder(_BuilderBase):
         return self
 
     def withSumCombiner(self):
-        """Declare the combiner leafwise ADDITION (``comb(a, b) == a + b``
-        on every leaf), so the cross-chip combine can ride ``lax.psum``
-        instead of all_gather + fold.  This is strictly additive, not
-        merely zero-absorbing: psum literally sums partials without
-        calling ``comb``, so any other combiner (max, min, ...) silently
-        computes sums — do not declare it.  Mesh execution only."""
-        self._sum_like = True
+        """Shorthand for ``withMonoidCombiner("sum")`` (strictly additive:
+        ``comb(a, b) == a + b`` on every leaf)."""
+        self._monoid = "sum"
+        return self
+
+    def withMonoidCombiner(self, kind: str):
+        """Declare the combiner a leafwise commutative monoid — ``"sum"``
+        (``a + b``), ``"max"`` (``maximum``) or ``"min"`` (``minimum``)
+        on every leaf — so the cross-chip combine can ride ONE reduce
+        collective (``lax.psum``/``pmax``/``pmin``) instead of
+        all_gather + fold.  The collective applies the declared operation
+        without calling ``comb``, so the declaration must match the
+        combiner exactly on every leaf (a wrong kind silently computes
+        the declared operation).  Mesh execution only."""
+        self._monoid = kind
         return self
 
     def build(self) -> ReduceTPU:
         return ReduceTPU(self._comb, name=self._name,
                          parallelism=self._parallelism,
                          key_extractor=self._key_extractor,
-                         max_keys=self._max_keys, sum_like=self._sum_like)
+                         max_keys=self._max_keys, monoid=self._monoid)
 
 
 # ---------------------------------------------------------------------------

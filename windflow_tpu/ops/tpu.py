@@ -218,7 +218,8 @@ class ReduceTPU(Operator):
     def __init__(self, comb: Callable[[Any, Any], Any],
                  name: str = "reduce_tpu", parallelism: int = 1,
                  key_extractor=None, max_keys: Optional[int] = None,
-                 sum_like: bool = False) -> None:
+                 sum_like: bool = False,
+                 monoid: Optional[str] = None) -> None:
         routing = RoutingMode.KEYBY if key_extractor is not None \
             else RoutingMode.FORWARD
         super().__init__(name, parallelism, routing=routing, is_tpu=True,
@@ -226,10 +227,16 @@ class ReduceTPU(Operator):
         self.comb = comb
         # Mesh execution only: bound of the dense key space [0, max_keys)
         # for the cross-chip partial tables (single-chip reduce needs no
-        # bound — it sorts arbitrary int32 keys).  ``sum_like=True`` lets the
-        # cross-chip combine ride lax.psum instead of all_gather + fold.
+        # bound — it sorts arbitrary int32 keys).  A declared monoid
+        # ("sum" | "max" | "min"; legacy sum_like=True means "sum") lets
+        # the cross-chip combine ride one reduce collective
+        # (psum/pmax/pmin) instead of all_gather + fold.
         self.max_keys = max_keys
-        self.sum_like = sum_like
+        from windflow_tpu.windows.ffat_kernels import resolve_monoid
+        try:
+            self.monoid = resolve_monoid(sum_like, monoid)
+        except ValueError as e:
+            raise WindFlowError(str(e)) from None
         self._jit_steps = {}
         # dense-key variant (withMaxKeys): the cross-chip partial tables
         # are compiled for one batch capacity — build-time capacity check
@@ -277,7 +284,7 @@ class ReduceTPU(Operator):
             else:
                 step = make_sharded_reduce_step(
                     self.mesh, capacity, K, self.comb, self.key_extractor,
-                    use_psum=self.sum_like)
+                    monoid=self.monoid)
             self._jit_steps[("mesh", capacity)] = step
         return step
 

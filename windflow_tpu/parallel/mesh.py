@@ -42,10 +42,13 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from windflow_tpu.basic import WindFlowError
 from windflow_tpu.batch import DeviceBatch, HostBatch, host_to_device
 from windflow_tpu.windows.ffat_kernels import (_b, _masked_reduce_last,
-                                           _seg_scan, make_ffat_flush,
+                                           _monoid_identity, _seg_scan,
+                                           make_ffat_flush,
                                            make_ffat_state, make_ffat_step,
                                            make_ffat_tb_state,
-                                           make_ffat_tb_step)
+                                           make_ffat_tb_step,
+                                           monoid_collective,
+                                           resolve_monoid)
 from windflow_tpu.windows.grouping import auto_order
 
 DATA_AXIS = "data"
@@ -129,7 +132,8 @@ def _dense_keyed_partial(keys, vals, valid, comb, K):
 
 def make_sharded_reduce_step(mesh: Mesh, capacity: int, K: int,
                              comb: Callable, key_fn: Optional[Callable],
-                             use_psum: bool = False):
+                             use_psum: bool = False,
+                             monoid: Optional[str] = None):
     """Sharded ReduceTPU step with the operator's batch contract: returns
     ``fn(payload, ts, valid) -> (table, ts_out, has, n_dropped)`` where
     ``table`` is the dense ``[K]`` combined-record table, ``ts_out`` the
@@ -139,12 +143,16 @@ def make_sharded_reduce_step(mesh: Mesh, capacity: int, K: int,
     ``[0, K)`` (the dense tables cannot hold them; the count surfaces in
     stats rather than vanishing silently).  This is what ``ReduceTPU``
     compiles when the graph runs on a mesh (Config.mesh): per-chip dense
-    partials over the flattened ``(data, key)`` axes combined with psum
-    (sum-like combiners) or all_gather + log-fold (reference: Reduce_GPU per
-    replica + cross-replica merge, ``reduce_gpu.hpp:227-283``).
+    partials over the flattened ``(data, key)`` axes combined with a
+    single reduce collective — ``psum``/``pmax``/``pmin`` for declared
+    monoid combiners (``monoid``; legacy ``use_psum=True`` means
+    ``"sum"``) — or all_gather + log-fold for arbitrary combiners
+    (reference: Reduce_GPU per replica + cross-replica merge,
+    ``reduce_gpu.hpp:227-283``).
 
     Non-keyed reduces pass ``key_fn=None`` with ``K == 1`` (the
     ``thrust::reduce`` global path)."""
+    monoid = resolve_monoid(use_psum, monoid)
     n_total = math.prod(mesh.devices.shape)
     if capacity % n_total:
         raise WindFlowError(
@@ -163,9 +171,13 @@ def make_sharded_reduce_step(mesh: Mesh, capacity: int, K: int,
         vals = (payload, ts)
         comb2 = lambda a, b: (comb(a[0], b[0]), jnp.maximum(a[1], b[1]))
         (table, ts_t), has = _dense_keyed_partial(keys, vals, valid, comb2, K)
-        if use_psum:
-            z = jax.tree.map(lambda a: jnp.where(_b(has, a), a, 0), table)
-            out = jax.tree.map(lambda a: jax.lax.psum(a, axes), z)
+        if monoid is not None:
+            coll = monoid_collective(monoid)
+            z = jax.tree.map(
+                lambda a: jnp.where(_b(has, a), a,
+                                    _monoid_identity(monoid, a.dtype)),
+                table)
+            out = jax.tree.map(lambda a: coll(a, axes), z)
             ts_out = jax.lax.pmax(jnp.where(has, ts_t, jnp.int64(-1)), axes)
             any_has = jax.lax.psum(has.astype(jnp.int32), axes) > 0
             return out, ts_out, any_has, n_drop
@@ -254,14 +266,15 @@ def make_sharded_reduce_arbitrary(mesh: Mesh, capacity: int, comb: Callable,
 
 def make_sharded_keyed_reduce(mesh: Mesh, capacity: int, K: int,
                               comb: Callable, key_fn: Callable,
-                              use_psum: bool = False):
+                              use_psum: bool = False,
+                              monoid: Optional[str] = None):
     """Compile a keyed reduce over the whole mesh; thin wrapper over
     :func:`make_sharded_reduce_step` (one implementation of the collective
     combine) that drops the timestamp/drop-count outputs.  Returns
     ``fn(payload, valid) -> (table, has)`` with both outputs replicated on
     every chip."""
     step = make_sharded_reduce_step(mesh, capacity, K, comb, key_fn,
-                                    use_psum=use_psum)
+                                    use_psum=use_psum, monoid=monoid)
 
     def fn(payload, valid):
         ts = jnp.zeros(valid.shape[0], jnp.int64)

@@ -141,16 +141,22 @@ def _sliding_reduce(comb, flags, values, R: int, axis: int):
 
 
 #: declared combiner monoids (withMonoidCombiner): one source of truth
-#: mapping kind -> (``.at[]`` scatter method, elementwise combine); the
-#: contract is ``comb(x, identity) == x`` leafwise (identity per dtype
-#: from :func:`_monoid_identity`), so identity-filled slots are absorbed
-#: without a has-mask.  A new kind goes here + ``_monoid_identity``.
+#: mapping kind -> (``.at[]`` scatter method, elementwise combine, mesh
+#: reduce collective); the contract is ``comb(x, identity) == x``
+#: leafwise (identity per dtype from :func:`_monoid_identity`), so
+#: identity-filled slots are absorbed without a has-mask.  A new kind
+#: goes here + ``_monoid_identity``.
 _MONOID_OPS = {
-    "sum": ("add", jnp.add),
-    "max": ("max", jnp.maximum),
-    "min": ("min", jnp.minimum),
+    "sum": ("add", jnp.add, jax.lax.psum),
+    "max": ("max", jnp.maximum, jax.lax.pmax),
+    "min": ("min", jnp.minimum, jax.lax.pmin),
 }
 _MONOID_KINDS = tuple(_MONOID_OPS)
+
+
+def monoid_collective(kind: str):
+    """The mesh reduce collective (psum/pmax/pmin) for a monoid kind."""
+    return _MONOID_OPS[kind][2]
 
 
 def resolve_monoid(sum_like: bool, monoid):
@@ -192,7 +198,7 @@ def _monoid_fill(kind: str, flags, values):
 
 
 def _sliding_reduce_plain(comb, flags, values, R: int, axis: int,
-                          monoid: str = "sum"):
+                          monoid: str):
     """Flagless dilated sliding fold for declared-monoid combiners
     (withSumCombiner / withMonoidCombiner): invalid entries are filled
     with the monoid identity once, then the log2(R) doubling runs on
