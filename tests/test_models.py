@@ -184,3 +184,35 @@ def test_mesh_analytics_matches_oracle():
     assert set(gmap) == set(exp)
     for kk in exp:
         assert abs(gmap[kk] - exp[kk]) < 1e-3 * max(1.0, abs(exp[kk]))
+
+
+def test_market_ticker_high_low_matches_oracle():
+    """MarketTicker: one declared-max FFAT op computes per-symbol sliding
+    high AND low (lo = -max(-p)); prices strictly negative-free but the
+    lift's negated leaf is all-negative, so a zero-identity bug in the
+    monoid path would corrupt every low."""
+    from windflow_tpu.models import market_ticker
+    n, syms, win, slide = 5000, 6, 32, 8
+    rnd = random.Random(21)
+    ticks = [{"sym": i % syms, "price": 10.0 + rnd.random() * 90.0}
+             for i in range(n)]
+    rows = market_ticker.run(ticks, win_len=win, slide=slide,
+                             max_symbols=syms, batch=512)
+    per_sym = {s: [] for s in range(syms)}
+    for t in ticks:
+        per_sym[t["sym"]].append(t["price"])
+    exp = {}
+    for s, ps in per_sym.items():
+        w = 0
+        while w * slide + win <= len(ps):
+            seg = ps[w * slide: w * slide + win]
+            exp[(s, w)] = (max(seg), min(seg))
+            w += 1
+    got = {(r["sym"], r["wid"]): (r["high"], r["low"]) for r in rows
+           if (r["sym"], r["wid"]) in exp}
+    assert set(got) == set(exp)
+    for kk, (hi, lo) in exp.items():
+        ghi, glo = got[kk]
+        assert abs(ghi - hi) < 1e-4 and abs(glo - lo) < 1e-4, kk
+    # EOS partials may add trailing windows beyond the oracle's full ones
+    assert len(rows) >= len(exp)

@@ -165,3 +165,25 @@ def test_reduce_tpu_combiner_leaf_contract():
     g.add_source(src).add(red).add_sink(snk)
     with pytest.raises(wf.WindFlowError, match="shape"):
         g.run()
+
+
+def test_device_to_host_nested_pytree_payload():
+    """Egress of a batch whose payload holds a NESTED pytree lane (e.g. a
+    multi-leaf window aggregate like {"value": {"hi": ..., "lo": ...}}):
+    the columnar flat-dict fast path must defer to the generic tree path
+    instead of indexing the sub-dict (r5 regression, found by the
+    market_ticker model)."""
+    import jax.numpy as jnp
+    from windflow_tpu.batch import DeviceBatch, device_to_host
+
+    payload = {"key": jnp.arange(4, dtype=jnp.int32),
+               "value": {"hi": jnp.asarray([1., 2., 3., 4.]),
+                         "lo": jnp.asarray([-1., -2., -3., -4.])}}
+    b = DeviceBatch(payload=payload,
+                    ts=jnp.asarray([10, 20, 30, 40], jnp.int64),
+                    valid=jnp.asarray([True, False, True, True]))
+    hb = device_to_host(b)
+    assert [it["key"] for it in hb.items] == [0, 2, 3]
+    assert [it["value"]["hi"] for it in hb.items] == [1., 3., 4.]
+    assert [it["value"]["lo"] for it in hb.items] == [-1., -3., -4.]
+    assert hb.tss == [10, 30, 40]

@@ -484,7 +484,11 @@ def device_to_host(batch: DeviceBatch) -> HostBatch:
     valid = _np_local(batch.valid)
     idx = np.nonzero(valid)[0]
     tss = _np_local(batch.ts)[idx].tolist()
-    if isinstance(batch.payload, dict):
+    if isinstance(batch.payload, dict) and all(
+            hasattr(a, "ndim") for a in batch.payload.values()):
+        # flat dict of array lanes only: a nested pytree value (e.g. a
+        # multi-leaf window aggregate) has no ndim and takes the generic
+        # tree path below
         cols = {n: _np_local(a)[idx] for n, a in batch.payload.items()}
         if all(c.ndim == 1 for c in cols.values()):
             names = list(cols)

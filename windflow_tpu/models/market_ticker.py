@@ -1,0 +1,61 @@
+"""MarketTicker: per-symbol sliding high/low tracker (the classic
+finance-feed window query; DSPBench's "stock analytics" family, used by
+the reference's evaluation papers).
+
+``Source(ticks) → FfatWindowsTPU(declared max) → Sink``: one device
+window op computes BOTH the sliding high and the sliding low per symbol
+in a single program, by lifting each tick to the two-leaf aggregate
+``{"hi": price, "lo": -price}`` under a leafwise ``maximum`` combiner —
+``min(x) == -max(-x)``, so one declared-"max" monoid covers both ends.
+The declaration routes the step onto the scatter-combine fast path (no
+grouping permutation, identity-filled flagless fold; see
+``windows/ffat_kernels.make_ffat_step``) — the reference pays its
+per-batch sort for the same query regardless of combiner
+(``ffat_replica_gpu.hpp:751``).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Iterable, List, Optional
+
+import jax.numpy as jnp
+
+import windflow_tpu as wf
+
+
+def build(ticks: Iterable[dict],
+          on_window: Optional[Callable] = None,
+          *, win_len: int = 64, slide: int = 16, max_symbols: int = 64,
+          batch: int = 1024) -> wf.PipeGraph:
+    """Ticks are dicts ``{"sym": int, "price": float}`` (extra lanes ride
+    along).  Each fired window emits ``{"sym", "wid", "high", "low"}``."""
+
+    def emit(res, ctx=None):
+        if res is not None and on_window is not None:
+            on_window({"sym": int(res["key"]), "wid": int(res["wid"]),
+                       "high": float(res["value"]["hi"]),
+                       "low": -float(res["value"]["lo"])})
+
+    src = (wf.Source_Builder(lambda: iter(ticks)).withName("ticks")
+           .withOutputBatchSize(batch).build())
+    hilo = (wf.Ffat_WindowsTPU_Builder(
+                lambda t: {"hi": t["price"], "lo": -t["price"]},
+                lambda a, b: {"hi": jnp.maximum(a["hi"], b["hi"]),
+                              "lo": jnp.maximum(a["lo"], b["lo"])})
+            .withName("hilo")
+            .withCBWindows(win_len, slide)
+            .withKeyBy(lambda t: t["sym"])
+            .withMaxKeys(max_symbols)
+            .withMonoidCombiner("max").build())
+    sink = wf.Sink_Builder(emit).withName("quotes_out").build()
+
+    g = wf.PipeGraph("market_ticker", wf.ExecutionMode.DEFAULT)
+    g.add_source(src).add(hilo).add_sink(sink)
+    return g
+
+
+def run(ticks: Iterable[dict], **kwargs) -> List[dict]:
+    """Run to completion; returns ``{"sym", "wid", "high", "low"}`` rows."""
+    results: List[dict] = []
+    build(ticks, on_window=results.append, **kwargs).run()
+    return results
