@@ -54,14 +54,16 @@ def oracle_tb(win_us, slide_us):
     return tb_window_sums(per_key, win_us, slide_us)
 
 
-def run_ffat_tpu(win_type, win, slide, batch):
+def run_ffat_tpu(win_type, win, slide, batch, comb=None, monoid=None):
     got = {}
     src = (wf.Source_Builder(lambda: iter(stream()))
            .withTimestampExtractor(lambda t: t["ts"])
            .withOutputBatchSize(batch).build())
     b = (wf.Ffat_WindowsTPU_Builder(lambda t: t["value"],
-                                    lambda a, b: a + b)
+                                    comb or (lambda a, b: a + b))
          .withKeyBy(lambda t: t["key"]).withMaxKeys(N_KEYS))
+    if monoid is not None:
+        b = b.withMonoidCombiner(monoid)
     if win_type == "cb":
         b = b.withCBWindows(win, slide)
     else:
@@ -108,6 +110,26 @@ def test_tb_spec(win, slide):
         got = run_ffat_tpu("tb", win, slide, batch)
         assert got == exp, (win, slide, batch,
                             len(got), len(exp))
+
+
+@pytest.mark.parametrize("win_type", ["cb", "tb"])
+@pytest.mark.parametrize("win,slide", SPECS)
+def test_monoid_max_spec(win_type, win, slide):
+    """Declared-max across the whole spec space (sliding / tumbling /
+    gap-hopping / coprime / slide-1): the scatter-combine and sort-free
+    placements must equal the undeclared flag-aware machinery EXACTLY on
+    every pane decomposition (max is idempotent, so bit-identical).
+    ``value`` lanes here are the stream's non-negative ints — the
+    strictly-negative identity hunt lives in test_monoid_combiner; this
+    sweep targets the spec-dependent pane/firing arithmetic instead."""
+    import jax.numpy as jnp
+    comb = lambda a, b: jnp.maximum(a, b)
+    rnd = random.Random(win * 10 + slide)
+    batch = rnd.randint(1, 96)
+    got = run_ffat_tpu(win_type, win, slide, batch, comb=comb,
+                       monoid="max")
+    want = run_ffat_tpu(win_type, win, slide, batch, comb=comb)
+    assert got == want and len(got) > 0, (win_type, win, slide, batch)
 
 
 def _host_builder(family, nonin):
