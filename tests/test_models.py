@@ -216,3 +216,33 @@ def test_market_ticker_high_low_matches_oracle():
         assert abs(ghi - hi) < 1e-4 and abs(glo - lo) < 1e-4, kk
     # EOS partials may add trailing windows beyond the oracle's full ones
     assert len(rows) >= len(exp)
+
+
+def test_fraud_detection_matches_oracle():
+    """FraudDetection: keyed device state (previous transaction type per
+    card) drives a Markov transition score; flagged alerts must match a
+    sequential python oracle exactly — any cross-batch state carryover
+    bug changes which transitions get flagged."""
+    from windflow_tpu.models import fraud_detection
+    n, cards, types = 4000, 12, 4
+    rnd = random.Random(31)
+    # a chain-shaped matrix: staying or stepping forward is likely,
+    # jumping back is rare (the fraud signal)
+    trans = [[0.0] * types for _ in range(types)]
+    for i in range(types):
+        for j in range(types):
+            trans[i][j] = 0.45 if j in (i, (i + 1) % types) else 0.05
+    txs = [{"card": i % cards, "etype": rnd.randrange(types)}
+           for i in range(n)]
+    alerts = fraud_detection.run(txs, trans, max_cards=cards,
+                                 threshold=0.1, batch=256)
+    prev = {}
+    exp = []
+    for t in txs:
+        c, e = t["card"], t["etype"]
+        score = 1.0 if c not in prev else trans[prev[c]][e]
+        if score < 0.1:
+            exp.append((c, e))
+        prev[c] = e
+    assert [(a["card"], a["etype"]) for a in alerts] == exp
+    assert len(exp) > 100   # the stream must actually flag things

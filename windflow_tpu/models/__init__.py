@@ -1,11 +1,11 @@
 """Example streaming applications built on windflow_tpu — the application
 set the reference's evaluation papers benchmark (DSPBench-style WordCount,
-SpikeDetection, MarketTicker) plus the flagship TPU FFAT analytics
+SpikeDetection, MarketTicker, FraudDetection) plus the flagship TPU FFAT analytics
 pipeline, the zero-per-tuple binary-telemetry pipeline, the
 Yahoo-Streaming-Benchmark ad-analytics pipeline, and the multi-chip mesh
 configuration."""
 
 from windflow_tpu.models import (ad_analytics, ffat_analytics,
-                                 market_ticker, mesh_analytics,
-                                 spike_detection, telemetry_frames,
-                                 wordcount)
+                                 fraud_detection, market_ticker,
+                                 mesh_analytics, spike_detection,
+                                 telemetry_frames, wordcount)
