@@ -23,7 +23,7 @@ from windflow_tpu import native
 from windflow_tpu.basic import RoutingMode, TimePolicy, WindFlowError, \
     current_time_usecs
 from windflow_tpu.meta import adapt
-from windflow_tpu.ops.base import Operator, Replica
+from windflow_tpu.ops.base import Operator
 from windflow_tpu.ops.source import BaseSourceReplica, Source
 
 

@@ -17,7 +17,7 @@ import math
 from typing import Any, Callable, Dict, Optional
 
 from windflow_tpu.basic import (EMPTY_KEY, ExecutionMode, RoutingMode,
-                                WindFlowError, WindowRole, WinType)
+                                WindFlowError, WinType)
 from windflow_tpu.batch import WM_NONE
 from windflow_tpu.ops.base import Operator, Replica
 from windflow_tpu.windows.engine import WindowSpec
