@@ -257,3 +257,56 @@ def test_tb_kernel_monoid_min_negative_and_positive():
     rng = np.random.default_rng(14)
     b = run(None)
     assert a == b and len(a) > 0
+
+
+@pytest.mark.parametrize("horizon,lateness,expect_drops", [
+    (12, 12_000, False),  # allowance covers the disorder horizon
+    (30, 2_000, True),    # disorder beyond lateness + window span: drops
+])
+def test_tb_monoid_with_lateness_and_disorder_matches_default(
+        horizon, lateness, expect_drops):
+    """Declared-max TB placement under an out-of-order stream WITH a
+    lateness allowance: the sort-free scatter path must agree with the
+    grouped default exactly — late-but-allowed tuples land in already-open
+    panes via scatter-combine, and too-late drops must be counted the
+    same on both paths."""
+    rnd = __import__("random").Random(40)
+    stream = [{"key": i % 3, "value": -1.0 - ((i * 53) % 89) / 9.0,
+               "ts": i * 1000} for i in range(300)]
+    # shuffle within a fixed disorder horizon; drops require the
+    # disorder to exceed lateness + the 20_000 us window span (panes
+    # stay in the ring while any window over them is open)
+    for i in range(0, 300 - horizon, horizon):
+        seg = stream[i:i + horizon]
+        rnd.shuffle(seg)
+        stream[i:i + horizon] = seg
+
+    def run(declare):
+        got = {}
+        drops = {}
+        src = (wf.Source_Builder(lambda: iter(stream))
+               .withTimestampExtractor(lambda t: t["ts"])
+               .withOutputBatchSize(23).build())
+        b = (wf.Ffat_WindowsTPU_Builder(
+                lambda t: t["value"], lambda a, b: jnp.maximum(a, b))
+             .withKeyBy(lambda t: t["key"]).withMaxKeys(3)
+             .withTBWindows(20_000, 5_000).withLateness(lateness))
+        if declare:
+            b = b.withMonoidCombiner("max")
+        op = b.build()
+        snk = wf.Sink_Builder(
+            lambda r: got.__setitem__((r["key"], r["wid"]), r["value"])
+            if r is not None else None).build()
+        g = wf.PipeGraph("ffat_tb_max_late", wf.ExecutionMode.DEFAULT,
+                         wf.TimePolicy.EVENT)
+        g.add_source(src).add(op).add_sink(snk)
+        g.run()
+        drops["late"] = op.dump_stats()["Late_tuples_dropped"]
+        return got, drops
+
+    got_m, d_m = run(True)
+    got_d, d_d = run(False)
+    assert got_m == got_d and len(got_m) > 0
+    assert d_m == d_d
+    if expect_drops:
+        assert d_m["late"] > 0   # the drop path itself was exercised
