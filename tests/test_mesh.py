@@ -205,31 +205,47 @@ def test_scaling_harness_refuses_virtual_mesh():
     assert "skipped" in out and "virtual" in out["skipped"]
 
 
-def test_sharded_ffat_sum_like_matches_default():
-    """The flagless declared-sum fold on the mesh matches the default
-    flag-aware fold bitwise on integer lifts."""
+def _drive_sharded_ffat_pair(comb, values, step_kwargs):
+    """Shared equivalence runner: drive the key-sharded FFAT step 5 batches
+    with and without the declared fast path; return both sorted firing
+    lists (signature changes only need editing here)."""
     cap, K, Pn, R, D = 64, 8, 4, 4, 1
     mesh = M.make_mesh(8, data=2)
-    payload = {"k": jnp.arange(cap, dtype=jnp.int32) % K,
-               "v": (jnp.arange(cap, dtype=jnp.int64) * 3) % 101}
+    payload = {"k": jnp.arange(cap, dtype=jnp.int32) % K, "v": values}
     ts = jnp.arange(cap, dtype=jnp.int64)
     valid = jnp.ones(cap, bool)
     sh = M.batch_sharding(mesh)
-    outs = {}
-    for sum_like in (False, True):
+    outs = []
+    for kwargs in ({}, step_kwargs):
         step = M.make_sharded_ffat_step(
-            mesh, cap, K, Pn, R, D, lambda x: x["v"], lambda a, b: a + b,
-            lambda x: x["k"], sum_like=sum_like)
+            mesh, cap, K, Pn, R, D, lambda x: x["v"], comb,
+            lambda x: x["k"], **kwargs)
         st = M.make_sharded_ffat_state(jnp.zeros((), jnp.int64), K, R, mesh)
         got = []
         for it in range(5):     # enough batches per key to fire windows
             p5 = {"k": jax.device_put(payload["k"], sh),
-                  "v": jax.device_put((payload["v"] + it) % 97, sh)}
+                  "v": jax.device_put(payload["v"] - it, sh)}
             st, out, fired, _ = step(st, p5, jax.device_put(ts, sh),
                                      jax.device_put(valid, sh))
             f = np.asarray(fired)
             got.extend(zip(np.asarray(out["key"])[f].tolist(),
                            np.asarray(out["wid"])[f].tolist(),
                            np.asarray(out["value"])[f].tolist()))
-        outs[sum_like] = sorted(got)
-    assert outs[False] == outs[True] and outs[False]
+        outs.append(sorted(got))
+    return outs
+
+
+@pytest.mark.parametrize("name,comb,values,step_kwargs", [
+    # flagless declared-sum fold, bitwise on integer lifts
+    ("sum", lambda a, b: a + b,
+     (jnp.arange(64, dtype=jnp.int64) * 3) % 101, dict(sum_like=True)),
+    # declared-max scatter-combine with per-shard key bases; negative int
+    # lifts — a zero-identity bug in any shard corrupts its windows
+    ("max", jnp.maximum,
+     -1 - ((jnp.arange(64, dtype=jnp.int64) * 7) % 89),
+     dict(monoid="max")),
+])
+def test_sharded_ffat_declared_path_matches_default(name, comb, values,
+                                                    step_kwargs):
+    default, declared = _drive_sharded_ffat_pair(comb, values, step_kwargs)
+    assert default == declared and default, name
