@@ -310,3 +310,76 @@ def test_tb_monoid_with_lateness_and_disorder_matches_default(
     assert d_m == d_d
     if expect_drops:
         assert d_m["late"] > 0   # the drop path itself was exercised
+
+
+def _run_reduce_graph(stream, declare, max_keys=None):
+    got = []
+    src = (wf.Source_Builder(lambda: iter(stream))
+           .withOutputBatchSize(64).build())
+    b = (wf.ReduceTPU_Builder(
+            lambda a, b: {"key": jnp.maximum(a["key"], b["key"]),
+                          "v": jnp.maximum(a["v"], b["v"])})
+         .withKeyBy(lambda t: t["key"]))
+    if max_keys is not None:
+        b = b.withMaxKeys(max_keys)
+    if declare:
+        b = b.withMonoidCombiner("max")
+    op = b.build()
+    snk = wf.Sink_Builder(
+        lambda r: got.append((int(r["key"]), float(r["v"])))
+        if r is not None else None).build()
+    g = wf.PipeGraph("reduce_dense", wf.ExecutionMode.DEFAULT)
+    g.add_source(src).add(op).add_sink(snk)
+    g.run()
+    return got, op
+
+
+def test_single_chip_dense_reduce_matches_sorted_path():
+    """withMaxKeys + withMonoidCombiner on ONE chip: the sort-free dense
+    scatter-combine table must emit exactly the records of the sorted
+    segmented reduce (same per-batch distinct keys, ascending order, same
+    values) — negative values so an identity bug wins a max."""
+    stream = [{"key": i % 7, "v": -2.0 - ((i * 29) % 83) / 7.0}
+              for i in range(512)]
+    dense, op_d = _run_reduce_graph(stream, declare=True, max_keys=7)
+    sorted_, _ = _run_reduce_graph(stream, declare=False)
+    assert dense == sorted_ and len(dense) > 0
+    assert op_d.dump_stats().get("Out_of_range_keys_dropped", 0) == 0
+
+
+def test_single_chip_dense_reduce_drops_and_counts_out_of_range():
+    """Keys outside [0, max_keys) cannot live in the dense table: they are
+    dropped and surface in Out_of_range_keys_dropped (the documented
+    withMaxKeys key-space contract), while the undeclared sorted path
+    keeps them."""
+    stream = [{"key": i % 10, "v": -1.0 - float(i % 13)}
+              for i in range(320)]
+    dense, op_d = _run_reduce_graph(stream, declare=True, max_keys=6)
+    sorted_, _ = _run_reduce_graph(stream, declare=False)
+    n_out_of_range = sum(1 for t in stream if t["key"] >= 6)
+    assert op_d.dump_stats()["Out_of_range_keys_dropped"] == n_out_of_range
+    assert sorted(set(k for k, _ in dense)) == list(range(6))
+    # in-range records agree with the sorted path's in-range subset
+    assert dense == [(k, v) for k, v in sorted_ if k < 6]
+
+
+def test_single_chip_dense_reduce_non_keyed_single_record():
+    """Non-keyed declared reduce: the dense path must emit ONE record per
+    batch (K=1 global segment, the mesh contract) — not a max_keys-lane
+    batch with one valid row."""
+    stream = [{"v": -3.0 - float(i % 11)} for i in range(256)]
+    got = []
+    src = (wf.Source_Builder(lambda: iter(stream))
+           .withOutputBatchSize(64).build())
+    op = (wf.ReduceTPU_Builder(
+            lambda a, b: {"v": jnp.maximum(a["v"], b["v"])})
+          .withMaxKeys(4096).withMonoidCombiner("max").build())
+    snk = wf.Sink_Builder(
+        lambda r: got.append(float(r["v"])) if r is not None else None) \
+        .build()
+    g = wf.PipeGraph("reduce_dense_nonkeyed", wf.ExecutionMode.DEFAULT)
+    g.add_source(src).add(op).add_sink(snk)
+    g.run()
+    exp = [max(t["v"] for t in stream[lo:lo + 64])
+           for lo in range(0, 256, 64)]
+    assert got == exp

@@ -402,9 +402,14 @@ class ReduceTPU_Builder(_BuilderBase):
             "does not apply")
 
     def withMaxKeys(self, n: int):
-        """Mesh execution only: bound of the dense key space [0, n) used by
-        the cross-chip partial tables (Config.mesh; single-chip reduces sort
-        arbitrary int32 keys and ignore this)."""
+        """Bound of the dense key space [0, n).  Required for mesh
+        execution (cross-chip partial tables, Config.mesh).  On a single
+        chip it is ignored by undeclared reduces (they sort arbitrary
+        int32 keys) but, combined with ``withMonoidCombiner``, routes the
+        reduce onto the sort-free dense scatter-combine path — keys
+        outside [0, n) are then dropped and counted
+        (Out_of_range_keys_dropped), the same key-space contract the mesh
+        path enforces."""
         self._max_keys = int(n)
         return self
 
@@ -417,12 +422,14 @@ class ReduceTPU_Builder(_BuilderBase):
     def withMonoidCombiner(self, kind: str):
         """Declare the combiner a leafwise commutative monoid — ``"sum"``
         (``a + b``), ``"max"`` (``maximum``) or ``"min"`` (``minimum``)
-        on every leaf — so the cross-chip combine can ride ONE reduce
-        collective (``lax.psum``/``pmax``/``pmin``) instead of
-        all_gather + fold.  The collective applies the declared operation
-        without calling ``comb``, so the declaration must match the
-        combiner exactly on every leaf (a wrong kind silently computes
-        the declared operation).  Mesh execution only."""
+        on every leaf.  On a mesh, the cross-chip combine then rides ONE
+        reduce collective (``lax.psum``/``pmax``/``pmin``) instead of
+        all_gather + fold; on a single chip, together with
+        ``withMaxKeys``, the whole sort + segmented scan is replaced by
+        one dense scatter-combine pass.  The declared operation is
+        applied without calling ``comb``, so the declaration must match
+        the combiner exactly on every leaf (a wrong kind silently
+        computes the declared operation)."""
         self._monoid = kind
         return self
 

@@ -225,12 +225,14 @@ class ReduceTPU(Operator):
         super().__init__(name, parallelism, routing=routing, is_tpu=True,
                          key_extractor=key_extractor)
         self.comb = comb
-        # Mesh execution only: bound of the dense key space [0, max_keys)
-        # for the cross-chip partial tables (single-chip reduce needs no
-        # bound — it sorts arbitrary int32 keys).  A declared monoid
-        # ("sum" | "max" | "min"; legacy sum_like=True means "sum") lets
-        # the cross-chip combine ride one reduce collective
-        # (psum/pmax/pmin) instead of all_gather + fold.
+        # Bound of the dense key space [0, max_keys) for the dense
+        # tables: required on the mesh (cross-chip partials), optional on
+        # a single chip where an UNDECLARED reduce sorts arbitrary int32
+        # keys.  A declared monoid ("sum" | "max" | "min"; legacy
+        # sum_like=True means "sum") lets the cross-chip combine ride one
+        # reduce collective (psum/pmax/pmin) instead of all_gather +
+        # fold, and — together with max_keys — replaces the single-chip
+        # sort/scan with one scatter-combine pass (_get_dense_step).
         self.max_keys = max_keys
         from windflow_tpu.windows.ffat_kernels import resolve_monoid
         try:
@@ -242,8 +244,9 @@ class ReduceTPU(Operator):
         # are compiled for one batch capacity — build-time capacity check
         if max_keys is not None:
             self.fixed_capacity_label = "ReduceTPU[withMaxKeys]"
-        # device scalar accumulating mesh-path key drops (tuples whose key
-        # falls outside [0, max_keys) cannot live in the dense cross-chip
+        # device scalar accumulating dense-table key drops — mesh path
+        # and the single-chip declared-monoid path alike (tuples whose
+        # key falls outside [0, max_keys) cannot live in the dense
         # tables); read lazily at stats time, never on the step path
         self._mesh_dropped = None
 
@@ -265,6 +268,55 @@ class ReduceTPU(Operator):
                                          capacity)
 
             self._jit_steps[capacity] = step
+        return step
+
+    def _get_dense_step(self, capacity: int):
+        """Single-chip declared-monoid fast path (requires ``withMaxKeys``
+        + ``withMonoidCombiner``): ONE scatter-combine pass builds the
+        dense ``[K]`` distinct-key table — no sort, no segmented scan —
+        exactly the per-chip half of the mesh path
+        (parallel/mesh._dense_keyed_partial) without the collective.  The
+        reference pays ``thrust::sort_by_key`` + ``reduce_by_key`` for
+        every combiner (``reduce_gpu.hpp:227-258``); a declared monoid
+        makes the grouping unnecessary.  Out-of-range keys cannot live in
+        the dense table: they are dropped and counted, the same
+        ``withMaxKeys`` key-space contract the mesh path enforces
+        (single-chip UNDECLARED reduces still sort arbitrary int32
+        keys)."""
+        step = self._jit_steps.get(("dense", capacity))
+        if step is None:
+            from windflow_tpu.windows.ffat_kernels import (_monoid_identity,
+                                                           _monoid_scatter)
+            # non-keyed: one global segment, K=1 (the mesh contract,
+            # _get_sharded_step) — not a max_keys-lane batch with one row
+            K = self.max_keys if self.key_extractor is not None else 1
+            monoid = self.monoid
+            key_fn = self.key_extractor
+
+            @jax.jit
+            def step(keys, payload, ts, valid):
+                if keys is None:
+                    keys = jax.vmap(key_fn)(payload).astype(jnp.int32) \
+                        if key_fn is not None \
+                        else jnp.zeros(capacity, jnp.int32)
+                in_range = (keys >= 0) & (keys < K)
+                ok = valid & in_range
+                n_drop = jnp.sum(valid & ~in_range, dtype=jnp.int64)
+                row = jnp.where(ok, keys, K)
+
+                def scat(leaf):
+                    ident = _monoid_identity(monoid, leaf.dtype)
+                    buf = jnp.full((K + 1,) + leaf.shape[1:], ident,
+                                   leaf.dtype)
+                    return _monoid_scatter(buf.at[row], monoid)(
+                        jnp.where(_bshape(ok, leaf), leaf, ident))[:K]
+                table = jax.tree.map(scat, payload)
+                ts_t = jnp.full(K + 1, -1, jnp.int64).at[row].max(
+                    jnp.where(ok, ts, jnp.int64(-1)))[:K]
+                has = jnp.zeros(K + 1, bool).at[row].set(True)[:K]
+                return table, ts_t, has, n_drop
+
+            self._jit_steps[("dense", capacity)] = step
         return step
 
     def _get_sharded_step(self, capacity: int):
@@ -341,6 +393,19 @@ class ReduceTPU(Operator):
             # output is a capacity-max_keys batch of distinct-key records.
             table, ts_out, has, n_drop = self._get_sharded_step(
                 batch.capacity)(batch.payload, batch.ts, batch.valid)
+            self._mesh_dropped = n_drop if self._mesh_dropped is None \
+                else self._mesh_dropped + n_drop
+            return DeviceBatch(table, ts_out, has,
+                               watermark=batch.watermark, size=None,
+                               frontier=batch.frontier)
+        if self.monoid is not None and self.max_keys is not None:
+            # declared-monoid dense table: same output contract as the
+            # mesh branch (capacity-max_keys batch of distinct-key
+            # records in ascending key order — the order the sorted path
+            # also emits)
+            table, ts_out, has, n_drop = self._get_dense_step(
+                batch.capacity)(batch.keys, batch.payload,
+                                batch.ts, batch.valid)
             self._mesh_dropped = n_drop if self._mesh_dropped is None \
                 else self._mesh_dropped + n_drop
             return DeviceBatch(table, ts_out, has,
