@@ -452,6 +452,61 @@ def run_bench(platform: str, cfg: dict, jax) -> dict:
     return out
 
 
+def run_bench_reduce(platform: str, cfg: dict, jax) -> dict:
+    """Keyed per-batch ReduceTPU throughput (BASELINE.md harness list:
+    keyed Reduce_GPU, ``tests/merge_tests_gpu`` ``_kb_`` variants), both
+    single-chip paths: the sorted segmented reduce (arbitrary combiner)
+    and the declared-monoid dense scatter table (withMaxKeys +
+    withMonoidCombiner) — kernel-level, pre-staged batches, the FFAT
+    methodology (median of 5 windows)."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    import windflow_tpu as wf
+    from windflow_tpu.batch import DeviceBatch
+
+    CAP, K = cfg["cap"], cfg["keys"]
+    rng = np.random.default_rng(4)
+    dev = jax.devices()[0]
+    payload = {
+        "key": jax.device_put(
+            jnp.asarray(rng.integers(0, K, CAP), jnp.int32), dev),
+        "v": jax.device_put(
+            jnp.asarray(rng.random(CAP, dtype=np.float32)), dev),
+    }
+    batch = DeviceBatch(payload,
+                        jax.device_put(
+                            jnp.arange(CAP, dtype=jnp.int64), dev),
+                        jax.device_put(jnp.ones(CAP, bool), dev))
+    # ONE combiner for both paths (leafwise max) so the speedup is
+    # apples-to-apples: the sorted baseline folds the identical function
+    # the declared path replaces with scatter-max
+    comb = lambda a, b: {"key": jnp.maximum(a["key"], b["key"]),
+                         "v": jnp.maximum(a["v"], b["v"])}
+    out = {}
+    for label, declare in (("sorted_tps", False), ("dense_decl_tps", True)):
+        b = wf.ReduceTPU_Builder(comb).withKeyBy(lambda t: t["key"])
+        if declare:
+            b = b.withMaxKeys(K).withMonoidCombiner("max")
+        op = b.build()
+        for _ in range(cfg["warmup"]):
+            o = op._step(batch)
+        jax.block_until_ready(o.payload)
+        rates = []
+        for _ in range(5):
+            t0 = time.perf_counter()
+            for _ in range(cfg["steps"]):
+                o = op._step(batch)
+            jax.block_until_ready(o.payload)
+            rates.append(cfg["steps"] * CAP / (time.perf_counter() - t0))
+        med, disp = _median_disp(rates)
+        out[label] = round(med, 1)
+        out[label.replace("_tps", "_dispersion")] = disp
+    out["dense_speedup"] = round(out["dense_decl_tps"]
+                                 / out["sorted_tps"], 2)
+    return out
+
+
 def _e2e_graph(cfg: dict, n_tuples: int, chunks, lat_sink):
     """Build the whole-framework pipeline (VERDICT r2 item 3: benchmark what
     ``PipeGraph.run()`` sustains, not the raw kernel): columnar byte ingest →
@@ -923,6 +978,12 @@ def main() -> None:
         result["ysb_error"] = f"{type(e).__name__}: {e}"[:300]
 
     try:
+        result["reduce"] = run_bench_reduce(platform, CONFIGS[platform],
+                                            jax)
+    except Exception as e:
+        result["reduce_error"] = f"{type(e).__name__}: {e}"[:300]
+
+    try:
         e2e = run_bench_e2e(platform, CONFIGS[platform], jax,
                             kernel_tps=result["value"])
         e2e["ratio_vs_kernel"] = round(
@@ -1021,6 +1082,7 @@ def main() -> None:
                  "e2e": result.get("e2e"),
                  "e2e_device_source": result.get("e2e_device_source"),
                  "ysb": result.get("ysb"),
+                 "reduce": result.get("reduce"),
                  "t": now,
                  "recorded_at": time.strftime("%Y-%m-%d %H:%M:%S")})
     del runs[:-48]  # retention: debugging reruns can burn through a
