@@ -429,7 +429,12 @@ class ReduceTPU_Builder(_BuilderBase):
         one dense scatter-combine pass.  The declared operation is
         applied without calling ``comb``, so the declaration must match
         the combiner exactly on every leaf (a wrong kind silently
-        computes the declared operation)."""
+        computes the declared operation).  This includes a record's key
+        FIELD: under ``"sum"`` the output's key field is the leafwise
+        sum ``key * count`` — route by the key EXTRACTOR and read the
+        dense output's position (ascending key order), or prefer
+        ``"max"``/``"min"``, which are idempotent and leave a key field
+        intact."""
         self._monoid = kind
         return self
 
