@@ -111,13 +111,22 @@ class DeviceBatch:
               *after* placing all the batch's tuples (place-then-fire), so
               it never propagates past the consumer — it saves time windows
               one batch of firing lag over the conservative stamp.
+              ``ts_min``/``ts_max`` are the DATA timestamp extrema of
+              the staged lanes (host-known at staging for free; ``None``
+              for device-born batches) — outer bounds that stay valid
+              through mask-only stages (map/filter/split can only shrink
+              the valid set), letting the TB ring size itself to the
+              batch pane spread and the data-vs-watermark lag without
+              any device sync.
     """
 
     __slots__ = ("payload", "ts", "valid", "keys", "watermark", "_frontier",
-                 "_size")
+                 "_size", "ts_max", "ts_min")
 
     def __init__(self, payload, ts, valid, keys=None, watermark: int = WM_NONE,
-                 size: Optional[int] = None, frontier: Optional[int] = None):
+                 size: Optional[int] = None, frontier: Optional[int] = None,
+                 ts_max: Optional[int] = None,
+                 ts_min: Optional[int] = None):
         self.payload = payload
         self.ts = ts
         self.valid = valid
@@ -125,6 +134,8 @@ class DeviceBatch:
         self.watermark = watermark
         self._frontier = frontier
         self._size = size
+        self.ts_max = ts_max
+        self.ts_min = ts_min
 
     @property
     def frontier(self) -> int:
@@ -208,6 +219,11 @@ def _stage_soa(soa, tss, n: int, capacity: int, watermark: int,
     all lanes plus timestamps ride ONE host→device transfer as a uint32
     buffer, re-typed on device by a cached program; the validity mask is
     derived on device from ``n``, never transferred."""
+    # data-ts extrema of the real lanes: free host metadata for TB ring
+    # sizing (DeviceBatch.ts_min/ts_max)
+    _t = np.asarray(tss[:n])
+    ts_max = int(np.max(_t)) if n else None
+    ts_min = int(np.min(_t)) if n else None
     leaves, treedef = jax.tree.flatten(soa)
     if isinstance(device, jax.sharding.Sharding) and jax.process_count() > 1:
         # multi-host staging: `capacity` is the GLOBAL lane count; this
@@ -232,7 +248,7 @@ def _stage_soa(soa, tss, n: int, capacity: int, watermark: int,
         ts = assemble(np.asarray(tss, dtype=np.int64))
         valid = assemble(np.arange(local_cap) < n)
         return DeviceBatch(payload, ts, valid, watermark=watermark,
-                           size=None, frontier=frontier)
+                           size=None, frontier=frontier, ts_max=ts_max, ts_min=ts_min)
     packable = (
         device is None or isinstance(device, jax.Device)
     ) and all(l.ndim == 1 and _packable_dtype(l.dtype) for l in leaves)
@@ -277,7 +293,8 @@ def _stage_soa(soa, tss, n: int, capacity: int, watermark: int,
             else jax.device_put(buf, device)
         cols, ts, valid = unpack(dbuf)
         return DeviceBatch(jax.tree.unflatten(treedef, cols), ts, valid,
-                           watermark=watermark, size=n, frontier=frontier)
+                           watermark=watermark, size=n, frontier=frontier,
+                           ts_max=ts_max, ts_min=ts_min)
     payload = jax.tree.map(
         lambda a: jnp.asarray(_pad_leading(np.ascontiguousarray(a),
                                            capacity)), soa)
@@ -289,7 +306,7 @@ def _stage_soa(soa, tss, n: int, capacity: int, watermark: int,
         ts = jax.device_put(ts, device)
         valid = jax.device_put(valid, device)
     return DeviceBatch(payload, ts, valid, watermark=watermark, size=n,
-                       frontier=frontier)
+                       frontier=frontier, ts_max=ts_max, ts_min=ts_min)
 
 
 def host_to_device(batch: HostBatch, capacity: Optional[int] = None,

@@ -164,7 +164,8 @@ class ChainedTPU(Operator):
         # keys lane not forwarded: edge-scoped metadata (see ops/tpu.py)
         return DeviceBatch(payload, batch.ts, valid,
                            watermark=batch.watermark, size=size,
-                           frontier=batch.frontier)
+                           frontier=batch.frontier, ts_max=batch.ts_max,
+                           ts_min=batch.ts_min)
 
 
 def fuse(a: Operator, b: Operator) -> Operator:

@@ -97,7 +97,8 @@ class MapTPU(Operator):
         # map may rewrite the key field anyway.
         return DeviceBatch(out_payload, batch.ts, batch.valid,
                            watermark=batch.watermark, size=batch._size,
-                           frontier=batch.frontier)
+                           frontier=batch.frontier, ts_max=batch.ts_max,
+                           ts_min=batch.ts_min)
 
 
 class FilterTPUReplica(_TPUReplica):
@@ -131,7 +132,8 @@ class FilterTPU(Operator):
         new_valid = self._jit_step(batch.payload, batch.valid)
         return DeviceBatch(batch.payload, batch.ts, new_valid,
                            watermark=batch.watermark, frontier=batch.frontier,
-                           size=None)  # survivor count unknown until observed
+                           size=None,  # survivor count unknown until observed
+                           ts_max=batch.ts_max, ts_min=batch.ts_min)
 
 
 def _segmented_reduce(keys, payload, ts, valid, comb, capacity):

@@ -598,7 +598,9 @@ class DeviceKeyByEmitter(Emitter):
             self._send(d, DeviceBatch(batch.payload, batch.ts, mask,
                                       keys=keys,
                                       watermark=batch.watermark, size=None,
-                                      frontier=batch.frontier))
+                                      frontier=batch.frontier,
+                                      ts_max=batch.ts_max,
+                                      ts_min=batch.ts_min))
 
 
 class DevicePassEmitter(Emitter):
@@ -777,7 +779,9 @@ class SplittingEmitter(Emitter):
                 self.branches[b].emit_device_batch(
                     DeviceBatch(batch.payload, batch.ts, mask,
                                 watermark=batch.watermark,
-                                size=None, frontier=batch.frontier))
+                                size=None, frontier=batch.frontier,
+                                ts_max=batch.ts_max,
+                                ts_min=batch.ts_min))
             return
         # Fallback: host-side per-tuple split (Python or multicast split fn).
         # A device-only branch emitter cannot accept host items, but that is

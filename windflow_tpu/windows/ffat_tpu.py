@@ -267,17 +267,22 @@ class FfatWindowsTPU(Operator):
         if self._capacity is None:
             self._capacity = batch.capacity
             cap_by_mem = max(64, (1 << 23) // max(1, self.max_keys))
-            # ceiling: a batch of C tuples can never span more than C
-            # panes, and the dense [max_keys, NP] state (plus the
-            # NP-proportional window-output grid) must stay bounded; the
-            # lateness allowance is ADDED — lateness pins panes in the
-            # ring by contract, so clamping it away would make the grown
-            # ring permanently too small for high-lateness specs
+            # ceiling: purely the MEMORY bound on the dense [max_keys,
+            # NP] state (plus the NP-proportional window-output grid).
+            # It deliberately does NOT clamp to the single-batch span
+            # (one batch of C tuples spans <= C panes, but the ring must
+            # hold UNFIRED panes across MANY batches when the min-folded
+            # watermark lags the frontier — a batch-capacity ceiling made
+            # the ring ungrowable exactly when multi-channel lag needed
+            # it, found by the r5 5000-tuple fuzz soak).  The lateness
+            # allowance is ADDED — lateness pins panes in the ring by
+            # contract, so clamping it away would make the grown ring
+            # permanently too small for high-lateness specs
             lat_panes = (self.spec.lateness // self.P + 1) if self.is_tb \
                 else 0
             self._np_ceil = max(2 * self.R, self.R + 64,
                                 self.R + lat_panes
-                                + min(batch.capacity, 8192, cap_by_mem) + 2)
+                                + min(8192, cap_by_mem) + 2)
             if self.NP is None and self.is_tb:
                 # Auto-size from the FIRST batch's observed time spread
                 # (one host sync, once): 8x margin over its pane span plus
@@ -322,6 +327,8 @@ class FfatWindowsTPU(Operator):
         sidx = self._sidx(ridx)
         self._ensure(batch, sidx)
         if self.is_tb:
+            if self._auto_np and self.NP < self._np_ceil:
+                self._regrow_for_span(batch)
             # Fire on the batch's staging-time frontier, not the min-folded
             # propagated stamp: the step places every tuple of the batch
             # before firing, so the newest frontier is safe here and saves
@@ -410,8 +417,15 @@ class FfatWindowsTPU(Operator):
         self._evicted_seen = ev
         # x4 per event: the lazy read grows at most once per two
         # checkpoints, so convergence to the ceiling must be steep
-        new_np = min(self._np_ceil, max(self.NP * 4, self.NP + 64))
+        self._grow_ring(min(self._np_ceil, max(self.NP * 4, self.NP + 64)))
+
+    def _grow_ring(self, new_np: int) -> None:
+        """Pad every live ring to ``new_np`` panes (invalid columns) and
+        rebuild the step program — shared by the eviction-cadence regrow
+        above and the preemptive span regrow below."""
         pad = new_np - self.NP
+        if pad <= 0:
+            return
 
         def grow(st):
             out = dict(st)
@@ -438,6 +452,58 @@ class FfatWindowsTPU(Operator):
             # growing pains, not the stream violating a user-sized ring —
             # the 'error' policy only counts evictions past this point
             self._evicted_base = self._tb_counter("n_evicted")
+
+    def _regrow_for_span(self, batch) -> None:
+        """PREEMPTIVE ring growth from the host-known watermark lag (r5;
+        found by the 5000-tuple fuzz soak: two seeds evicted a handful of
+        panes — and suppressed their windows — under configurations whose
+        multi-replica host stages let the min-folded watermark lag the
+        staging frontier further than the first-batch span estimate).
+
+        By the watermark contract, no future tuple is older than the
+        propagated watermark, so the ring only ever needs the panes in
+        ``(wm_adj, ts_max]`` plus ``R-1`` of window history — ``ts_max``
+        is the batch's max DATA timestamp (attached host-side at staging
+        and carried through mask-only stages), which can run arbitrarily
+        far ahead of any watermark when a sibling channel lags.  Both
+        stamps are host metadata — the bound costs ZERO device syncs —
+        and growing to it BEFORE the step means the capacity roll never
+        evicts non-late data; the eviction-cadence regrow remains as the
+        backstop for device-born batches (no ``ts_max``) and streams
+        whose true span exceeds the memory ceiling.
+
+        The ring must also cover the BATCH'S OWN pane spread even when
+        every pane is fireable: one step's fire passes advance at most
+        ``3 * (NP // D + 2)`` windows, so a batch spanning far more
+        panes than the ring holds would force the capacity roll to evict
+        panes the passes could not fire in time — the
+        ``ts_max - ts_min`` spread bound (the operator's documented ring
+        contract, previously estimated from the FIRST batch only) now
+        updates from every staged batch.
+
+        Until every input channel has been heard from, the folded
+        frontier is ``WM_NONE`` and NOTHING bounds how old a sibling
+        channel's first data may be — the only safe ring is the ceiling
+        itself (which is precisely the user-accepted memory bound), so
+        data arriving before the fold resolves never forces the base
+        past an unheard sibling's range."""
+        if batch.ts_max is None:
+            return
+        wm = batch.frontier             # newest safe stamp: firing uses it
+        if wm == WM_NONE:
+            self._grow_ring(self._np_ceil)
+            return
+        lo = self._wm_pane(wm)          # oldest pane still open for data
+        hi = batch.ts_max // self.P     # newest pane this batch touches
+        needed = int(hi - lo) + self.R + 2
+        if batch.ts_min is not None:
+            spread = (batch.ts_max - batch.ts_min) // self.P + 1
+            needed = max(needed, int(spread) + self.R + 2)
+        if needed > self.NP:
+            # at least double: each growth recompiles the step, so
+            # convergence under a widening lag must be geometric
+            self._grow_ring(min(self._np_ceil,
+                                max(needed, self.NP * 2)))
 
     def _check_overflow(self):
         # operator-wide: counters and the excused-eviction base
