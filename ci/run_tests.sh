@@ -6,8 +6,16 @@
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
+# fast tier-1 gate first: the staging-plane contracts (pool reuse, fused
+# transfer round-trip, prefetch ordering) fail in seconds, before the
+# full suite spends minutes
+python -m pytest tests/test_staging.py -q -m 'not slow'
 python -m pytest tests/ -q
 python __graft_entry__.py 8
-BENCH_PLATFORM=cpu BENCH_E2E_TUPLES=131072 python bench.py
+BENCH_PLATFORM=cpu BENCH_E2E_TUPLES=131072 python bench.py | tee bench_ci_out.txt
+# the e2e decomposition keys (ratio_vs_kernel, staging_share_of_staged_run)
+# are the staging plane's evidence trail — fail if a bench refactor drops them
+python tools/check_bench_keys.py bench_ci_out.txt
+rm -f bench_ci_out.txt
 # host worker-pool smoke (reduced size; reports pool overhead on 1 core)
 BENCH_HOST_TUPLES=4000 BENCH_HOST_VEC=2048 BENCH_HOST_REPS=1 python bench_host.py

@@ -383,3 +383,37 @@ def test_single_chip_dense_reduce_non_keyed_single_record():
     exp = [max(t["v"] for t in stream[lo:lo + 64])
            for lo in range(0, 256, 64)]
     assert got == exp
+
+
+def test_single_chip_dense_drop_warns_once_and_notes_stats():
+    """ADVICE r5 low (ops/tpu.py): adding withMaxKeys + withMonoidCombiner
+    for speed silently switches ReduceTPU from the sorted path (keeps
+    arbitrary int32 keys) to the dense-table contract (out-of-range keys
+    dropped).  The FIRST observed drop must surface one RuntimeWarning
+    plus a persistent note in dump_stats — and only once."""
+    import warnings
+    stream = [{"key": (17 if i % 5 == 0 else i % 4), "v": -1.0 - i}
+              for i in range(256)]
+    with pytest.warns(RuntimeWarning, match="dense-table contract") as rec:
+        _, op = _run_reduce_graph(stream, declare=True, max_keys=4)
+        st = op.dump_stats()
+    assert sum("dense-table" in str(w.message) for w in rec) == 1
+    assert st["Out_of_range_keys_dropped"] == \
+        sum(1 for t in stream if t["key"] >= 4)
+    assert "dense-table contract" in st["Out_of_range_keys_note"]
+    with warnings.catch_warnings():        # warned once, never again
+        warnings.simplefilter("error", RuntimeWarning)
+        st2 = op.dump_stats()
+    assert "dense-table contract" in st2["Out_of_range_keys_note"]
+
+
+def test_single_chip_dense_no_drop_no_warning():
+    """In-range streams must stay silent: no warning, no stats note."""
+    import warnings
+    stream = [{"key": i % 4, "v": -1.0 - i} for i in range(256)]
+    with warnings.catch_warnings():
+        warnings.simplefilter("error", RuntimeWarning)
+        _, op = _run_reduce_graph(stream, declare=True, max_keys=4)
+        st = op.dump_stats()
+    assert st["Out_of_range_keys_dropped"] == 0
+    assert "Out_of_range_keys_note" not in st

@@ -781,3 +781,80 @@ def test_ffat_tpu_tb_ring_grows_under_merged_channel_lag():
     per_key = {0: [(t["ts"], t["value"]) for t in a],
                1: [(t["ts"], t["value"]) for t in b]}
     assert got == tb_window_sums(per_key, 4_000, 1_000)
+
+
+def test_ffat_tpu_tb_auto_ring_defers_ceiling_until_fold_resolves():
+    """ADVICE r5 low (windows/ffat_tpu.py _regrow_for_span): batches
+    staged before the multi-channel watermark fold resolves carry
+    ``frontier == WM_NONE``; the old path grew straight to the memory
+    ceiling — permanently charging tiny-span streams a ceiling-size ring
+    plus a step recompile.  The deferral grows only to the OBSERVED
+    pre-fold data spread; a small-span merged stream must finish with a
+    small ring, exact results, and nothing evicted."""
+    from conftest import tb_window_sums
+    N = 400
+    a = [{"key": 0, "value": i, "ts": i * 1000} for i in range(N)]
+    b = [{"key": 1, "value": i, "ts": i * 1000} for i in range(N)]
+    got = {}
+    g = wf.PipeGraph("fold_defer", wf.ExecutionMode.DEFAULT,
+                     wf.TimePolicy.EVENT)
+    mp = g.add_source(
+        wf.Source_Builder(lambda: iter(a))
+        .withTimestampExtractor(lambda t: t["ts"])
+        .withOutputBatchSize(16).build())
+    mp2 = g.add_source(
+        wf.Source_Builder(lambda: iter(b))
+        .withTimestampExtractor(lambda t: t["ts"])
+        .withOutputBatchSize(16).build())
+    mp = mp.merge(mp2)
+    op = (wf.Ffat_WindowsTPU_Builder(lambda t: t["value"],
+                                     lambda a_, b_: a_ + b_)
+          .withTBWindows(4_000, 1_000).withKeyBy(lambda t: t["key"])
+          .withMaxKeys(2).build())
+    mp.add(op).add_sink(wf.Sink_Builder(
+        lambda r: got.__setitem__((r["key"], r["wid"]), r["value"])
+        if r is not None else None).build())
+    g.run()
+    st = op.dump_stats()
+    assert st["Pane_cells_evicted"] == 0, st
+    assert st["Late_tuples_dropped"] == 0, st
+    # the WM_NONE phase no longer commits the ceiling: the ring stays
+    # sized to the observed span, far under the memory bound
+    assert op._np_ceil >= 4096, op._np_ceil   # bound is real headroom
+    assert op.NP <= op._np_ceil // 4, (op.NP, op._np_ceil)
+    per_key = {0: [(t["ts"], t["value"]) for t in a],
+               1: [(t["ts"], t["value"]) for t in b]}
+    assert got == tb_window_sums(per_key, 4_000, 1_000)
+
+
+def test_ffat_tpu_tb_span_regrow_skipped_multi_host(monkeypatch):
+    """ADVICE r5 medium: the span regrow reads host-side batch ts extrema,
+    which on a multi-host mesh are process-LOCAL — divergent growth
+    decisions would desynchronize the sharded ring shapes across
+    processes.  With process_count > 1 the span regrow must be a no-op
+    (the SPMD-consistent eviction-cadence regrow stays the growth path)."""
+    import types
+    import jax
+    items = [{"key": 0, "value": 1, "ts": i * 1000} for i in range(64)]
+    src = (wf.Source_Builder(lambda: iter(items))
+           .withTimestampExtractor(lambda t: t["ts"])
+           .withOutputBatchSize(16).build())
+    op = (wf.Ffat_WindowsTPU_Builder(lambda t: t["value"],
+                                     lambda a, b: a + b)
+          .withTBWindows(8_000, 2_000).withMaxKeys(1).build())
+    snk = wf.Sink_Builder(lambda r: None).build()
+    g = wf.PipeGraph("mh_skip", wf.ExecutionMode.DEFAULT,
+                     wf.TimePolicy.EVENT)
+    g.add_source(src).add(op).add_sink(snk)
+    g.run()                                # initialize ring + auto sizing
+    np0 = op.NP
+    assert op._auto_np and np0 < op._np_ceil
+    wide = types.SimpleNamespace(
+        frontier=64_000, ts_min=64_000,
+        ts_max=64_000 + op.P * (np0 + 512))   # would force growth locally
+    monkeypatch.setattr(jax, "process_count", lambda: 2)
+    op._regrow_for_span(wide)
+    assert op.NP == np0                    # skipped: no divergent growth
+    monkeypatch.setattr(jax, "process_count", lambda: 1)
+    op._regrow_for_span(wide)
+    assert op.NP > np0                     # same batch grows single-host

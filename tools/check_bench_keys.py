@@ -1,0 +1,104 @@
+#!/usr/bin/env python
+"""Guard the bench e2e decomposition contract (r6 CI check).
+
+The staging-plane work is only provable through two keys in ``bench.py``
+output — ``ratio_vs_kernel`` (staged e2e rate over kernel-only rate) and
+``staging_share_of_staged_run`` (staged-vs-device-source delta) — and
+round-over-round comparisons (BENCH_r05.json baseline: 0.7153 / 0.1964)
+silently break if a bench refactor drops either.  This check fails CI
+when they disappear.
+
+Usage::
+
+    python tools/check_bench_keys.py             # static: scan bench.py
+    python tools/check_bench_keys.py OUT.json    # dynamic: check a bench
+                                                 # run's captured output
+
+With a file argument, the last JSON object found in the file (bench.py
+prints its result dict as the final stdout line; log lines above it are
+skipped) must carry ``e2e.ratio_vs_kernel`` and — unless the
+device-source leg errored, which decomposition needs —
+``e2e_device_source.decomposition.staging_share_of_staged_run``.
+Without arguments, ``bench.py``'s source must still contain the code
+paths that emit both keys.
+"""
+
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+KEYS = ("ratio_vs_kernel", "staging_share_of_staged_run")
+
+
+def fail(msg: str) -> None:
+    print(f"check_bench_keys: FAIL: {msg}", file=sys.stderr)
+    sys.exit(1)
+
+
+def check_source() -> None:
+    with open(os.path.join(REPO, "bench.py")) as f:
+        src = f.read()
+    missing = [k for k in KEYS if f'"{k}"' not in src]
+    if missing:
+        fail(f"bench.py no longer emits {missing} — the e2e "
+             "decomposition contract (docs/PERF.md) is broken")
+    print("check_bench_keys: OK (bench.py source emits "
+          + ", ".join(KEYS) + ")")
+
+
+def last_json_object(path: str):
+    """The bench result dict: last line of the file that parses as a JSON
+    object (bench.py prints it as its final stdout line)."""
+    obj = None
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if line.startswith("{"):
+                try:
+                    cand = json.loads(line)
+                except ValueError:
+                    continue
+                if isinstance(cand, dict):
+                    obj = cand
+    return obj
+
+
+def check_output(path: str) -> None:
+    result = last_json_object(path)
+    if result is None:
+        fail(f"no JSON result object found in {path}")
+    e2e = result.get("e2e")
+    if not isinstance(e2e, dict):
+        fail(f"bench result has no 'e2e' section "
+             f"(e2e_error={result.get('e2e_error')!r})")
+    if "ratio_vs_kernel" not in e2e:
+        fail("'e2e.ratio_vs_kernel' missing from bench output")
+    dev = result.get("e2e_device_source")
+    if isinstance(dev, dict):
+        decomp = dev.get("decomposition", {})
+        if "staging_share_of_staged_run" not in decomp:
+            fail("'e2e_device_source.decomposition."
+                 "staging_share_of_staged_run' missing from bench output")
+        share = decomp["staging_share_of_staged_run"]
+    elif "e2e_device_source_error" in result:
+        # the device-source leg can fail for environment reasons (e.g. a
+        # flaky TPU tunnel); the decomposition needs both legs, so only
+        # report — the ratio key above is still enforced
+        print("check_bench_keys: note: device-source leg errored "
+              f"({result['e2e_device_source_error']!r}); decomposition "
+              "absent for this run")
+        share = None
+    else:
+        fail("bench output has neither 'e2e_device_source' nor "
+             "'e2e_device_source_error'")
+    print("check_bench_keys: OK (ratio_vs_kernel="
+          f"{e2e['ratio_vs_kernel']}, staging_share_of_staged_run="
+          f"{share})")
+
+
+if __name__ == "__main__":
+    if len(sys.argv) > 1:
+        check_output(sys.argv[1])
+    else:
+        check_source()

@@ -61,6 +61,8 @@ from windflow_tpu.persistent import (DBHandle, LogKV, PFilter, PFlatMap,
                                      P_Filter_Builder, P_FlatMap_Builder,
                                      P_Keyed_Windows_Builder, P_Map_Builder,
                                      P_Reduce_Builder, P_Sink_Builder)
+from windflow_tpu import staging
+from windflow_tpu.staging import StagingPool
 
 __version__ = "0.3.0"  # keep in sync with pyproject.toml
 
@@ -85,4 +87,5 @@ __all__ = [
     "PKeyedWindows", "P_Map_Builder", "P_Filter_Builder",
     "P_FlatMap_Builder", "P_Reduce_Builder", "P_Sink_Builder",
     "P_Keyed_Windows_Builder",
+    "staging", "StagingPool",
 ]

@@ -143,6 +143,16 @@ class Config:
     # per-tuple functions are GIL-bound, as in any CPython thread pool.
     host_worker_threads: int = int(os.environ.get("WF_TPU_HOST_WORKERS",
                                                   "0"))
+    # Staging-plane lookahead (windflow_tpu/staging): extra source-tick
+    # passes per scheduler sweep AFTER the drain phase, so batch N+1 is
+    # packed into a (pooled) host staging buffer while batch N's
+    # asynchronously dispatched XLA step still runs — the driver-loop form
+    # of the reference's 2-deep pinned double buffering
+    # (forward_emitter_gpu.hpp:254-300).  Each pass re-checks backpressure
+    # first, so the in-transit caps above still bound lookahead depth.
+    # 0 disables (sources tick once per sweep, pre-r6 behavior).
+    stage_prefetch_depth: int = int(os.environ.get("WF_TPU_STAGE_PREFETCH",
+                                                   "1"))
     # FFAT batch-grouping algorithm: "rank_scatter" (default) groups each
     # batch by key with the O(n) dense-key counting permutation
     # (windows/grouping.py — no comparison sort; the reference pays

@@ -34,6 +34,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from windflow_tpu import staging
+
 TS_DTYPE = jnp.int64
 #: Watermark value meaning "no watermark yet".
 WM_NONE = -1
@@ -188,7 +190,7 @@ def _pad_leading(arr: np.ndarray, capacity: int) -> np.ndarray:
 
 
 #: cached unpack programs for packed staging, keyed by
-#: (leaf treedef/dtypes, capacity, n) — one trace per batch shape
+#: (leaf treedef/dtypes, capacity) — one trace per batch shape
 _UNPACK_CACHE: dict = {}
 
 # 32-bit word packing: host↔device links are dominated by per-TRANSFER
@@ -197,17 +199,63 @@ _UNPACK_CACHE: dict = {}
 # so all lanes of a batch ride ONE uint32 buffer.  Only 32-bit bitcasts are
 # used on device — the TPU X64-rewrite pass implements no 64-bit bitcast —
 # int64 lanes travel as arithmetic lo/hi word pairs; float64 lanes make a
-# batch unpackable (TPU has no native f64 anyway: stage f32).
+# batch unpackable (TPU has no native f64 anyway: stage f32).  Packing,
+# layout, and the host-buffer recycling pool live in windflow_tpu/staging.
+
+_words = staging.lane_words
+_packable_dtype = staging.packable_dtype
 
 
-def _words(dt: np.dtype) -> int:
-    return 2 if dt.itemsize == 8 else 1
+def _get_unpack(treedef, dtypes, capacity: int):
+    """Cached device program re-typing one packed uint32 staging buffer
+    into payload columns + ts lane + validity mask (derived on device from
+    the trailing fill-count word — never transferred separately, and cached
+    per capacity, not per fill level)."""
+    key = (treedef, dtypes, capacity)
+    unpack = _UNPACK_CACHE.get(key)
+    if unpack is None:
+        def unpack_fn(b):
+            cols, off = [], 0
+            for dt in dtypes + ("int64",):
+                d = np.dtype(dt)
+                if d.itemsize == 8:
+                    seg = b[off:off + 2 * capacity]
+                    lo = seg[0::2].astype(jnp.int64)
+                    hi = seg[1::2].astype(jnp.int64)
+                    cols.append(((hi << 32) | lo).astype(d))
+                    off += 2 * capacity
+                else:
+                    cols.append(jax.lax.bitcast_convert_type(
+                        b[off:off + capacity], d))
+                    off += capacity
+            n_valid = b[-1].astype(jnp.int32)
+            return cols[:-1], cols[-1], \
+                jnp.arange(capacity, dtype=jnp.int32) < n_valid
+        unpack = jax.jit(unpack_fn)
+        _UNPACK_CACHE[key] = unpack
+    return unpack
 
 
-def _packable_dtype(dt) -> bool:
-    dt = np.dtype(dt)
-    return (dt.itemsize == 4) or dt in (np.dtype(np.int64),
-                                        np.dtype(np.uint64))
+def stage_packed(buf: np.ndarray, treedef, dtypes, capacity: int, n: int,
+                 watermark: int = WM_NONE, device=None,
+                 frontier: Optional[int] = None,
+                 ts_max: Optional[int] = None, ts_min: Optional[int] = None,
+                 pool=None) -> DeviceBatch:
+    """ONE host→device transfer of a packed staging buffer (built by
+    ``staging.PackedBatchBuilder`` or the inline pack in ``_stage_soa``)
+    into a DeviceBatch.  When ``pool`` is given, ``buf`` is recycled with
+    the unpack output as its gate — the device owns the buffer until the
+    unpack has executed, so reuse can never race the (asynchronous)
+    transfer (staging.StagingPool)."""
+    unpack = _get_unpack(treedef, dtypes, capacity)
+    dbuf = jnp.asarray(buf) if device is None \
+        else jax.device_put(buf, device)
+    cols, ts, valid = unpack(dbuf)
+    if pool is not None:
+        pool.release(buf, gate=valid)
+    return DeviceBatch(jax.tree.unflatten(treedef, cols), ts, valid,
+                       watermark=watermark, size=n, frontier=frontier,
+                       ts_max=ts_max, ts_min=ts_min)
 
 
 def _stage_soa(soa, tss, n: int, capacity: int, watermark: int,
@@ -247,54 +295,33 @@ def _stage_soa(soa, tss, n: int, capacity: int, watermark: int,
         payload = jax.tree.map(assemble, soa)
         ts = assemble(np.asarray(tss, dtype=np.int64))
         valid = assemble(np.arange(local_cap) < n)
+        # ts extrema deliberately NOT attached (ADVICE r5 medium): they
+        # describe only this process's local slice of a globally sharded
+        # batch, and attaching them would let windows/ffat_tpu
+        # _regrow_for_span make DIFFERENT ring-growth decisions per
+        # process, desynchronizing sharded state shapes.  The eviction-
+        # cadence regrow (SPMD-consistent n_evicted sums) remains the
+        # ring's growth path on multi-host meshes.
         return DeviceBatch(payload, ts, valid, watermark=watermark,
-                           size=None, frontier=frontier, ts_max=ts_max, ts_min=ts_min)
+                           size=None, frontier=frontier,
+                           ts_max=None, ts_min=None)
     packable = (
         device is None or isinstance(device, jax.Device)
     ) and all(l.ndim == 1 and _packable_dtype(l.dtype) for l in leaves)
     if packable:
         dtypes = tuple(str(np.dtype(l.dtype)) for l in leaves)
-        lanes = list(leaves) + [np.asarray(tss, dtype=np.int64)]
-        lane_words = [_words(np.dtype(l.dtype)) for l in lanes]
-        # final word carries n, so the unpack program is cached per
-        # capacity, not per fill level (no per-partial-batch recompiles,
-        # and no extra scalar transfer)
-        total = sum(lane_words) * capacity + 1
-        buf = np.zeros(total, np.uint32)
-        o = 0
-        for l, w in zip(lanes, lane_words):
-            src = np.ascontiguousarray(l).view(np.uint32)  # LE interleaved
-            buf[o:o + w * n] = src
-            o += w * capacity
-        buf[-1] = n
-        key = (treedef, dtypes, capacity)
-        unpack = _UNPACK_CACHE.get(key)
-        if unpack is None:
-            def unpack_fn(b):
-                cols, off = [], 0
-                for dt in dtypes + ("int64",):
-                    d = np.dtype(dt)
-                    if d.itemsize == 8:
-                        seg = b[off:off + 2 * capacity]
-                        lo = seg[0::2].astype(jnp.int64)
-                        hi = seg[1::2].astype(jnp.int64)
-                        cols.append(((hi << 32) | lo).astype(d))
-                        off += 2 * capacity
-                    else:
-                        cols.append(jax.lax.bitcast_convert_type(
-                            b[off:off + capacity], d))
-                        off += capacity
-                n_valid = b[-1].astype(jnp.int32)
-                return cols[:-1], cols[-1], \
-                    jnp.arange(capacity, dtype=jnp.int32) < n_valid
-            unpack = jax.jit(unpack_fn)
-            _UNPACK_CACHE[key] = unpack
-        dbuf = jnp.asarray(buf) if device is None \
-            else jax.device_put(buf, device)
-        cols, ts, valid = unpack(dbuf)
-        return DeviceBatch(jax.tree.unflatten(treedef, cols), ts, valid,
-                           watermark=watermark, size=n, frontier=frontier,
-                           ts_max=ts_max, ts_min=ts_min)
+        pool = staging.default_pool()
+        # pooled buffer + streaming pack (staging.PackedBatchBuilder):
+        # steady-state staging allocates no numpy buffers, and the final
+        # word carries n, so the unpack program is cached per capacity,
+        # not per fill level (no per-partial-batch recompiles, and no
+        # extra scalar transfer)
+        b = staging.PackedBatchBuilder(dtypes, capacity, pool=pool)
+        b.append(leaves, np.asarray(tss, dtype=np.int64))
+        return stage_packed(b.finish(), treedef, dtypes, capacity, n,
+                            watermark=watermark, device=device,
+                            frontier=frontier, ts_max=ts_max,
+                            ts_min=ts_min, pool=pool)
     payload = jax.tree.map(
         lambda a: jnp.asarray(_pad_leading(np.ascontiguousarray(a),
                                            capacity)), soa)

@@ -147,6 +147,7 @@ class DeviceSource_Builder(_BuilderBase):
         self._n_batches = 0
         self._ts_fn = None
         self._wm_fn = None
+        self._ts_bounds_fn = None
 
     def withCapacity(self, n: int):
         """Lanes per generated batch (the compiled batch shape)."""
@@ -166,6 +167,14 @@ class DeviceSource_Builder(_BuilderBase):
         self._wm_fn = wm_fn
         return self
 
+    def withTimestampBounds(self, ts_bounds_fn: Callable):
+        """HOST fn ``i -> (ts_min, ts_max)`` bounding batch ``i``'s event
+        timestamps: attaches the data-ts extrema that let downstream TB
+        window rings size themselves preemptively without a device sync
+        (DeviceBatch.ts_min/ts_max; EVENT time only)."""
+        self._ts_bounds_fn = ts_bounds_fn
+        return self
+
     def withKeyBy(self, *_):
         raise WindFlowError("a Source has no input to key by")
 
@@ -180,7 +189,8 @@ class DeviceSource_Builder(_BuilderBase):
         from windflow_tpu.io.device_source import DeviceSource
         return DeviceSource(self._batch_fn, self._capacity, self._n_batches,
                             name=self._name, parallelism=self._parallelism,
-                            ts_fn=self._ts_fn, wm_fn=self._wm_fn)
+                            ts_fn=self._ts_fn, wm_fn=self._wm_fn,
+                            ts_bounds_fn=self._ts_bounds_fn)
 
 
 class Map_Builder(_BroadcastMixin, _BuilderBase):

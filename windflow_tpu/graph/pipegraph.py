@@ -41,6 +41,13 @@ from windflow_tpu.parallel.collectors import create_collector
 from windflow_tpu.parallel.emitters import SplittingEmitter, create_emitter
 
 
+def _staging_pool_stats() -> dict:
+    """Hit/miss counters of the process-wide staging-buffer recycling pool
+    (windflow_tpu/staging), surfaced through the monitoring stats dump."""
+    from windflow_tpu import staging
+    return staging.default_pool().stats()
+
+
 def _rss_kb() -> float:
     """Resident set size in KiB (reference ``get_MemUsage``,
     ``monitoring.hpp:52-70``)."""
@@ -74,6 +81,8 @@ class PipeGraph:
         self._throttle_events = 0
         self._max_inbox_seen = 0
         self._max_inflight_device_seen = 0
+        # staging-plane lookahead telemetry (Config.stage_prefetch_depth)
+        self._prefetch_ticks = 0
         # host worker pool (Config.host_worker_threads): replicas drained
         # off the driver thread, and the driver-thread remainder
         self._pool = None
@@ -354,6 +363,25 @@ class PipeGraph:
             for f in futures:
                 if f.result():
                     progress = True
+        # Staging-plane prefetch (Config.stage_prefetch_depth): the drain
+        # above only DISPATCHED device work (JAX dispatch is async), so the
+        # host is idle while the chip crunches — use it to pack batch N+1
+        # into the recycled staging buffers now (windflow_tpu/staging),
+        # the driver-loop form of the reference's 2-deep pinned double
+        # buffering.  Each pass re-checks the in-transit caps, so
+        # lookahead never overruns backpressure; punctuation cadence stays
+        # with the main tick pass.
+        for _ in range(max(0, self.config.stage_prefetch_depth)):
+            if self._backpressured():
+                break
+            ticked = False
+            for sr in self._source_replicas:
+                if not sr.exhausted and sr.tick(self._tick_chunk(sr)):
+                    ticked = True
+            if not ticked:
+                break
+            progress = True
+            self._prefetch_ticks += 1
         if not progress:
             # Sources were deferred but nothing drained (e.g. limit=0 edge
             # cases): force one tick so the graph cannot deadlock on its own
@@ -439,6 +467,11 @@ class PipeGraph:
             "Non_blocking": "ON",     # async XLA dispatch
             "Thread_pinning": "OFF",  # driver loop + pool, no pinning
             "Host_worker_threads": self.config.host_worker_threads,
+            # staging plane (windflow_tpu/staging): host-buffer recycling
+            # pool counters + lookahead tick count
+            "Staging_pool": _staging_pool_stats(),
+            "Stage_prefetch_depth": self.config.stage_prefetch_depth,
+            "Stage_prefetch_ticks": self._prefetch_ticks,
             "Dropped_tuples": self.get_num_dropped_tuples(),
             "Operator_number": len(self._operators),
             "Thread_number": 1 + self.config.host_worker_threads

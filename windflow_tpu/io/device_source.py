@@ -77,9 +77,20 @@ class DeviceSourceReplica(BaseSourceReplica):
         if self.time_policy == TimePolicy.INGRESS:
             base = max(current_time_usecs(), self._last_ts + 1)
             wm = base
+            # every lane carries the same arrival stamp, so the data-ts
+            # extrema are host-known for free — device-born batches then
+            # feed the same preemptive TB ring sizing as staged batches
+            # (DeviceBatch.ts_min/ts_max, windows/ffat_tpu
+            # _regrow_for_span) without any device sync
+            ts_lo = ts_hi = base
         else:
             base = 0
             wm = int(self.op.wm_fn(self._i))
+            if self.op.ts_bounds_fn is not None:
+                lo, hi = self.op.ts_bounds_fn(self._i)
+                ts_lo, ts_hi = int(lo), int(hi)
+            else:
+                ts_lo = ts_hi = None    # unknown: eviction backstop only
         payload, ts, valid = self._jit(jnp.int32(self._i), jnp.int64(base))
         self._last_ts = max(self._last_ts, wm)
         self._advance_wm(self._last_ts)
@@ -87,7 +98,7 @@ class DeviceSourceReplica(BaseSourceReplica):
         self.stats.device_programs_launched += 1
         self.emitter.emit_device_batch(
             DeviceBatch(payload, ts, valid, watermark=self.current_wm,
-                        size=self.op.capacity))
+                        size=self.op.capacity, ts_min=ts_lo, ts_max=ts_hi))
         self._i += self.op.parallelism
         self._count_toward_punctuation(self.op.capacity)
         return True
@@ -104,7 +115,8 @@ class DeviceSource(Source):
     def __init__(self, batch_fn: Callable, capacity: int, n_batches: int,
                  name: str = "device_source", parallelism: int = 1,
                  ts_fn: Optional[Callable] = None,
-                 wm_fn: Optional[Callable[[int], int]] = None) -> None:
+                 wm_fn: Optional[Callable[[int], int]] = None,
+                 ts_bounds_fn: Optional[Callable] = None) -> None:
         if capacity <= 0 or n_batches < 0:
             raise WindFlowError(
                 "device source needs capacity > 0 and n_batches >= 0")
@@ -115,4 +127,10 @@ class DeviceSource(Source):
         self.n_batches = n_batches
         self.ts_fn = ts_fn
         self.wm_fn = wm_fn
+        #: optional HOST fn ``i -> (ts_min, ts_max)`` bounding the event-
+        #: time lane of batch ``i``: attaches the data-ts extrema that let
+        #: downstream TB window rings size themselves preemptively
+        #: (batch.py DeviceBatch.ts_min/ts_max) — without it, device-born
+        #: EVENT batches rely on the eviction-cadence backstop
+        self.ts_bounds_fn = ts_bounds_fn
         self.ts_extractor = None

@@ -251,6 +251,16 @@ class ReduceTPU(Operator):
         # key falls outside [0, max_keys) cannot live in the dense
         # tables); read lazily at stats time, never on the step path
         self._mesh_dropped = None
+        # one-time drop warning for the single-chip dense path (ADVICE
+        # r5): adding withMaxKeys + withMonoidCombiner for speed silently
+        # switches semantics from the sorted path (keeps arbitrary int32
+        # keys) to the dense-table contract (out-of-range keys dropped) —
+        # surface the first observed drop loudly.  The cadence check reads
+        # a device scalar enqueued 64 steps earlier (same lazy-read trick
+        # as the FFAT regrow checkpoint), so the hot path never syncs.
+        self._drop_warned = False
+        self._drop_steps = 0
+        self._pending_drop = None
 
     def _get_step(self, capacity: int):
         step = self._jit_steps.get(capacity)
@@ -347,10 +357,35 @@ class ReduceTPU(Operator):
             return 0
         return int(self._mesh_dropped)  # one device sync, diagnostics only
 
+    def _maybe_warn_drops(self, n_drop: int) -> None:
+        """One-time RuntimeWarning the first time the single-chip dense
+        path (withMaxKeys + withMonoidCombiner) is SEEN dropping
+        out-of-range keys; also noted in dump_stats, mirroring how the
+        other silent-drop paths surface through the stats layer."""
+        if self._drop_warned or n_drop <= 0 or self.mesh is not None:
+            return
+        self._drop_warned = True
+        import warnings
+        warnings.warn(
+            f"ReduceTPU '{self.name}': withMaxKeys({self.max_keys}) + "
+            "withMonoidCombiner uses the dense-table contract — "
+            f"{n_drop} tuple(s) with out-of-range keys (outside "
+            f"[0, {self.max_keys})) were dropped and counted in "
+            "Out_of_range_keys_dropped; the undeclared sorted path keeps "
+            "arbitrary int32 keys", RuntimeWarning, stacklevel=3)
+
     def dump_stats(self) -> dict:
         st = super().dump_stats()
         if self._mesh_dropped is not None:
-            st["Out_of_range_keys_dropped"] = self.num_dropped_tuples()
+            dropped = self.num_dropped_tuples()
+            st["Out_of_range_keys_dropped"] = dropped
+            self._maybe_warn_drops(dropped)
+            if self._drop_warned:
+                st["Out_of_range_keys_note"] = (
+                    "dense-table contract (withMaxKeys + "
+                    "withMonoidCombiner): keys outside [0, max_keys) are "
+                    "dropped; the undeclared sorted path keeps arbitrary "
+                    "int32 keys")
         return st
 
     def _check_comb_contract(self, payload) -> None:
@@ -377,7 +412,9 @@ class ReduceTPU(Operator):
         # Same structure is not enough: a leaf whose shape or dtype drifts
         # (a combiner summing over an axis, or promoting f32→f64) fails
         # later inside the scan with the same opaque mismatch.
-        in_leaves, _ = jax.tree.flatten_with_path(one)
+        # tree_util spelling: jax.tree.flatten_with_path only exists on
+        # jax >= 0.5 and this must run on the 0.4.x floor too
+        in_leaves, _ = jax.tree_util.tree_flatten_with_path(one)
         out_leaves = jax.tree.leaves(out_struct)
         for (path, a), b in zip(in_leaves, out_leaves):
             if a.shape != b.shape or a.dtype != b.dtype:
@@ -410,6 +447,14 @@ class ReduceTPU(Operator):
                                 batch.ts, batch.valid)
             self._mesh_dropped = n_drop if self._mesh_dropped is None \
                 else self._mesh_dropped + n_drop
+            # lazy drop check on a 64-step cadence: inspects the counter
+            # enqueued one cadence AGO (long executed — no sync stall)
+            self._drop_steps += 1
+            if not self._drop_warned and self._drop_steps % 64 == 0:
+                prev = self._pending_drop
+                self._pending_drop = self._mesh_dropped
+                if prev is not None:
+                    self._maybe_warn_drops(int(prev))
             return DeviceBatch(table, ts_out, has,
                                watermark=batch.watermark, size=None,
                                frontier=batch.frontier)

@@ -29,11 +29,14 @@ from __future__ import annotations
 import math
 from typing import Any, Callable, Optional, Sequence, Tuple
 
+import jax
 import numpy as np
 
+from windflow_tpu import staging
 from windflow_tpu.basic import RoutingMode, WindFlowError
 from windflow_tpu.batch import (DeviceBatch, HostBatch, Punctuation, WM_NONE,
-                                columns_to_device, host_to_device)
+                                columns_to_device, host_to_device,
+                                stage_packed)
 
 
 _M64 = (1 << 64) - 1
@@ -352,6 +355,21 @@ class DeviceStageEmitter(Emitter):
         # with the running max at ITS last row.
         self._col_chunks = []
         self._col_rows = 0
+        # Streaming packed staging (windflow_tpu/staging): single-chip
+        # packable columns bypass the chunk-accumulate/concatenate path
+        # entirely — rows are written straight into a pooled staging
+        # buffer at their final packed offsets, and a full buffer ships
+        # as ONE fused host→device transfer.  State of the open builder
+        # (the pool is looked up per batch, not captured: swapping the
+        # process-wide pool via staging.set_default_pool must redirect
+        # live emitters, or stats()["Staging_pool"] reports counters the
+        # staging path no longer touches):
+        self._builder = None
+        self._b_dtypes = None
+        self._b_treedef = None
+        self._b_wm = WM_NONE            # running row-frontier max
+        self._b_ts_min = None           # data-ts extrema of the OPEN batch
+        self._b_ts_max = None
         # Multi-chip: lay staged batch lanes out data-sharded over the mesh
         # so downstream sharded programs consume them without a reshard
         # (parallel/mesh.py batch_sharding).
@@ -362,15 +380,13 @@ class DeviceStageEmitter(Emitter):
         #: is assembled shard-locally (batch.py _stage_soa; SURVEY §5.8)
         self._local_cap = output_batch_size
         if mesh is not None:
-            import jax as _jax
-
             from windflow_tpu.parallel.mesh import batch_sharding
             if output_batch_size % math.prod(mesh.devices.shape):
                 raise WindFlowError(
                     f"output batch size {output_batch_size} not divisible "
                     f"by the mesh's {math.prod(mesh.devices.shape)} devices")
             self._stage_target = batch_sharding(mesh)
-            if _jax.process_count() > 1:
+            if jax.process_count() > 1:
                 # fully-sharded staging: each process's lanes land at its
                 # own (data, key) blocks (batch.py _stage_soa); consumers
                 # gather over both axes (mesh.py ingest="flat")
@@ -380,7 +396,7 @@ class DeviceStageEmitter(Emitter):
                 from windflow_tpu.parallel.mesh import DATA_AXIS, KEY_AXIS
                 self._stage_target = NamedSharding(
                     mesh, _P((DATA_AXIS, KEY_AXIS)))
-                self._local_cap = output_batch_size // _jax.process_count()
+                self._local_cap = output_batch_size // jax.process_count()
 
     def _advance_frontier(self, wm):
         if wm != WM_NONE and wm > self._frontier:
@@ -396,10 +412,93 @@ class DeviceStageEmitter(Emitter):
             self.flush(wm)
 
     def emit_columns(self, cols, tss, wm, row_wms=None):
-        """Columnar fast path: accumulate SoA chunks, stage full batches with
-        one concatenate + one transfer (reference pinned staging without the
-        per-tuple fill loop, ``forward_emitter_gpu.hpp:254-300``).  See the
-        ``_col_chunks`` note for the watermark lane."""
+        """Columnar fast path.  Single-chip packable columns take the
+        STREAMING packed route: rows are written directly into a pooled
+        staging buffer at their final packed offsets
+        (staging.PackedBatchBuilder) and a full buffer ships as ONE fused
+        host→device transfer — no chunk concatenate, no per-batch numpy
+        allocation, no per-lane device_put (the reference's recycled
+        pinned staging, ``forward_emitter_gpu.hpp:254-300`` +
+        ``recycling.hpp``).  Mesh-sharded targets and non-packable lanes
+        fall back to the chunk-accumulate path below."""
+        if self._stage_target is None and not self._col_chunks:
+            leaves, treedef = jax.tree.flatten(
+                {nm: np.asarray(a) for nm, a in cols.items()})
+            if all(l.ndim == 1 and staging.packable_dtype(l.dtype)
+                   for l in leaves):
+                self._emit_columns_packed(leaves, treedef, tss, wm, row_wms)
+                return
+        if self._builder is not None:
+            # falling back mid-stream: ship the open packed rows first so
+            # per-destination arrival order is preserved
+            self._finalize_builder()
+        self._emit_columns_chunked(cols, tss, wm, row_wms)
+
+    def _emit_columns_packed(self, leaves, treedef, tss, wm, row_wms):
+        """Streaming packed staging (see emit_columns).  Watermark lane
+        contract matches the chunked path: a staged batch is stamped with
+        the running row-frontier max at ITS last row; a chunk-level ``wm``
+        is applied only once the chunk's last row is packed."""
+        tss = np.ascontiguousarray(tss, np.int64)
+        dtypes = tuple(str(l.dtype) for l in leaves)
+        if self._builder is not None and (treedef != self._b_treedef
+                                          or dtypes != self._b_dtypes):
+            self._finalize_builder()    # lane structure changed mid-stream
+        m = len(tss)
+        pos = 0
+        while pos < m:
+            if self._builder is None:
+                self._b_treedef = treedef
+                self._b_dtypes = dtypes
+                self._builder = staging.PackedBatchBuilder(
+                    dtypes, self.output_batch_size)
+                self._b_ts_min = None
+                self._b_ts_max = None
+            take = min(self._builder.room, m - pos)
+            sl = slice(pos, pos + take)
+            tsl = tss[sl]
+            self._builder.append([l[sl] for l in leaves], tsl)
+            lo, hi = int(tsl.min()), int(tsl.max())
+            if self._b_ts_min is None or lo < self._b_ts_min:
+                self._b_ts_min = lo
+            if self._b_ts_max is None or hi > self._b_ts_max:
+                self._b_ts_max = hi
+            if row_wms is not None:
+                w = int(np.max(row_wms[sl]))
+                if w != WM_NONE and w > self._b_wm:
+                    self._b_wm = w
+            elif pos + take == m and wm != WM_NONE and wm > self._b_wm:
+                # a chunk-level wm is valid only after the chunk's LAST row
+                self._b_wm = wm
+            pos += take
+            if self._builder.room == 0:
+                self._finalize_builder()
+
+    def _finalize_builder(self, fallback_wm: int = WM_NONE) -> None:
+        """Ship the open packed batch (padding derived on device from the
+        fill count; the pooled buffer is recycled gated on the unpack —
+        batch.stage_packed)."""
+        b, self._builder = self._builder, None
+        if b is None:
+            return
+        if b.n == 0:
+            b.abandon()
+            return
+        wm = self._b_wm if self._b_wm != WM_NONE else fallback_wm
+        self._advance_frontier(wm)
+        db = stage_packed(b.finish(), self._b_treedef, self._b_dtypes,
+                          b.capacity, b.n, watermark=wm, device=None,
+                          frontier=self._frontier,
+                          ts_max=self._b_ts_max, ts_min=self._b_ts_min,
+                          pool=b.pool)
+        d = self._next
+        self._next = (self._next + 1) % len(self.dests)
+        self._send(d, db)
+
+    def _emit_columns_chunked(self, cols, tss, wm, row_wms=None):
+        """Chunk-accumulate staging (mesh-sharded targets, non-packable
+        lanes): stage full batches with one concatenate + one transfer.
+        See the ``_col_chunks`` note for the watermark lane."""
         if row_wms is None:
             # chunk-level wm: valid only after the last row
             row_wms = np.full(len(tss), WM_NONE, np.int64)
@@ -439,6 +538,8 @@ class DeviceStageEmitter(Emitter):
         self._send(d, db)
 
     def flush(self, wm):
+        if self._builder is not None:
+            self._finalize_builder(fallback_wm=wm)
         if self._col_chunks:
             names = list(self._col_chunks[0][0])
             cat = {n: _concat([c[0][n] for c in self._col_chunks])

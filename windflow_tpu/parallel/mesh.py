@@ -39,6 +39,19 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+# jax.shard_map is the >= 0.6 spelling (replication check kwarg
+# `check_vma`); the 0.4.x floor ships it under jax.experimental with the
+# check named `check_rep` — resolve once so every collective below works
+# on both
+if hasattr(jax, "shard_map"):
+    shard_map = jax.shard_map
+else:
+    from jax.experimental.shard_map import shard_map as _exp_shard_map
+
+    def shard_map(f, *, mesh, in_specs, out_specs, check_vma=True):
+        return _exp_shard_map(f, mesh=mesh, in_specs=in_specs,
+                              out_specs=out_specs, check_rep=check_vma)
+
 from windflow_tpu.basic import WindFlowError
 from windflow_tpu.batch import DeviceBatch, HostBatch, host_to_device
 from windflow_tpu.windows.ffat_kernels import (_b, _masked_reduce_last,
@@ -187,7 +200,7 @@ def make_sharded_reduce_step(mesh: Mesh, capacity: int, K: int,
         anyf, (folded, ts_f) = _masked_reduce_last(comb2, g_h, g_t, axis=0)
         return folded, ts_f, anyf, n_drop
 
-    fn = jax.shard_map(local, mesh=mesh,
+    fn = shard_map(local, mesh=mesh,
                        in_specs=(P(axes), P(axes), P(axes)),
                        out_specs=(P(), P(), P(), P()), check_vma=False)
     return jax.jit(fn)
@@ -257,7 +270,7 @@ def make_sharded_reduce_arbitrary(mesh: Mesh, capacity: int, comb: Callable,
             rkeys, rp, rt, rm, comb, capacity)
         return out_payload, out_ts, out_valid, jnp.zeros((), jnp.int64)
 
-    fn = jax.shard_map(local, mesh=mesh,
+    fn = shard_map(local, mesh=mesh,
                        in_specs=(P(axes), P(axes), P(axes)),
                        out_specs=(P(axes), P(axes), P(axes), P()),
                        check_vma=False)
@@ -372,7 +385,7 @@ def make_sharded_ffat_step(mesh: Mesh, capacity: int, K: int, Pn: int, R: int,
         payload, ts, valid = gather(payload, ts, valid)
         return step_local(state, payload, ts, valid)
 
-    fn = jax.shard_map(
+    fn = shard_map(
         local, mesh=mesh,
         in_specs=(P(KEY_AXIS), bspec, bspec, bspec),
         out_specs=(P(KEY_AXIS), P(KEY_AXIS), P(KEY_AXIS), P(KEY_AXIS)),
@@ -394,7 +407,7 @@ def make_sharded_ffat_flush(mesh: Mesh, K: int, Pn: int, R: int, D: int,
     key_base_fn = lambda: jax.lax.axis_index(KEY_AXIS) * K_local
     flush_local = make_ffat_flush(K_local, Pn, R, D, comb,
                                   key_base_fn=key_base_fn)
-    fn = jax.shard_map(
+    fn = shard_map(
         flush_local, mesh=mesh,
         in_specs=(P(KEY_AXIS),),
         out_specs=(P(KEY_AXIS), P(KEY_AXIS), P(KEY_AXIS)),
@@ -486,7 +499,7 @@ def make_sharded_stateful_step(mesh: Mesh, capacity: int, S: int,
             lambda l: merge_lanes(sl(l), owned_b), out_payload)
         return new_state, merged_payload, valid_b & owned_any
 
-    fn = jax.shard_map(
+    fn = shard_map(
         local, mesh=mesh,
         in_specs=(P(KEY_AXIS), P(DATA_AXIS), P(DATA_AXIS), P(), P()),
         out_specs=(P(KEY_AXIS), P(DATA_AXIS), P(DATA_AXIS)),
@@ -557,7 +570,7 @@ def make_sharded_ffat_tb_step(mesh: Mesh, capacity: int, K: int, P_usec: int,
 
     sspec = {k: P(KEY_AXIS) for k in
              ("cells", "cell_valid", "horizon") + _TB_SCALARS}
-    fn = jax.shard_map(
+    fn = shard_map(
         local, mesh=mesh,
         in_specs=(sspec, bspec, bspec, bspec, P()),
         out_specs=(sspec, P(KEY_AXIS), P(KEY_AXIS), P(KEY_AXIS), P()),

@@ -1,0 +1,255 @@
+"""Staging plane: host-buffer recycling pool + streaming packed batches.
+
+WindFlow's L1 data plane gets its rate from two mechanisms this module
+reproduces for the TPU (reference ``recycling.hpp`` ``ff::MPMC_Ptr_Queue``
+batch recycling; ``batch_gpu_t.hpp`` per-batch CUDA streams overlapping
+H2D copies with kernel execution):
+
+* :class:`StagingPool` — fixed-capacity, size-keyed pool of host ``uint32``
+  staging buffers reused across batches, so steady-state staging performs
+  **zero numpy allocation** (the reference's recycling queue).  A released
+  buffer carries a device-side *gate*: any array whose readiness implies
+  the device has finished consuming the buffer.  Re-acquiring a buffer
+  whose gate is still in flight blocks until the gate is ready — the
+  recycling queue's blocking pop, which doubles as natural backpressure
+  exactly like the reference's ``FullGPUMemoryException`` retry loop
+  (``recycling_gpu.hpp:88-126``).  In steady state the gate is long ready
+  (the pool runs several buffers deep) and acquire never syncs.
+
+* :class:`PackedBatchBuilder` — streaming packer writing SoA chunk slices
+  straight into a pooled buffer at their final packed offsets: all payload
+  lanes, the timestamp lane, and the fill count ride ONE contiguous host
+  buffer and ONE host→device transfer per batch (``batch.py`` unpacks it
+  on device with a cached program).  No intermediate concatenate, no
+  per-lane ``device_put`` — host↔device links are dominated by
+  per-transfer latency, not bandwidth.
+
+* Double-buffered prefetch lives in the run loop
+  (``graph/pipegraph.py``, ``Config.stage_prefetch_depth``): with a
+  sweep's device programs dispatched asynchronously, the driver packs
+  batch N+1 on the host while batch N's XLA step runs — JAX async
+  dispatch plays the role of the reference's 2-deep pinned double
+  buffering (``forward_emitter_gpu.hpp:254-300``).
+
+Buffer layout (shared with ``batch.py``'s cached unpack programs)::
+
+    [lane0 words | lane1 words | ... | ts words (2/row) | n]
+
+where a 4-byte lane contributes 1 word/row and an int64 lane 2 words/row
+(little-endian lo/hi interleaved — the TPU X64-rewrite implements no
+64-bit bitcast, so 64-bit lanes travel as arithmetic word pairs).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from collections import deque
+from typing import Optional, Sequence
+
+import numpy as np
+
+#: retained buffers per distinct buffer size (the recycling queue depth);
+#: 4 covers the driver loop's double buffering with margin for the keyed
+#: staging emitter's per-partition builders
+DEFAULT_DEPTH = int(os.environ.get("WF_TPU_STAGING_POOL_DEPTH", "4"))
+#: global cap on bytes RETAINED by the pool (buffers out on loan are the
+#: caller's); beyond it releases drop their buffer (graceful degradation
+#: to plain allocation, never a deadlock)
+DEFAULT_MAX_BYTES = int(os.environ.get("WF_TPU_STAGING_POOL_BYTES",
+                                       str(256 << 20)))
+
+
+def lane_words(dt) -> int:
+    """uint32 words per row for one packed lane."""
+    return 2 if np.dtype(dt).itemsize == 8 else 1
+
+
+def packable_dtype(dt) -> bool:
+    """Lanes that can ride the packed buffer: any 4-byte dtype via a
+    32-bit device bitcast, or int64/uint64 as arithmetic lo/hi pairs
+    (float64 has no cheap device decode — TPU has no native f64)."""
+    dt = np.dtype(dt)
+    return (dt.itemsize == 4) or dt in (np.dtype(np.int64),
+                                        np.dtype(np.uint64))
+
+
+class StagingPool:
+    """Size-keyed recycling pool of host ``uint32`` staging buffers.
+
+    Thread-safe (host worker-pool replicas may stage concurrently); the
+    lock guards only deque bookkeeping, never a copy or a device sync.
+    ``acquire`` never blocks on pool state — an empty slot allocates (a
+    counted miss) — and only ever waits on a recycled buffer's gate.
+    """
+
+    def __init__(self, depth: int = DEFAULT_DEPTH,
+                 max_bytes: int = DEFAULT_MAX_BYTES) -> None:
+        self.depth = max(1, depth)
+        self.max_bytes = max_bytes
+        self._slots: dict = {}          # nwords -> deque[(buf, gate)]
+        self._held_bytes = 0
+        self._lock = threading.Lock()
+        # counters (exposed via stats() and the PipeGraph monitoring dump)
+        self.hits = 0
+        self.misses = 0
+        self.releases = 0
+        self.drops = 0          # releases refused at capacity
+        self.gate_waits = 0     # acquires that had to sync on a gate
+
+    def acquire(self, nwords: int) -> np.ndarray:
+        """A ``uint32[nwords]`` host buffer: recycled when one is pooled
+        (waiting on its gate only if the device is still reading it),
+        freshly allocated otherwise.  Contents are UNDEFINED — callers
+        overwrite every word they transfer, zeroing only partial-batch
+        tails (``PackedBatchBuilder.finish``)."""
+        entry = None
+        with self._lock:
+            dq = self._slots.get(nwords)
+            if dq:
+                entry = dq.popleft()
+                self._held_bytes -= nwords * 4
+                self.hits += 1
+            else:
+                self.misses += 1
+        if entry is None:
+            return np.empty(nwords, np.uint32)
+        buf, gate = entry
+        if gate is not None:
+            ready = True
+            try:
+                ready = bool(gate.is_ready())
+            except Exception:
+                ready = False
+            if not ready:
+                self.gate_waits += 1
+                import jax
+                jax.block_until_ready(gate)
+        return buf
+
+    def release(self, buf: np.ndarray, gate=None) -> None:
+        """Return a buffer for reuse.  ``gate`` is a device array whose
+        readiness implies the device has finished reading ``buf`` (for a
+        packed batch: any output of the unpack program).  At capacity the
+        buffer is dropped instead of pooled — allocation pressure, never
+        blocking."""
+        with self._lock:
+            dq = self._slots.setdefault(buf.shape[0], deque())
+            if len(dq) >= self.depth \
+                    or self._held_bytes + buf.nbytes > self.max_bytes:
+                self.drops += 1
+                return
+            dq.append((buf, gate))
+            self._held_bytes += buf.nbytes
+            self.releases += 1
+
+    # -- telemetry -----------------------------------------------------------
+    def stats(self) -> dict:
+        """Counter snapshot for the monitoring stats layer
+        (``PipeGraph.stats()["Staging_pool"]``)."""
+        total = self.hits + self.misses
+        return {
+            "hits": self.hits,
+            "misses": self.misses,
+            "hit_rate": round(self.hits / total, 4) if total else 0.0,
+            "releases": self.releases,
+            "drops_at_capacity": self.drops,
+            "gate_waits": self.gate_waits,
+            "held_bytes": self._held_bytes,
+            "depth": self.depth,
+        }
+
+    def reset_stats(self) -> None:
+        self.hits = self.misses = self.releases = 0
+        self.drops = self.gate_waits = 0
+
+    def clear(self) -> None:
+        """Drop every pooled buffer (tests; backend teardown)."""
+        with self._lock:
+            self._slots.clear()
+            self._held_bytes = 0
+
+
+_default_pool: Optional[StagingPool] = None
+_default_lock = threading.Lock()
+
+
+def default_pool() -> StagingPool:
+    """Process-wide staging pool shared by every graph's staging emitters
+    (buffers are shape-keyed, so sharing across graphs only helps)."""
+    global _default_pool
+    if _default_pool is None:
+        with _default_lock:
+            if _default_pool is None:
+                _default_pool = StagingPool()
+    return _default_pool
+
+
+def set_default_pool(pool: Optional[StagingPool]) -> None:
+    """Swap the process-wide pool (tests; sizing experiments)."""
+    global _default_pool
+    _default_pool = pool
+
+
+class PackedBatchBuilder:
+    """Streams SoA rows into one pooled staging buffer.
+
+    ``dtypes`` lists the payload lanes in order (each packable, see
+    :func:`packable_dtype`); the int64 timestamp lane and the fill-count
+    word are implicit.  ``append`` writes each chunk slice at its final
+    packed offset — the zero-copy-beyond-one-memcpy streaming form of the
+    reference's pinned-buffer fill loop (``forward_emitter_gpu.hpp``).
+    """
+
+    __slots__ = ("capacity", "dtypes", "_words", "_offsets", "total",
+                 "buf", "n", "pool")
+
+    def __init__(self, dtypes: Sequence, capacity: int,
+                 pool: Optional[StagingPool] = None) -> None:
+        self.pool = pool or default_pool()
+        self.dtypes = tuple(np.dtype(d) for d in dtypes)
+        if not all(packable_dtype(d) for d in self.dtypes):
+            raise ValueError(f"unpackable lane dtypes {self.dtypes}")
+        self._words = [lane_words(d) for d in self.dtypes] + [2]  # + ts
+        self._offsets = []
+        off = 0
+        for w in self._words:
+            self._offsets.append(off)
+            off += w * capacity
+        self.total = off + 1            # + fill-count word
+        self.capacity = capacity
+        self.buf = self.pool.acquire(self.total)
+        self.n = 0
+
+    @property
+    def room(self) -> int:
+        return self.capacity - self.n
+
+    def append(self, lanes: Sequence[np.ndarray], tss: np.ndarray) -> None:
+        """Write ``len(tss)`` rows: ``lanes`` are 1-D payload columns in
+        ``dtypes`` order, ``tss`` the int64 timestamps.  Slices of
+        contiguous source columns view as uint32 without copying."""
+        m = len(tss)
+        for off, w, dt, lane in zip(self._offsets, self._words,
+                                    self.dtypes + (np.dtype(np.int64),),
+                                    list(lanes) + [tss]):
+            src = np.ascontiguousarray(lane, dt).view(np.uint32)
+            lo = off + w * self.n
+            self.buf[lo:lo + w * m] = src
+        self.n += m
+
+    def finish(self) -> np.ndarray:
+        """Zero each lane's unwritten tail (recycled buffers carry stale
+        words; the old per-batch ``np.zeros`` padded with zeros, and
+        downstream equality depends on it only for partial batches), stamp
+        the fill count, and hand the buffer over.  The caller owns it
+        until ``pool.release(buf, gate)``."""
+        if self.n < self.capacity:
+            for off, w in zip(self._offsets, self._words):
+                self.buf[off + w * self.n:off + w * self.capacity] = 0
+        self.buf[-1] = self.n
+        return self.buf
+
+    def abandon(self) -> None:
+        """Return an unused buffer to the pool (no gate: nothing read it)."""
+        self.pool.release(self.buf, None)

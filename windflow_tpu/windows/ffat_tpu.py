@@ -37,6 +37,7 @@ from typing import Callable, Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from windflow_tpu.basic import RoutingMode, WindFlowError, WinType
 from windflow_tpu.batch import WM_NONE, DeviceBatch
@@ -173,6 +174,18 @@ class FfatWindowsTPU(Operator):
         self._error_armed = False      # error policy live (post-transient)
         self._clean_checks = 0
         self._dirty_checks = 0
+        # data-ts extrema observed while the multi-channel watermark fold
+        # is still unresolved (frontier == WM_NONE): nothing fires in that
+        # phase, so every placed pane stays live and the ring must cover
+        # exactly this spread (see _regrow_for_span)
+        self._unres_lo = None
+        self._unres_hi = None
+        # True once a step ran with a RESOLVED frontier: before that
+        # nothing has fired, so the ring may be REBASED down to re-cover
+        # panes the capacity roll slid past while a sibling channel was
+        # still unheard (see _rebase_ring); after it, panes below the
+        # fired frontier are closed and only upward growth is safe
+        self._fold_stepped = False
         # Device state, created on first batch.  CB: one shared table (key
         # 0) — per-key clock lanes make it partition-safe.  TB: one state
         # PER REPLICA index — the ring clocks are shared across a state's
@@ -327,8 +340,19 @@ class FfatWindowsTPU(Operator):
         sidx = self._sidx(ridx)
         self._ensure(batch, sidx)
         if self.is_tb:
-            if self._auto_np and self.NP < self._np_ceil:
+            if self._auto_np:
+                # no NP < ceiling gate: at the ceiling growth no-ops in
+                # _grow_ring, but extrema tracking and the pre-fold
+                # _rebase_ring (a pure position shift, no growth) must
+                # still run or a lagging channel's below-base panes are
+                # unrecoverable on ceiling-size rings
                 self._regrow_for_span(batch)
+            if batch.frontier != WM_NONE:
+                # this step fires: pre-fold rebasing closes (see
+                # _rebase_ring) — read BEFORE the flag below by
+                # _regrow_for_span, so the first resolved batch itself
+                # still rebases ahead of its own placement
+                self._fold_stepped = True
             # Fire on the batch's staging-time frontier, not the min-folded
             # propagated stamp: the step places every tuple of the batch
             # before firing, so the newest frontier is safe here and saves
@@ -453,6 +477,44 @@ class FfatWindowsTPU(Operator):
             # the 'error' policy only counts evictions past this point
             self._evicted_base = self._tb_counter("n_evicted")
 
+    def _rebase_ring(self, lo_pane: int, hi_pane: int) -> None:
+        """Move the ring window DOWN to ``lo_pane`` so panes the capacity
+        roll slid past while the watermark fold was unresolved become
+        placeable again (a lagging sibling channel's first data lives
+        BELOW everything placed so far; growth alone pads the ring's top
+        and cannot help).  Safe exactly while nothing has fired
+        (``_fold_stepped`` False): the slid-past columns are empty — the
+        roll found nothing to evict — and ``win_next``/``max_seen``/
+        ``horizon`` are absolute pane stamps unaffected by where the ring
+        window sits.  Shifting wraps top columns to the bottom; they are
+        invalid by the ``hi_pane < new_base + NP`` clamp, and invalid
+        cells' values are masked at merge (kernels).  Costs one host read
+        of ``base`` per state — growth cadence only, never steady-state."""
+        if self._fold_stepped:
+            return
+        for sidx, st in self._states.items():
+            # rare host sync (see docstring); on a mesh "base" is a
+            # [key-shards] lane whose per-shard clocks advance in
+            # lockstep from the same gathered batches — read shard 0,
+            # the elementwise shift below keeps every shard consistent
+            base = int(np.asarray(st["base"]).reshape(-1)[0])
+            new_base = max(lo_pane, hi_pane - self.NP + 1)
+            delta = base - new_base
+            if delta <= 0:
+                continue
+            out = dict(st)
+            out["cells"] = jax.tree.map(
+                lambda a: jnp.roll(a, delta, axis=1), st["cells"])
+            out["cell_valid"] = jnp.roll(st["cell_valid"], delta, axis=1)
+            out["base"] = st["base"] - delta
+            if self.mesh is not None:
+                from windflow_tpu.parallel.mesh import state_sharding
+                sh = state_sharding(self.mesh)
+                for k in ("cells", "cell_valid"):
+                    out[k] = jax.tree.map(
+                        lambda a: jax.device_put(a, sh), out[k])
+            self._states[sidx] = out
+
     def _regrow_for_span(self, batch) -> None:
         """PREEMPTIVE ring growth from the host-known watermark lag (r5;
         found by the 5000-tuple fuzz soak: two seeds evicted a handful of
@@ -481,29 +543,80 @@ class FfatWindowsTPU(Operator):
         contract, previously estimated from the FIRST batch only) now
         updates from every staged batch.
 
-        Until every input channel has been heard from, the folded
-        frontier is ``WM_NONE`` and NOTHING bounds how old a sibling
-        channel's first data may be — the only safe ring is the ceiling
-        itself (which is precisely the user-accepted memory bound), so
-        data arriving before the fold resolves never forces the base
-        past an unheard sibling's range."""
+        While the multi-channel watermark fold is unresolved
+        (``frontier == WM_NONE``) NOTHING fires, so every placed pane
+        stays live and the ring must cover exactly the OBSERVED data
+        spread — it grows (geometrically) to that, not to the memory
+        ceiling (ADVICE r5: the former eager ceiling commit permanently
+        charged tiny-span streams a ceiling-size ring plus a step
+        recompile before their first resolved frontier).  The extrema
+        seen during the unresolved phase keep bounding ``hi`` after the
+        fold resolves, until the watermark passes them — the pre-fold
+        panes are still unfired and must not be rolled out.
+
+        Multi-host meshes skip the span regrow entirely: each process
+        observes different local extrema, and divergent per-process
+        growth decisions would desynchronize the sharded ring shapes
+        (ADVICE r5 medium; staging also stops attaching process-local
+        extrema, batch.py _stage_soa).  The eviction-cadence regrow is
+        SPMD-consistent and remains the growth path there."""
         if batch.ts_max is None:
+            return
+        if jax.process_count() > 1:
             return
         wm = batch.frontier             # newest safe stamp: firing uses it
         if wm == WM_NONE:
-            self._grow_ring(self._np_ceil)
+            lo = batch.ts_min if batch.ts_min is not None else batch.ts_max
+            prev_lo = self._unres_lo
+            if self._unres_lo is None or lo < self._unres_lo:
+                self._unres_lo = lo
+            if self._unres_hi is None or batch.ts_max > self._unres_hi:
+                self._unres_hi = batch.ts_max
+            needed = int(self._unres_hi - self._unres_lo) // self.P \
+                + self.R + 2
+            if needed > self.NP:
+                self._grow_ring(min(self._np_ceil,
+                                    max(needed, self.NP * 2)))
+            if prev_lo is not None and lo < prev_lo:
+                # a lagging channel opened panes BELOW everything placed:
+                # leading batches may already have rolled base past them
+                self._rebase_ring(self._unres_lo // self.P,
+                                  self._unres_hi // self.P)
             return
         lo = self._wm_pane(wm)          # oldest pane still open for data
         hi = batch.ts_max // self.P     # newest pane this batch touches
+        if self._unres_hi is not None:
+            if lo > self._unres_hi // self.P:
+                # watermark passed the pre-fold data: stop tracking it
+                self._unres_lo = self._unres_hi = None
+            else:
+                hi = max(hi, self._unres_hi // self.P)
+        rebase_lo = None
+        if not self._fold_stepped:
+            # FIRST resolved batch (nothing fired yet): its own rows and
+            # the pre-fold extrema may all reach below the rolled base —
+            # the ring must re-cover down to the oldest of them before
+            # this step places (the step fires AFTER placement, so panes
+            # under the watermark still emit their windows normally)
+            cand = [lo]
+            if batch.ts_min is not None:
+                cand.append(batch.ts_min // self.P)
+            if self._unres_lo is not None:
+                cand.append(self._unres_lo // self.P)
+            rebase_lo = min(cand)
         needed = int(hi - lo) + self.R + 2
         if batch.ts_min is not None:
             spread = (batch.ts_max - batch.ts_min) // self.P + 1
             needed = max(needed, int(spread) + self.R + 2)
+        if rebase_lo is not None:
+            needed = max(needed, int(hi - rebase_lo) + self.R + 2)
         if needed > self.NP:
             # at least double: each growth recompiles the step, so
             # convergence under a widening lag must be geometric
             self._grow_ring(min(self._np_ceil,
                                 max(needed, self.NP * 2)))
+        if rebase_lo is not None:
+            self._rebase_ring(rebase_lo, hi)
 
     def _check_overflow(self):
         # operator-wide: counters and the excused-eviction base
