@@ -1038,6 +1038,16 @@ def main() -> None:
     except Exception as e:
         result["e2e_device_source_error"] = f"{type(e).__name__}: {e}"[:400]
 
+    # latency section (guarded by tools/check_bench_keys.py): the p50/p99
+    # distribution numbers the flight-recorder observability layer makes
+    # first-class — recorded into bench_history.json so round-over-round
+    # comparisons read tails, not means (docs/OBSERVABILITY.md)
+    latency = {"batch_p99_ms": result.get("p99_batch_latency_ms")}
+    if result.get("e2e"):
+        latency["e2e_p50_ms"] = result["e2e"].get("p50_window_latency_ms")
+        latency["e2e_p99_ms"] = result["e2e"].get("p99_window_latency_ms")
+    result["latency"] = latency
+
     now = time.time()
     hist = load_history()
     runs = hist.setdefault(platform, [])
@@ -1079,6 +1089,7 @@ def main() -> None:
                  "sum_decl_value": result.get("sum_decl_value"),
                  "sum_decl_methodology": result.get("sum_decl_methodology"),
                  "p99_batch_latency_ms": result["p99_batch_latency_ms"],
+                 "latency": result.get("latency"),
                  "e2e": result.get("e2e"),
                  "e2e_device_source": result.get("e2e_device_source"),
                  "ysb": result.get("ysb"),

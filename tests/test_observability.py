@@ -1,0 +1,369 @@
+"""Flight-recorder observability contracts (docs/OBSERVABILITY.md):
+log-bucket histogram percentile math on its edge cases, trace-export JSON
+schema validity, watermark-lag gauge behavior under punctuation-only flow,
+transfer byte counters, real termination state, the recorder-disabled
+zero-event guarantee, and the recorder's overhead budget."""
+
+import dataclasses
+import json
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+import windflow_tpu as wf
+from windflow_tpu.basic import default_config
+from windflow_tpu.monitoring.recorder import (STAGE_NAMES, FlightRecorder,
+                                              LatencyHistogram, ReplicaRing,
+                                              chrome_trace_from_events)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ---------------------------------------------------------------------------
+# LatencyHistogram: percentile math edge cases
+# ---------------------------------------------------------------------------
+
+def test_histogram_empty():
+    h = LatencyHistogram()
+    assert h.percentile(0.5) == 0.0
+    q = h.quantiles()
+    assert q["count"] == 0
+    assert q["p50"] == q["p95"] == q["p99"] == 0.0 and q["max"] == 0.0
+
+
+def test_histogram_single_sample_is_exact():
+    h = LatencyHistogram()
+    h.add(137.0)
+    # clamping to the observed [min, max] makes one sample report itself,
+    # not its log bucket's midpoint
+    for p in (0.0, 0.5, 0.95, 0.99, 1.0):
+        assert h.percentile(p) == 137.0
+    assert h.quantiles()["count"] == 1
+    assert h.mean() == 137.0
+
+
+def test_histogram_bucket_boundaries():
+    h = LatencyHistogram()
+    # 2^k sits exactly on a bucket edge: [2^(k-1), 2^k) vs [2^k, 2^(k+1))
+    for v in (0, 1, 2, 255, 256, 257):
+        h.add(v)
+    assert h.count == 6
+    assert h.min == 0 and h.max == 257
+    # percentiles are monotone in p and clamped to the sample range
+    last = -1.0
+    for p in (0.1, 0.5, 0.9, 0.99):
+        v = h.percentile(p)
+        assert 0 <= v <= 257
+        assert v >= last
+        last = v
+
+
+def test_histogram_percentiles_bracket_distribution():
+    h = LatencyHistogram()
+    for i in range(1000):
+        h.add(float(i))
+    p50, p95, p99 = (h.percentile(p) for p in (0.50, 0.95, 0.99))
+    assert p50 <= p95 <= p99 <= h.max
+    # log buckets guarantee only factor-of-2 resolution: the true p50 of
+    # 0..999 is ~500, inside the [256, 1024) bucket span
+    assert 256 <= p50 < 1024
+    assert p99 > 500
+
+
+def test_histogram_merge():
+    a, b = LatencyHistogram(), LatencyHistogram()
+    a.add(10)
+    b.add(1000)
+    a.merge(b)
+    assert a.count == 2
+    assert a.min == 10 and a.max == 1000
+    assert a.percentile(0.01) >= 10 and a.percentile(0.99) <= 1000
+
+
+def test_ring_wraps_without_allocation():
+    r = ReplicaRing("op", 0, 16)
+    for i in range(40):
+        r.record(i, 0, 1000 + i)
+    ev = r.events()
+    assert len(ev) == 16                       # ring capacity retained
+    assert ev[0]["trace"] == 24 and ev[-1]["trace"] == 39  # newest kept
+    assert r.n == 40
+
+
+def test_recorder_sampling_rate():
+    fr = FlightRecorder(sample_every=4)
+    picks = [fr.maybe_trace() for _ in range(40)]
+    assert sum(t is not None for t in picks) == 10
+    ids = [t[0] for t in picks if t is not None]
+    assert ids == sorted(ids) and len(set(ids)) == len(ids)
+
+
+# ---------------------------------------------------------------------------
+# pipeline helpers
+# ---------------------------------------------------------------------------
+
+def _tpu_graph(cfg=None, n=4000, cap=512, name="obs_app"):
+    src = (wf.Source_Builder(
+        lambda: iter({"key": i % 8, "v": float(i)} for i in range(n)))
+        .withName("src").withOutputBatchSize(cap).build())
+    m = (wf.MapTPU_Builder(lambda t: {"key": t["key"], "v": t["v"] * 2.0})
+         .withName("mtpu").build())
+    seen = []
+    snk = (wf.Sink_Builder(lambda t, ctx=None: seen.append(t))
+           .withName("snk").build())
+    g = wf.PipeGraph(name, wf.ExecutionMode.DEFAULT, config=cfg)
+    g.add_source(src).add(m).add_sink(snk)
+    return g, seen
+
+
+def _traced_cfg(**kw):
+    kw.setdefault("flight_recorder", True)
+    kw.setdefault("trace_sample_every", 2)
+    return dataclasses.replace(default_config, **kw)
+
+
+# ---------------------------------------------------------------------------
+# stats schema: percentiles, byte counters, termination state
+# ---------------------------------------------------------------------------
+
+def test_stats_latency_and_byte_totals():
+    g, _ = _tpu_graph(cfg=_traced_cfg())
+    g.run()
+    st = g.stats()
+    # h2d wired from the staging plane, d2h from the TPU->host boundary:
+    # both totals are real (nonzero) on a staged run
+    assert st["Bytes_H2D_total"] > 0
+    assert st["Bytes_D2H_total"] > 0
+    lat = st["Latency"]
+    for op_name in ("src", "mtpu", "snk"):
+        q = lat["service_usec_per_operator"][op_name]
+        assert set(q) >= {"count", "p50", "p95", "p99"}
+    assert lat["end_to_end_usec"]["count"] > 0
+    assert 0 < lat["end_to_end_usec"]["p50"] \
+        <= lat["end_to_end_usec"]["p99"]
+    # per-replica JSON carries the histogram quantiles too
+    mtpu = next(o for o in st["Operators"]
+                if o["Operator_name"] == "mtpu")
+    rj = mtpu["Replicas"][0]
+    assert rj["Service_latency_usec"]["count"] > 0
+    assert rj["Bytes_H2D"] == 0          # staging credits the UPSTREAM rep
+    src_rep = next(o for o in st["Operators"]
+                   if o["Operator_name"] == "src")["Replicas"][0]
+    assert src_rep["Bytes_H2D"] > 0
+
+
+def test_is_terminated_reports_actual_state():
+    g, _ = _tpu_graph(cfg=_traced_cfg())
+    g.start()
+    st = g.stats()
+    reps = [r for o in st["Operators"] for r in o["Replicas"]]
+    assert all(r["Is_terminated"] is False for r in reps)
+    g.wait_end()
+    st = g.stats()
+    reps = [r for o in st["Operators"] for r in o["Replicas"]]
+    assert all(r["Is_terminated"] is True for r in reps)
+
+
+def test_flight_recorder_summary_and_spans():
+    g, _ = _tpu_graph(cfg=_traced_cfg())
+    g.run()
+    fr = g.stats()["Flight_recorder"]
+    assert fr["enabled"] is True
+    assert fr["traces_started"] > 0
+    assert fr["events_recorded"] >= 3 * fr["traces_started"]  # >=3 stages
+    stages = {e["stage"] for e in g._recorder.events()}
+    assert {"staged", "dispatched", "collected", "sunk"} <= stages
+    assert stages <= set(STAGE_NAMES)
+
+
+def test_device_done_sync_sampling():
+    g, _ = _tpu_graph(cfg=_traced_cfg(trace_sample_every=1,
+                                      trace_device_sync_every=2),
+                      n=4000, cap=256)
+    g.run()
+    ev = g._recorder.events()
+    done = [e for e in ev if e["stage"] == "device_done"]
+    dispatched = [e for e in ev if e["stage"] == "dispatched"]
+    assert dispatched, "TPU op recorded no dispatches"
+    # every 2nd traced batch syncs: roughly half the dispatches, never all
+    assert 0 < len(done) <= len(dispatched)
+
+
+# ---------------------------------------------------------------------------
+# trace export
+# ---------------------------------------------------------------------------
+
+def test_dump_trace_chrome_schema(tmp_path):
+    g, _ = _tpu_graph(cfg=_traced_cfg())
+    g.run()
+    path = g.dump_trace(str(tmp_path / "app_trace.json"))
+    with open(path) as f:
+        trace = json.load(f)
+    evs = trace["traceEvents"]
+    assert isinstance(evs, list) and evs
+    phases = {e["ph"] for e in evs}
+    assert "i" in phases and "b" in phases and "e" in phases
+    for e in evs:
+        assert "name" in e and "ph" in e and "pid" in e
+        if e["ph"] != "M":
+            assert isinstance(e["ts"], (int, float))
+    # async span begin/end pairs balance per (id, name)
+    opens = {}
+    for e in evs:
+        if e["ph"] == "b":
+            opens[(e["id"], e["name"])] = opens.get(
+                (e["id"], e["name"]), 0) + 1
+        elif e["ph"] == "e":
+            opens[(e["id"], e["name"])] = opens.get(
+                (e["id"], e["name"]), 0) - 1
+    assert all(v == 0 for v in opens.values())
+    # raw events dumped alongside for offline re-export
+    assert (tmp_path / "app_events.json").exists()
+
+
+def test_trace_export_tool_roundtrip(tmp_path):
+    g, _ = _tpu_graph(cfg=_traced_cfg())
+    g.run()
+    g.dump_trace(str(tmp_path / "app_trace.json"))
+    out = tmp_path / "re_trace.json"
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "trace_export.py"),
+         str(tmp_path / "app_events.json"), "-o", str(out)],
+        capture_output=True, text=True)
+    assert r.returncode == 0, r.stderr
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "trace_export.py"),
+         "--check", str(out)], capture_output=True, text=True)
+    assert r.returncode == 0, r.stderr
+    assert "OK" in r.stdout
+
+
+def test_chrome_trace_from_events_empty():
+    t = chrome_trace_from_events([])
+    assert t["traceEvents"] == []
+
+
+# ---------------------------------------------------------------------------
+# gauges
+# ---------------------------------------------------------------------------
+
+def test_watermark_lag_gauge_monotone_under_punctuation_only_flow():
+    """An idle-but-live INGRESS source advances its watermark by cadence
+    punctuations alone; the frontier gauge must be monotone and the lag
+    gauge bounded by the punctuation interval (plus scheduling slack)."""
+    def idle_gen():
+        for _ in range(4000):
+            yield None              # live source, no data
+
+    cfg = dataclasses.replace(default_config,
+                              punctuation_interval_usec=5_000)
+    src = wf.Source_Builder(idle_gen).withName("idle").build()
+    snk = wf.Sink_Builder(lambda t, ctx=None: None).withName("snk").build()
+    g = wf.PipeGraph("punct_only", wf.ExecutionMode.DEFAULT, config=cfg)
+    g.add_source(src).add_sink(snk)
+    g.start()
+    fronts = []
+    deadline = time.monotonic() + 10.0
+    while not g.is_done() and time.monotonic() < deadline:
+        g.step()
+        gau = g.gauges()
+        snk_g = gau["operators"]["snk"]
+        if snk_g["watermark_frontier_usec"] is not None:
+            fronts.append(snk_g["watermark_frontier_usec"])
+            assert snk_g["watermark_lag_usec"] >= 0
+        time.sleep(0.001)
+    g.wait_end()
+    assert len(fronts) > 3, "punctuations never advanced the sink frontier"
+    assert fronts == sorted(fronts), "watermark frontier went backwards"
+    assert fronts[-1] > fronts[0], "frontier never advanced while idle"
+
+
+def test_gauges_shape_and_rolling_throughput():
+    g, _ = _tpu_graph(cfg=_traced_cfg())
+    g.start()
+    while not g.is_done():
+        g.step()
+        g.sample_gauges()
+    g.wait_end()
+    gau = g.stats()["Gauges"]
+    assert set(gau) >= {"operators", "staging_pool_held_bytes",
+                        "throughput_1s_tps", "throughput_10s_tps"}
+    for name in ("src", "mtpu", "snk"):
+        og = gau["operators"][name]
+        assert og["queue_depth"] >= 0
+    assert gau["throughput_1s_tps"] >= 0.0
+
+
+def test_gauges_in_dashboard_report_payload():
+    """The monitoring thread ships stats() as NEW_REPORT; the payload must
+    carry the new observability sections (wire parity is covered by
+    test_monitoring.py's stub dashboard — here we check the payload)."""
+    g, _ = _tpu_graph(cfg=_traced_cfg())
+    g.run()
+    payload = json.loads(json.dumps(g.stats()))   # must be JSON-clean
+    assert "Gauges" in payload and "Latency" in payload
+    assert "Flight_recorder" in payload
+    assert payload["Flight_recorder"]["enabled"] is True
+
+
+# ---------------------------------------------------------------------------
+# recorder off: zero events, no trace lanes, no measurable hot-path cost
+# ---------------------------------------------------------------------------
+
+def test_recorder_disabled_emits_zero_events():
+    cfg = dataclasses.replace(default_config, flight_recorder=False)
+    seen_traces = []
+    src = (wf.Source_Builder(
+        lambda: iter({"key": i % 8, "v": float(i)} for i in range(3000)))
+        .withName("src").withOutputBatchSize(256).build())
+    m = (wf.MapTPU_Builder(lambda t: {"key": t["key"], "v": t["v"] + 1})
+         .withName("mtpu").build())
+    snk = (wf.Sink_Builder(lambda t, ctx=None: None)
+           .withName("snk").build())
+    g = wf.PipeGraph("off_app", wf.ExecutionMode.DEFAULT, config=cfg)
+    g.add_source(src).add(m).add_sink(snk)
+    g.start()
+    # hook the sink inbox to observe trace lanes on in-flight batches
+    snk_rep = snk.replicas[0]
+    orig = snk_rep.receive
+
+    def spy(ch, msg):
+        seen_traces.append(getattr(msg, "trace", None))
+        orig(ch, msg)
+    snk_rep.receive = spy
+    g.wait_end()
+    assert g._recorder is None
+    assert all(rep.ring is None for rep in g._all_replicas)
+    assert all(t is None for t in seen_traces)
+    st = g.stats()
+    assert st["Flight_recorder"] == {"enabled": False}
+    assert st["Latency"]["end_to_end_usec"]["count"] == 0
+    # byte counters stay real even with the recorder off
+    assert st["Bytes_H2D_total"] > 0
+    with pytest.raises(wf.WindFlowError):
+        g.dump_trace()
+
+
+def test_recorder_overhead_within_budget():
+    """Overhead smoke (documented budget <2% at default 1-in-64 sampling):
+    recorder on vs off over the same pipeline.  CPU CI timing is noisy, so
+    the assertion leaves generous slack — it exists to catch a recorder
+    that lands on the per-TUPLE path (orders of magnitude, not percent)."""
+    def run_once(enabled):
+        cfg = dataclasses.replace(default_config,
+                                  flight_recorder=enabled,
+                                  trace_sample_every=64)
+        g, _ = _tpu_graph(cfg=cfg, n=40000, cap=1024,
+                          name=f"ovh_{enabled}")
+        t0 = time.perf_counter()
+        g.run()
+        return time.perf_counter() - t0
+
+    run_once(True)                      # warm compile caches for shapes
+    on = min(run_once(True) for _ in range(3))
+    off = min(run_once(False) for _ in range(3))
+    assert on < off * 1.5 + 0.25, \
+        f"recorder-on run {on:.3f}s vs off {off:.3f}s exceeds budget slack"

@@ -21,6 +21,12 @@ device-source leg errored, which decomposition needs —
 ``e2e_device_source.decomposition.staging_share_of_staged_run``.
 Without arguments, ``bench.py``'s source must still contain the code
 paths that emit both keys.
+
+Since the flight-recorder round the bench also publishes a ``latency``
+section (``batch_p99_ms`` always; ``e2e_p50_ms``/``e2e_p99_ms`` when the
+staged e2e leg ran) recorded into ``bench_history.json`` — the tail
+numbers the observability layer steers by (docs/OBSERVABILITY.md).  This
+check guards those keys the same way.
 """
 
 import json
@@ -29,6 +35,7 @@ import sys
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 KEYS = ("ratio_vs_kernel", "staging_share_of_staged_run")
+LATENCY_KEYS = ("batch_p99_ms", "e2e_p50_ms", "e2e_p99_ms")
 
 
 def fail(msg: str) -> None:
@@ -43,8 +50,13 @@ def check_source() -> None:
     if missing:
         fail(f"bench.py no longer emits {missing} — the e2e "
              "decomposition contract (docs/PERF.md) is broken")
+    missing = [k for k in LATENCY_KEYS if f'"{k}"' not in src] \
+        + ([] if '"latency"' in src else ['latency'])
+    if missing:
+        fail(f"bench.py no longer emits the latency section keys "
+             f"{missing} (docs/OBSERVABILITY.md contract)")
     print("check_bench_keys: OK (bench.py source emits "
-          + ", ".join(KEYS) + ")")
+          + ", ".join(KEYS + ("latency",)) + ")")
 
 
 def last_json_object(path: str):
@@ -92,9 +104,19 @@ def check_output(path: str) -> None:
     else:
         fail("bench output has neither 'e2e_device_source' nor "
              "'e2e_device_source_error'")
+    lat = result.get("latency")
+    if not isinstance(lat, dict):
+        fail("'latency' section missing from bench output")
+    if "batch_p99_ms" not in lat:
+        fail("'latency.batch_p99_ms' missing from bench output")
+    if isinstance(result.get("e2e"), dict):
+        missing = [k for k in ("e2e_p50_ms", "e2e_p99_ms") if k not in lat]
+        if missing:
+            fail(f"latency section missing {missing} although the staged "
+                 "e2e leg ran")
     print("check_bench_keys: OK (ratio_vs_kernel="
           f"{e2e['ratio_vs_kernel']}, staging_share_of_staged_run="
-          f"{share})")
+          f"{share}, latency={lat})")
 
 
 if __name__ == "__main__":

@@ -1,0 +1,108 @@
+#!/usr/bin/env python
+"""Export flight-recorder span events as Chrome-trace JSON.
+
+``PipeGraph.dump_trace()`` writes two files under ``Config.log_dir``: the
+Chrome trace itself (``{app}_trace.json``) and the raw span events
+(``{app}_events.json``).  This tool re-renders the raw events offline —
+useful when a long run dumped only the (small) raw events, or when
+re-exporting after a recorder format change — and validates that a trace
+file is loadable Chrome-trace JSON.
+
+Usage::
+
+    python tools/trace_export.py APP_events.json            # -> APP_trace.json
+    python tools/trace_export.py APP_events.json -o OUT.json
+    python tools/trace_export.py --check APP_trace.json     # schema check
+
+Open the result in Perfetto (https://ui.perfetto.dev) or
+``chrome://tracing``; timestamps are wall-clock microseconds, the same
+domain as a ``jax.profiler`` capture taken during the run, so the two load
+side by side (docs/OBSERVABILITY.md).
+"""
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from windflow_tpu.monitoring.recorder import (STAGE_NAMES,  # noqa: E402
+                                              chrome_trace_from_events)
+
+_EVENT_KEYS = {"op", "replica", "trace", "stage", "t_usec"}
+_PHASES = {"M", "i", "b", "e", "X"}
+
+
+def fail(msg: str) -> None:
+    print(f"trace_export: FAIL: {msg}", file=sys.stderr)
+    sys.exit(1)
+
+
+def check_chrome_trace(obj) -> int:
+    """Validate the subset of the Chrome-trace schema the recorder emits
+    (and that Perfetto requires): a ``traceEvents`` array whose entries
+    carry name/ph/pid, a numeric ``ts`` on every timed phase, and only
+    known phase codes.  Returns the event count."""
+    if not isinstance(obj, dict) or "traceEvents" not in obj:
+        fail("not a Chrome trace: no 'traceEvents' key")
+    evs = obj["traceEvents"]
+    if not isinstance(evs, list) or not evs:
+        fail("'traceEvents' empty or not a list")
+    for i, e in enumerate(evs):
+        for k in ("name", "ph", "pid"):
+            if k not in e:
+                fail(f"traceEvents[{i}] missing '{k}': {e}")
+        if e["ph"] not in _PHASES:
+            fail(f"traceEvents[{i}] unknown phase {e['ph']!r}")
+        if e["ph"] != "M" and not isinstance(e.get("ts"), (int, float)):
+            fail(f"traceEvents[{i}] ({e['ph']}) has no numeric 'ts'")
+    return len(evs)
+
+
+def load_events(path: str) -> list:
+    with open(path) as f:
+        events = json.load(f)
+    if not isinstance(events, list):
+        fail(f"{path}: expected a JSON array of span events")
+    for i, e in enumerate(events):
+        if not isinstance(e, dict) or not _EVENT_KEYS <= set(e):
+            fail(f"{path}[{i}]: not a span event (need {sorted(_EVENT_KEYS)})")
+        if e["stage"] not in STAGE_NAMES:
+            fail(f"{path}[{i}]: unknown stage {e['stage']!r}")
+    return events
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("input", help="raw events JSON (or a Chrome trace "
+                                  "with --check)")
+    ap.add_argument("-o", "--output", default=None,
+                    help="output path (default: derive <app>_trace.json)")
+    ap.add_argument("--check", action="store_true",
+                    help="validate an existing Chrome-trace file instead "
+                         "of exporting")
+    args = ap.parse_args()
+
+    if args.check:
+        with open(args.input) as f:
+            n = check_chrome_trace(json.load(f))
+        print(f"trace_export: OK ({args.input}: {n} events)")
+        return
+
+    events = load_events(args.input)
+    out = args.output
+    if out is None:
+        root, ext = os.path.splitext(args.input)
+        base = root[:-len("_events")] if root.endswith("_events") else root
+        out = f"{base}_trace{ext or '.json'}"
+    trace = chrome_trace_from_events(events)
+    check_chrome_trace(trace)
+    with open(out, "w") as f:
+        json.dump(trace, f)
+    print(f"trace_export: OK ({len(events)} span events -> {out}, "
+          f"{len(trace['traceEvents'])} trace events)")
+
+
+if __name__ == "__main__":
+    main()

@@ -131,6 +131,28 @@ class Config:
     dashboard_port: int = int(os.environ.get("WF_TPU_DASHBOARD_PORT", "20207"))
     # Enable runtime tracing (reference compile-time -DWF_TRACING_ENABLED).
     tracing_enabled: bool = bool(int(os.environ.get("WF_TPU_TRACING", "0")))
+    # Flight recorder (monitoring/recorder.py): per-batch span tracing into
+    # preallocated per-replica ring buffers + staged→sunk latency
+    # histograms.  Default ON at 1-in-`trace_sample_every` batch sampling
+    # with a documented <2% overhead budget (docs/OBSERVABILITY.md;
+    # tests/test_observability.py asserts it); switching it off removes
+    # every hook but a single `is not None` check per batch.
+    flight_recorder: bool = bool(int(os.environ.get(
+        "WF_TPU_FLIGHT_RECORDER", "1")))
+    # 1-in-N batch sampling rate for span traces (N=1 traces everything —
+    # tests/debugging only; the overhead budget assumes the default).
+    trace_sample_every: int = int(os.environ.get("WF_TPU_TRACE_SAMPLE",
+                                                 "64"))
+    # Total span events retained across all replica rings (split evenly;
+    # old events are overwritten when a ring wraps — no allocation).
+    trace_ring_events: int = int(os.environ.get("WF_TPU_TRACE_RING",
+                                                "65536"))
+    # Every M-th TRACED batch additionally records `device_done` by calling
+    # block_until_ready on the operator's output — a real device sync, so
+    # it runs 1 in (trace_sample_every * M) batches.  0 disables the sync
+    # (spans then end at `dispatched`/`collected`).
+    trace_device_sync_every: int = int(os.environ.get(
+        "WF_TPU_TRACE_DEVICE_SYNC", "8"))
     # Host-side worker threads draining host-operator replicas in parallel
     # (reference: one OS thread per replica via FastFlow,
     # basic_operator.hpp:54-235, so a CPU-operator pipeline scales across

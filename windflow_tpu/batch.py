@@ -81,6 +81,12 @@ class HostBatch:
     #: mutating (reference ``copyOnWrite`` + ``delete_counter`` multicast,
     #: ``map.hpp:57-215``, ``single_t.hpp:54``).
     shared: bool = False
+    #: flight-recorder trace lane: ``(trace_id, t_origin_usec)`` on the
+    #: 1-in-N sampled batch, None otherwise (monitoring/recorder.py).
+    #: Relayed by whole-batch paths; host per-tuple stages start fresh
+    #: traces at their emitter — lineage across a record explosion is not
+    #: a single batch's journey.
+    trace: tuple = None
 
     def __len__(self) -> int:
         return len(self.items)
@@ -123,12 +129,13 @@ class DeviceBatch:
     """
 
     __slots__ = ("payload", "ts", "valid", "keys", "watermark", "_frontier",
-                 "_size", "ts_max", "ts_min")
+                 "_size", "ts_max", "ts_min", "trace")
 
     def __init__(self, payload, ts, valid, keys=None, watermark: int = WM_NONE,
                  size: Optional[int] = None, frontier: Optional[int] = None,
                  ts_max: Optional[int] = None,
-                 ts_min: Optional[int] = None):
+                 ts_min: Optional[int] = None,
+                 trace: Optional[tuple] = None):
         self.payload = payload
         self.ts = ts
         self.valid = valid
@@ -138,6 +145,10 @@ class DeviceBatch:
         self._size = size
         self.ts_max = ts_max
         self.ts_min = ts_min
+        #: flight-recorder trace lane (monitoring/recorder.py):
+        #: ``(trace_id, t_origin_usec)`` when this batch is the 1-in-N
+        #: sampled one, else None.  Host metadata only — never transferred.
+        self.trace = trace
 
     @property
     def frontier(self) -> int:
@@ -165,6 +176,17 @@ class DeviceBatch:
 
     def __len__(self) -> int:
         return self.size
+
+
+def transfer_nbytes(batch: DeviceBatch) -> int:
+    """Whole-batch transfer size (payload + ts + valid lanes): the ONE
+    definition behind the H2D/D2H byte counters (stats_record.hpp parity)
+    wherever no packed staging buffer exists to measure exactly — shared
+    by the staging emitters, the TPU→host boundary, and columnar sinks so
+    the two directions can never drift apart."""
+    return sum(getattr(l, "nbytes", 0)
+               for l in jax.tree.leaves(batch.payload)) \
+        + getattr(batch.ts, "nbytes", 0) + getattr(batch.valid, "nbytes", 0)
 
 
 # ---------------------------------------------------------------------------
@@ -240,7 +262,7 @@ def stage_packed(buf: np.ndarray, treedef, dtypes, capacity: int, n: int,
                  watermark: int = WM_NONE, device=None,
                  frontier: Optional[int] = None,
                  ts_max: Optional[int] = None, ts_min: Optional[int] = None,
-                 pool=None) -> DeviceBatch:
+                 pool=None, trace: Optional[tuple] = None) -> DeviceBatch:
     """ONE host→device transfer of a packed staging buffer (built by
     ``staging.PackedBatchBuilder`` or the inline pack in ``_stage_soa``)
     into a DeviceBatch.  When ``pool`` is given, ``buf`` is recycled with
@@ -255,11 +277,12 @@ def stage_packed(buf: np.ndarray, treedef, dtypes, capacity: int, n: int,
         pool.release(buf, gate=valid)
     return DeviceBatch(jax.tree.unflatten(treedef, cols), ts, valid,
                        watermark=watermark, size=n, frontier=frontier,
-                       ts_max=ts_max, ts_min=ts_min)
+                       ts_max=ts_max, ts_min=ts_min, trace=trace)
 
 
 def _stage_soa(soa, tss, n: int, capacity: int, watermark: int,
-               device, frontier: Optional[int] = None) -> DeviceBatch:
+               device, frontier: Optional[int] = None,
+               trace: Optional[tuple] = None) -> DeviceBatch:
     """Shared staging tail: pad an SoA numpy pytree + timestamps to
     ``capacity``, build the validity mask, optionally pin to a device.
 
@@ -304,7 +327,7 @@ def _stage_soa(soa, tss, n: int, capacity: int, watermark: int,
         # ring's growth path on multi-host meshes.
         return DeviceBatch(payload, ts, valid, watermark=watermark,
                            size=None, frontier=frontier,
-                           ts_max=None, ts_min=None)
+                           ts_max=None, ts_min=None, trace=trace)
     packable = (
         device is None or isinstance(device, jax.Device)
     ) and all(l.ndim == 1 and _packable_dtype(l.dtype) for l in leaves)
@@ -321,7 +344,7 @@ def _stage_soa(soa, tss, n: int, capacity: int, watermark: int,
         return stage_packed(b.finish(), treedef, dtypes, capacity, n,
                             watermark=watermark, device=device,
                             frontier=frontier, ts_max=ts_max,
-                            ts_min=ts_min, pool=pool)
+                            ts_min=ts_min, pool=pool, trace=trace)
     payload = jax.tree.map(
         lambda a: jnp.asarray(_pad_leading(np.ascontiguousarray(a),
                                            capacity)), soa)
@@ -333,11 +356,13 @@ def _stage_soa(soa, tss, n: int, capacity: int, watermark: int,
         ts = jax.device_put(ts, device)
         valid = jax.device_put(valid, device)
     return DeviceBatch(payload, ts, valid, watermark=watermark, size=n,
-                       frontier=frontier, ts_max=ts_max, ts_min=ts_min)
+                       frontier=frontier, ts_max=ts_max, ts_min=ts_min,
+                       trace=trace)
 
 
 def host_to_device(batch: HostBatch, capacity: Optional[int] = None,
-                   device=None, frontier: Optional[int] = None) -> DeviceBatch:
+                   device=None, frontier: Optional[int] = None,
+                   trace: Optional[tuple] = None) -> DeviceBatch:
     """Stage a HostBatch into device buffers, padding to ``capacity``."""
     n = len(batch)
     if n == 0:
@@ -346,12 +371,13 @@ def host_to_device(batch: HostBatch, capacity: Optional[int] = None,
     if n > cap:
         raise ValueError(f"batch of {n} items exceeds capacity {cap}")
     return _stage_soa(_stack_records(batch.items), batch.tss, n, cap,
-                      batch.watermark, device, frontier)
+                      batch.watermark, device, frontier,
+                      trace=trace if trace is not None else batch.trace)
 
 
 def columns_to_device(cols, tss, capacity: int, watermark: int = WM_NONE,
-                      device=None, frontier: Optional[int] = None
-                      ) -> DeviceBatch:
+                      device=None, frontier: Optional[int] = None,
+                      trace: Optional[tuple] = None) -> DeviceBatch:
     """Stage columnar (SoA numpy) data directly into a DeviceBatch — the
     zero-per-tuple-Python path used by bulk sources (windflow_tpu/io) and the
     columnar staging emitter.  ``cols`` is a dict of [n]-leading numpy
@@ -362,7 +388,7 @@ def columns_to_device(cols, tss, capacity: int, watermark: int = WM_NONE,
     if n > capacity:
         raise ValueError(f"column batch of {n} exceeds capacity {capacity}")
     return _stage_soa(dict(cols), tss, n, capacity, watermark, device,
-                      frontier)
+                      frontier, trace=trace)
 
 
 #: cached pack programs for single-transfer egress, keyed by the payload's
@@ -539,7 +565,7 @@ def device_to_host(batch: DeviceBatch) -> HostBatch:
             items = [dict(zip(names, vals))
                      for vals in zip(*(cols[n].tolist() for n in names))]
             return HostBatch(items=items, tss=tss,
-                             watermark=batch.watermark)
+                             watermark=batch.watermark, trace=batch.trace)
     treedef = jax.tree.structure(batch.payload)
     cols = [_np_local(leaf)[idx] for leaf in jax.tree.leaves(batch.payload)]
     items = [jax.tree.unflatten(treedef, [c[i] for c in cols])
@@ -547,4 +573,5 @@ def device_to_host(batch: DeviceBatch) -> HostBatch:
     # Unwrap 0-d numpy scalars for ergonomic host-side records.
     items = [jax.tree.map(lambda v: v.item() if np.ndim(v) == 0 else v, it)
              for it in items]
-    return HostBatch(items=items, tss=tss, watermark=batch.watermark)
+    return HostBatch(items=items, tss=tss, watermark=batch.watermark,
+                     trace=batch.trace)

@@ -30,10 +30,13 @@ from __future__ import annotations
 import dataclasses
 import json
 import os
+import time
+from collections import deque
 from typing import List, Optional
 
 from windflow_tpu.basic import (Config, ExecutionMode, TimePolicy,
-                                WindFlowError, default_config)
+                                WindFlowError, current_time_usecs,
+                                default_config)
 from windflow_tpu.graph.multipipe import MultiPipe
 from windflow_tpu.ops.base import Operator
 from windflow_tpu.ops.source import Source, SourceReplica
@@ -83,6 +86,13 @@ class PipeGraph:
         self._max_inflight_device_seen = 0
         # staging-plane lookahead telemetry (Config.stage_prefetch_depth)
         self._prefetch_ticks = 0
+        # flight recorder (monitoring/recorder.py): built in _build when
+        # Config.flight_recorder is on; None means every hook is inert
+        self._recorder = None
+        # rolling-throughput gauge samples: (wall_s, tuples_sunk_total),
+        # appended by sample_gauges() (the monitoring thread calls it once
+        # per second; stats() also samples so headless runs get gauges)
+        self._thr_samples = deque(maxlen=64)
         # host worker pool (Config.host_worker_threads): replicas drained
         # off the driver thread, and the driver-thread remainder
         self._pool = None
@@ -245,6 +255,26 @@ class PipeGraph:
             if rep.num_channels > 0:
                 rep.collector = create_collector(self.mode, rep.num_channels)
                 self._collectors.append(rep.collector)
+
+        # 3b. observability: the flight recorder's per-replica rings and
+        # the emitters' stats/ring/flight binding (monitoring/recorder.py).
+        # Transfer byte counters are bound even with the recorder off —
+        # they are plain integer adds, and the H2D/D2H totals must be real
+        # on every run (stats_record.hpp:152-160 parity).
+        cfg = self.config
+        if cfg.flight_recorder and cfg.trace_sample_every > 0:
+            from windflow_tpu.monitoring.recorder import FlightRecorder
+            self._recorder = FlightRecorder(
+                sample_every=cfg.trace_sample_every,
+                ring_events=cfg.trace_ring_events,
+                device_sync_every=cfg.trace_device_sync_every,
+                expected_rings=len(self._all_replicas))
+            for rep in self._all_replicas:
+                rep.ring = self._recorder.ring_for(rep.op.name, rep.index)
+        for rep in self._all_replicas:
+            if rep.emitter is not None:
+                rep.emitter.bind_observability(rep.stats, rep.ring,
+                                               self._recorder)
 
         # sanity: every non-sink replica must have an emitter
         for op in self._operators:
@@ -447,10 +477,113 @@ class PipeGraph:
         (``pipegraph.hpp:786-789``)."""
         return self.get_num_dropped_tuples()
 
+    # -- observability: gauges, latency, span traces -------------------------
+    def sample_gauges(self) -> None:
+        """Append one rolling-throughput sample.  The monitoring thread
+        calls this once per second; ``stats()`` also samples so headless
+        runs (no dashboard) still get the rolling gauges."""
+        total = sum(r.stats.inputs_received for op in self._operators
+                    if op.is_terminal for r in op.replicas)
+        self._thr_samples.append((time.monotonic(), total))
+
+    def _rolling_rate(self, window_s: float) -> float:
+        """Sunk-tuples/sec over (at least) the trailing ``window_s``: the
+        delta between the newest sample and the youngest sample that is at
+        least ``window_s`` old (the whole retained window when none is)."""
+        if len(self._thr_samples) < 2:
+            return 0.0
+        now_t, now_v = self._thr_samples[-1]
+        base = None
+        for t, v in self._thr_samples:
+            if now_t - t >= window_s:
+                base = (t, v)      # samples are time-ordered: keep the
+            else:                  # youngest one old enough
+                break
+        if base is None:
+            base = self._thr_samples[0]
+        dt = now_t - base[0]
+        return (now_v - base[1]) / dt if dt > 0 else 0.0
+
+    def gauges(self) -> dict:
+        """Point-in-time gauges (sampled by the monitoring thread into the
+        NEW_REPORT payload): per-operator watermark lag (wall clock minus
+        frontier — meaningful under INGRESS/wall-based EVENT time) and
+        inbox queue depth, staging-pool occupancy, rolling throughput."""
+        from windflow_tpu.batch import WM_MAX, WM_NONE
+        from windflow_tpu import staging
+        now = current_time_usecs()
+        per_op = {}
+        for op in self._operators:
+            depth = 0
+            fronts = []
+            for rep in op.replicas:
+                depth += len(rep.inbox)
+                wm = rep.current_wm
+                if wm != WM_NONE and wm < WM_MAX:
+                    fronts.append(wm)
+            # operator frontier = MIN over replicas (watermark semantics):
+            # the lag gauge must surface a stalled replica, not hide it
+            # behind its most-advanced sibling
+            front = min(fronts) if fronts else None
+            per_op[op.name] = {
+                "queue_depth": depth,
+                "watermark_frontier_usec": front,
+                "watermark_lag_usec":
+                    max(0, now - front) if front is not None else None,
+            }
+        pool = staging.default_pool()
+        return {
+            "sampled_at_usec": now,
+            "operators": per_op,
+            "staging_pool_held_bytes": pool.stats()["held_bytes"],
+            "throughput_1s_tps": round(self._rolling_rate(1.0), 1),
+            "throughput_10s_tps": round(self._rolling_rate(10.0), 1),
+        }
+
+    def _latency_section(self) -> dict:
+        """Per-operator service-span and end-to-end staged→sunk latency
+        distributions (p50/p95/p99), merged across replicas from the
+        log-bucketed histograms (monitoring/recorder.py)."""
+        from windflow_tpu.monitoring.recorder import LatencyHistogram
+        per_op = {}
+        e2e = LatencyHistogram()
+        for op in self._operators:
+            h = LatencyHistogram()
+            for rep in op.replicas:
+                h.merge(rep.stats.service_hist)
+                e2e.merge(rep.stats.e2e_hist)   # nonzero only at sinks
+            per_op[op.name] = h.quantiles()
+        return {"service_usec_per_operator": per_op,
+                "end_to_end_usec": e2e.quantiles()}
+
+    def dump_trace(self, path: Optional[str] = None) -> str:
+        """Write the flight recorder's span events as Chrome-trace JSON
+        (``{name}_trace.json`` under ``Config.log_dir``), loadable in
+        ``chrome://tracing`` / Perfetto next to a ``jax.profiler`` capture;
+        the raw events ride along as ``{name}_events.json`` for offline
+        re-export through ``tools/trace_export.py``.  Returns the trace
+        path."""
+        if self._recorder is None:
+            raise WindFlowError(
+                "flight recorder is off (Config.flight_recorder) or the "
+                "graph has not been built — nothing to dump")
+        from windflow_tpu.monitoring.recorder import write_chrome_trace
+        d = self.config.log_dir
+        os.makedirs(d, exist_ok=True)
+        path = path or os.path.join(d, f"{self.name}_trace.json")
+        events = self._recorder.events()
+        write_chrome_trace(events, path)
+        root, ext = os.path.splitext(path)
+        base = root[:-len("_trace")] if root.endswith("_trace") else root
+        with open(f"{base}_events{ext or '.json'}", "w") as f:
+            json.dump(events, f)
+        return path
+
     def stats(self) -> dict:
         """Stats report; schema follows the reference's dashboard JSON
         (``pipegraph.hpp:468-526``).  The fixed reference fields describe the
         FastFlow runtime; here they describe the host driver equivalents."""
+        self.sample_gauges()
         return {
             "PipeGraph_name": self.name,
             "Mode": self.mode.value,
@@ -477,6 +610,20 @@ class PipeGraph:
             "Thread_number": 1 + self.config.host_worker_threads
                                + (1 if self._monitor is not None else 0),
             "rss_size_kb": _rss_kb(),
+            # graph-level transfer totals (reference per-replica H2D/D2H
+            # counters, stats_record.hpp:152-160, summed here)
+            "Bytes_H2D_total": sum(r.stats.h2d_bytes
+                                   for r in self._all_replicas),
+            "Bytes_D2H_total": sum(r.stats.d2h_bytes
+                                   for r in self._all_replicas),
+            # flight-recorder layer (monitoring/recorder.py): latency
+            # distributions + point-in-time gauges, shipped to the
+            # dashboard in every NEW_REPORT
+            "Flight_recorder": (self._recorder.summary()
+                                if self._recorder is not None
+                                else {"enabled": False}),
+            "Latency": self._latency_section(),
+            "Gauges": self.gauges(),
             "Operators": [op.dump_stats() for op in self._operators],
         }
 

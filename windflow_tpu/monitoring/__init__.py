@@ -1,8 +1,13 @@
-"""Observability: per-replica stats records, the dashboard monitoring
-thread + TCP protocol, and graph diagram generation (reference
-``stats_record.hpp``, ``monitoring.hpp``, graphviz hooks — SURVEY.md §2.8/§5.1)."""
+"""Observability: per-replica stats records, the flight recorder (span
+tracing + latency histograms), the dashboard monitoring thread + TCP
+protocol, and graph diagram generation (reference ``stats_record.hpp``,
+``monitoring.hpp``, graphviz hooks — SURVEY.md §2.8/§5.1; recorder design
+in docs/OBSERVABILITY.md)."""
 
 from windflow_tpu.monitoring.dashboard import DashboardServer
 from windflow_tpu.monitoring.diagram import to_dot, to_svg
 from windflow_tpu.monitoring.monitor import MonitoringThread
+from windflow_tpu.monitoring.recorder import (FlightRecorder,
+                                              LatencyHistogram,
+                                              chrome_trace_from_events)
 from windflow_tpu.monitoring.stats import StatsRecord

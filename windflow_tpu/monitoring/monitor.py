@@ -11,6 +11,13 @@ length-prefixed TCP protocol (default ``localhost:20207``):
   terminated JSON stats; ack ``[status, _]``.
 * ``END_APP`` (type 2): same framing as NEW_REPORT, sent once at the end.
 
+Each NEW_REPORT payload is ``PipeGraph.stats()``, which since the flight
+recorder (monitoring/recorder.py) also carries the ``Latency`` histograms
+(per-operator + end-to-end p50/p95/p99) and the ``Gauges`` section —
+watermark lag, queue depths, staging-pool occupancy, rolling 1s/10s
+throughput — sampled by THIS thread's once-per-second cadence (the
+rolling-rate window is fed by ``PipeGraph.sample_gauges``).
+
 Like the reference (``monitoring.hpp:197-200``), the thread switches itself
 off quietly if the dashboard is unreachable or any send fails — monitoring
 must never take the pipeline down.
@@ -89,6 +96,9 @@ class MonitoringThread:
             while not self._stop.wait(0.05) and not self.graph.is_done():
                 now = time.monotonic()
                 if now - last >= SAMPLE_INTERVAL_SEC:
+                    # stats() inside _send_report samples the throughput
+                    # gauges, so this 1 Hz cadence is what feeds the
+                    # rolling 1s/10s windows
                     self._send_report(TYPE_NEW_REPORT)
                     last = now
             self._send_report(TYPE_END_APP)

@@ -1,0 +1,306 @@
+"""Flight recorder: per-batch span tracing + log-bucketed latency histograms.
+
+The reference's observability layer records per-replica counters and service
+times (``stats_record.hpp``) — enough when every operator blocks on its own
+work.  Here dispatch is asynchronous (JAX enqueues; the chip crunches later),
+so a per-operator running average no longer says where a batch spends its
+time.  This module adds the missing batch-granular layer:
+
+* **Span events.**  A sampled batch carries a trace id (``HostBatch.trace``
+  / ``DeviceBatch.trace`` = ``(trace_id, t_origin_usec)``) from its birth at
+  a source emitter or the staging plane all the way to the sink.  Hooks on
+  the hot path append ``(trace_id, stage, t)`` records — stages ``staged``,
+  ``emitted``, ``dispatched``, ``device_done``, ``collected``, ``sunk`` —
+  into a preallocated per-replica **ring buffer** (:class:`ReplicaRing`):
+  no allocation, no locking, no syscalls on the hot path; old events are
+  overwritten when the ring wraps.
+
+* **Sampling.**  One batch in ``Config.trace_sample_every`` is traced
+  (default 64); untraced batches carry ``trace=None`` and every hook
+  degenerates to one attribute check.  ``device_done`` additionally calls
+  ``block_until_ready`` — a real sync — so it fires only every
+  ``Config.trace_device_sync_every``-th *traced* batch (default 8, i.e.
+  1 in 512 batches at the default sampling): the recorder's documented
+  overhead budget is **< 2%** on the bench chain
+  (tests/test_observability.py asserts it with generous slack).
+
+* **Histograms.**  :class:`LatencyHistogram` buckets values by log2 —
+  64 buckets cover 1 usec..centuries in constant memory — and reports
+  ``p50/p95/p99`` by geometric interpolation inside the bucket, clamped to
+  the exact observed ``[min, max]`` (so a single sample reports itself, not
+  its bucket's midpoint).  Per-operator service-time histograms live in
+  ``StatsRecord``; the staged→sunk end-to-end histogram is fed by sinks
+  from the trace lane.
+
+* **Export.**  :func:`chrome_trace_from_events` renders the merged rings as
+  Chrome-trace JSON (the ``traceEvents`` array format) loadable in
+  ``chrome://tracing`` or Perfetto next to a ``jax.profiler`` capture;
+  ``PipeGraph.dump_trace()`` and ``tools/trace_export.py`` wrap it.
+
+When ``Config.flight_recorder`` is off, ``PipeGraph`` binds no recorder at
+all: replicas hold ``ring = None`` and emitters ``flight = None``, so the
+hot path's only residue is a ``is not None`` check per batch.
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+from typing import List, Optional
+
+import numpy as np
+
+from windflow_tpu.basic import current_time_usecs
+
+#: span stage codes (ring buffers store the code, exports the name)
+STAGED = 0      # host rows fixed into a device batch (staging plane)
+EMITTED = 1     # host batch formed/shipped by an emitter
+DISPATCHED = 2  # device program enqueued for the batch (async!)
+DEVICE_DONE = 3  # device results ready (block_until_ready, sampled subset)
+COLLECTED = 4   # batch pulled from a replica inbox for processing
+SUNK = 5        # batch reached a terminal (sink) replica
+
+STAGE_NAMES = ("staged", "emitted", "dispatched", "device_done",
+               "collected", "sunk")
+
+
+class LatencyHistogram:
+    """Log2-bucketed latency histogram (microseconds).
+
+    ``add`` costs one ``int.bit_length`` and one array increment — no
+    allocation, safe on the hot path.  Percentiles interpolate
+    geometrically within the winning bucket and clamp to the observed
+    ``[min, max]``, which makes the empty / single-sample / boundary edge
+    cases exact (tests/test_observability.py pins them).
+    """
+
+    __slots__ = ("counts", "count", "total", "min", "max")
+
+    NBUCKETS = 64
+
+    def __init__(self) -> None:
+        self.counts = np.zeros(self.NBUCKETS, np.int64)
+        self.count = 0
+        self.total = 0.0
+        self.min = float("inf")
+        self.max = 0.0
+
+    def add(self, usec: float) -> None:
+        if usec < 0:
+            usec = 0.0
+        # bucket b holds values in [2^(b-1), 2^b); 0 lands in bucket 0
+        b = int(usec).bit_length()
+        if b >= self.NBUCKETS:
+            b = self.NBUCKETS - 1
+        self.counts[b] += 1
+        self.count += 1
+        self.total += usec
+        if usec < self.min:
+            self.min = usec
+        if usec > self.max:
+            self.max = usec
+
+    def merge(self, other: "LatencyHistogram") -> "LatencyHistogram":
+        self.counts += other.counts
+        self.count += other.count
+        self.total += other.total
+        self.min = min(self.min, other.min)
+        self.max = max(self.max, other.max)
+        return self
+
+    def mean(self) -> float:
+        return self.total / self.count if self.count else 0.0
+
+    def percentile(self, p: float) -> float:
+        """Value at quantile ``p`` in [0, 1].  Empty histogram -> 0.0."""
+        if self.count == 0:
+            return 0.0
+        rank = p * self.count
+        cum = 0
+        for b in range(self.NBUCKETS):
+            c = int(self.counts[b])
+            if c == 0:
+                continue
+            if cum + c >= rank:
+                lo = 0.0 if b == 0 else float(1 << (b - 1))
+                hi = float(1 << b)
+                # geometric position of the rank inside this bucket
+                frac = (rank - cum) / c
+                val = lo + frac * (hi - lo)
+                return min(max(val, self.min), self.max)
+            cum += c
+        return self.max
+
+    def quantiles(self) -> dict:
+        """The ``p50/p95/p99`` dict shipped by ``StatsRecord.to_json`` and
+        ``PipeGraph.stats()`` (empty -> all zeros, count 0)."""
+        return {
+            "count": self.count,
+            "mean": round(self.mean(), 3),
+            "p50": round(self.percentile(0.50), 3),
+            "p95": round(self.percentile(0.95), 3),
+            "p99": round(self.percentile(0.99), 3),
+            "max": round(self.max, 3) if self.count else 0.0,
+        }
+
+
+class ReplicaRing:
+    """Preallocated span-event ring for one replica.
+
+    ``record`` writes three scalars into preallocated numpy arrays at a
+    wrapping index — no allocation, no lock.  The driver loop and the host
+    worker pool never share a ring (one per replica, and a replica's drain
+    is single-threaded by construction), so the lock-free write is safe;
+    the monitoring thread reads a possibly-torn snapshot, which is
+    acceptable for telemetry (same stance as the lock-free backpressure
+    reads, graph/pipegraph.py)."""
+
+    __slots__ = ("op_name", "replica_index", "size", "trace", "stage", "t",
+                 "n")
+
+    def __init__(self, op_name: str, replica_index: int, size: int) -> None:
+        self.op_name = op_name
+        self.replica_index = replica_index
+        self.size = max(8, int(size))
+        self.trace = np.zeros(self.size, np.int64)
+        self.stage = np.zeros(self.size, np.int8)
+        self.t = np.zeros(self.size, np.int64)
+        self.n = 0          # total events ever recorded (wraps the index)
+
+    def record(self, trace_id: int, stage: int, t_usec: int) -> None:
+        i = self.n % self.size
+        self.trace[i] = trace_id
+        self.stage[i] = stage
+        self.t[i] = t_usec
+        self.n += 1
+
+    def events(self) -> List[dict]:
+        """Retained events, oldest first (ring order reconstructed)."""
+        k = min(self.n, self.size)
+        start = self.n % self.size if self.n > self.size else 0
+        out = []
+        for j in range(k):
+            i = (start + j) % self.size
+            out.append({
+                "op": self.op_name,
+                "replica": self.replica_index,
+                "trace": int(self.trace[i]),
+                "stage": STAGE_NAMES[int(self.stage[i])],
+                "t_usec": int(self.t[i]),
+            })
+        return out
+
+
+class FlightRecorder:
+    """Graph-scoped recorder: owns the per-replica rings, the trace-id
+    counter and the sampling decision.  Built by ``PipeGraph._build`` when
+    ``Config.flight_recorder`` is on; replicas and emitters hold direct
+    references to their ring (no indirection on the hot path)."""
+
+    def __init__(self, sample_every: int = 64, ring_events: int = 65536,
+                 device_sync_every: int = 8,
+                 expected_rings: int = 1) -> None:
+        self.sample_every = max(1, int(sample_every))
+        self.ring_events = max(8, int(ring_events))
+        self.device_sync_every = max(0, int(device_sync_every))
+        self.expected_rings = max(1, int(expected_rings))
+        self.rings: List[ReplicaRing] = []
+        # itertools.count: __next__ is C-implemented and atomic under the
+        # GIL, so concurrently-staging host-pool replicas never mint the
+        # same trace id (a plain += would race and alias two batches'
+        # spans in the Chrome export)
+        self._seq = itertools.count(1)
+        self.traces_started = 0
+
+    # -- trace assignment (batch-birth sites: emitters, staging plane) ------
+    def maybe_trace(self) -> Optional[tuple]:
+        """Sampling decision for one new batch: ``(trace_id, t_origin)``
+        for the 1-in-N sampled batch, None otherwise.  One counter tick +
+        one modulo when not sampled."""
+        seq = next(self._seq)
+        if seq % self.sample_every:
+            return None
+        self.traces_started += 1
+        return (seq, current_time_usecs())
+
+    # -- ring registry -------------------------------------------------------
+    def ring_for(self, op_name: str, replica_index: int) -> ReplicaRing:
+        # ring_events splits evenly over the graph's replicas (the builder
+        # passes the replica count), so total retained events stay bounded
+        # regardless of graph width; the floor keeps narrow rings useful
+        per = max(64, self.ring_events // self.expected_rings)
+        ring = ReplicaRing(op_name, replica_index, per)
+        self.rings.append(ring)
+        return ring
+
+    # -- export --------------------------------------------------------------
+    def events(self) -> List[dict]:
+        ev = [e for ring in self.rings for e in ring.events()]
+        ev.sort(key=lambda e: e["t_usec"])
+        return ev
+
+    def summary(self) -> dict:
+        return {
+            "enabled": True,
+            "sample_every": self.sample_every,
+            "device_sync_every": self.device_sync_every,
+            "traces_started": self.traces_started,
+            "events_recorded": sum(r.n for r in self.rings),
+            "events_retained": sum(min(r.n, r.size) for r in self.rings),
+            "rings": len(self.rings),
+        }
+
+    def to_chrome_trace(self) -> dict:
+        return chrome_trace_from_events(self.events())
+
+
+def chrome_trace_from_events(events: List[dict]) -> dict:
+    """Render raw span events as Chrome-trace JSON (``traceEvents`` array
+    format), loadable in ``chrome://tracing`` and Perfetto.
+
+    Layout: one *thread* track per ``(op, replica)`` carrying instant
+    events for every record, plus one *async* span per traced batch and
+    stage pair (``b``/``e`` events keyed by the trace id) so a batch's
+    staged→...→sunk journey reads as a nested bar across the pipeline.
+    Timestamps are the recorder's wall-clock microseconds — the same
+    domain as a ``jax.profiler`` capture, so the two files line up when
+    opened side by side."""
+    trace_events: List[dict] = []
+    tids = {}
+    for e in events:
+        key = (e["op"], e["replica"])
+        if key not in tids:
+            tids[key] = len(tids)
+            trace_events.append({
+                "name": "thread_name", "ph": "M", "pid": 1,
+                "tid": tids[key],
+                "args": {"name": f"{e['op']}[{e['replica']}]"},
+            })
+    per_trace = {}
+    for e in events:
+        trace_events.append({
+            "name": e["stage"], "ph": "i", "s": "t",
+            "ts": e["t_usec"], "pid": 1, "tid": tids[(e["op"],
+                                                      e["replica"])],
+            "args": {"trace": e["trace"]},
+        })
+        per_trace.setdefault(e["trace"], []).append(e)
+    for trace_id, evs in per_trace.items():
+        evs.sort(key=lambda e: e["t_usec"])
+        for a, b in zip(evs, evs[1:]):
+            span = {"cat": "batch", "id": trace_id, "pid": 1, "tid": 0,
+                    "name": f"{a['stage']}→{b['stage']}"}
+            trace_events.append(dict(span, ph="b", ts=a["t_usec"]))
+            trace_events.append(dict(span, ph="e", ts=b["t_usec"]))
+    return {
+        "traceEvents": trace_events,
+        "displayTimeUnit": "ms",
+        "otherData": {"source": "windflow_tpu flight recorder",
+                      "clock": "wall_usec"},
+    }
+
+
+def write_chrome_trace(events: List[dict], path: str) -> str:
+    with open(path, "w") as f:
+        json.dump(chrome_trace_from_events(events), f)
+    return path

@@ -4,7 +4,12 @@ The reference records inputs/outputs/bytes and service times per replica, plus
 GPU kernel-launch counts and H2D/D2H byte counts for device replicas
 (``stats_record.hpp:80-82,152-160``).  The TPU equivalents map one-to-one:
 compiled-program dispatches for kernel launches, stage/fetch bytes for the
-transfer counters.
+transfer counters.  On top of the reference's lifetime counters and running
+average, every replica keeps **log-bucketed latency histograms**
+(monitoring/recorder.py): ``service_hist`` distributes the per-batch service
+spans the average used to flatten, and sinks fill ``e2e_hist`` with
+staged→sunk latencies from the flight recorder's trace lane — both surface
+as ``p50/p95/p99`` here and aggregated in ``PipeGraph.stats()``.
 """
 
 from __future__ import annotations
@@ -13,6 +18,7 @@ import dataclasses
 import time
 
 from windflow_tpu.basic import current_time_usecs
+from windflow_tpu.monitoring.recorder import LatencyHistogram
 
 
 @dataclasses.dataclass
@@ -29,17 +35,33 @@ class StatsRecord:
     service_time_usec: float = 0.0
     num_service_samples: int = 0
     # Device-side counters (reference GPU extensions of Stats_Record).
+    # h2d_bytes is credited by the staging plane through the owning
+    # replica's emitter (parallel/emitters.py bind_observability); d2h_bytes
+    # by the TPU→host boundary (DeviceToHostEmitter) and columnar sinks.
     device_programs_launched: int = 0
     h2d_bytes: int = 0
     d2h_bytes: int = 0
+    #: actual replica termination state (reference Stats_Record terminated
+    #: flag); set by Replica._terminate — live dashboard reports show the
+    #: truth instead of a hardcoded True
+    is_terminated: bool = False
+    #: per-batch service-span distribution (every start/end sample pair)
+    service_hist: LatencyHistogram = dataclasses.field(
+        default_factory=LatencyHistogram)
+    #: staged→sunk end-to-end latency; filled only at terminal (sink)
+    #: replicas from the flight recorder's trace lane
+    e2e_hist: LatencyHistogram = dataclasses.field(
+        default_factory=LatencyHistogram)
     _t0: float = 0.0
 
     def start_sample(self) -> None:
         self._t0 = time.perf_counter()
 
     def end_sample(self) -> None:
-        self.service_time_usec += (time.perf_counter() - self._t0) * 1e6
+        dur = (time.perf_counter() - self._t0) * 1e6
+        self.service_time_usec += dur
         self.num_service_samples += 1
+        self.service_hist.add(dur)
 
     def avg_service_time_usec(self) -> float:
         if self.num_service_samples == 0:
@@ -49,15 +71,19 @@ class StatsRecord:
     def to_json(self) -> dict:
         """Schema kept close to the reference's per-replica JSON dump
         (``basic_operator.hpp:292-317``) for dashboard compatibility."""
-        return {
+        out = {
             "Replica_id": self.replica_index,
             "Starting_time_usec": self.start_time_usec,
             "Inputs_received": self.inputs_received,
             "Inputs_ignored": self.inputs_ignored,
             "Outputs_sent": self.outputs_sent,
             "Service_time_usec": round(self.avg_service_time_usec(), 3),
-            "Is_terminated": True,
+            "Service_latency_usec": self.service_hist.quantiles(),
+            "Is_terminated": self.is_terminated,
             "Device_programs_launched": self.device_programs_launched,
             "Bytes_H2D": self.h2d_bytes,
             "Bytes_D2H": self.d2h_bytes,
         }
+        if self.e2e_hist.count:
+            out["End_to_end_latency_usec"] = self.e2e_hist.quantiles()
+        return out

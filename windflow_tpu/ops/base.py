@@ -23,9 +23,11 @@ from collections import deque
 from typing import Any, Callable, List, Optional
 
 from windflow_tpu.basic import (ExecutionMode, RoutingMode, TimePolicy,
-                                WindFlowError, default_config)
+                                WindFlowError, current_time_usecs,
+                                default_config)
 from windflow_tpu.batch import DeviceBatch, HostBatch, Punctuation, WM_MAX, WM_NONE
 from windflow_tpu.context import RuntimeContext
+from windflow_tpu.monitoring import recorder as flightrec
 from windflow_tpu.monitoring.stats import StatsRecord
 from windflow_tpu.parallel.collectors import Collector
 from windflow_tpu.parallel.emitters import Emitter
@@ -62,6 +64,11 @@ class Replica:
         self._hooked_wm = WM_NONE   # last watermark passed to on_watermark
         self.stats = StatsRecord(operator_name=op.name, replica_index=index,
                                  is_tpu=op.is_tpu)
+        #: flight-recorder span ring (monitoring/recorder.py), bound by
+        #: PipeGraph._build when Config.flight_recorder is on; None leaves
+        #: a single `is not None` check as the hot path's whole cost
+        self.ring = None
+        self._traced_seen = 0   # traced batches seen (device_done cadence)
         self.mode = ExecutionMode.DEFAULT
         self.time_policy = TimePolicy.INGRESS
         #: origin id of the input currently being processed (HostBatch.ids);
@@ -130,6 +137,7 @@ class Replica:
             from windflow_tpu.meta import adapt
             adapt(cf, 0)(self.context)
         self.done = True
+        self.stats.is_terminated = True
 
     def _dispatch(self, msg) -> None:
         if isinstance(msg, Punctuation):
@@ -138,6 +146,12 @@ class Replica:
             if self.emitter is not None:
                 self.emitter.propagate_punctuation(self.current_wm)
             return
+        # flight recorder (monitoring/recorder.py): span events for the
+        # 1-in-N traced batch; untraced batches cost one attribute check
+        tr = msg.trace if self.ring is not None else None
+        if tr is not None:
+            self.ring.record(tr[0], flightrec.COLLECTED,
+                             current_time_usecs())
         self.stats.start_sample()
         if isinstance(msg, DeviceBatch):
             self._advance_wm(msg.watermark)
@@ -161,6 +175,13 @@ class Replica:
             self.cur_tid = None
         self._maybe_hook_wm()
         self.stats.end_sample()
+        if tr is not None and self.op.is_terminal:
+            # staged→sunk span closes at sink RECEIPT (a deferred columnar
+            # sink converts later; its extra defer rides the bench's own
+            # delivery-latency measurement, not this histogram)
+            now = current_time_usecs()
+            self.ring.record(tr[0], flightrec.SUNK, now)
+            self.stats.e2e_hist.add(now - tr[1])
 
     def _maybe_hook_wm(self) -> None:
         # only invoke the (potentially O(open windows)) hook on a real advance

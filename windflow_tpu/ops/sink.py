@@ -44,9 +44,11 @@ class SinkReplica(Replica):
     def process_device_batch(self, batch):
         # A sink fed directly by a TPU operator pulls the batch to host
         # (reference GPU→CPU boundary): columnar sinks get the SoA lanes in
-        # one bulk copy, record sinks get per-tuple dicts.
-        self.stats.d2h_bytes += sum(
-            getattr(l, "nbytes", 0) for l in _leaves(batch.payload))
+        # one bulk copy, record sinks get per-tuple dicts.  The egress copy
+        # moves the timestamp and validity lanes too, so the D2H counter
+        # uses the shared whole-batch definition (batch.transfer_nbytes).
+        from windflow_tpu.batch import transfer_nbytes
+        self.stats.d2h_bytes += transfer_nbytes(batch)
         if self.op.columnar:
             # Deferred conversion: hold the last ``defer`` batches and pull
             # the oldest — JAX dispatch is asynchronous, so the device→host
@@ -79,11 +81,6 @@ class SinkReplica(Replica):
             self._deliver_columns(self._pending)
             self._pending = []
         self._fn(None, self.context)
-
-
-def _leaves(tree):
-    import jax
-    return jax.tree.leaves(tree)
 
 
 class Sink(Operator):

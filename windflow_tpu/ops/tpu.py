@@ -32,8 +32,9 @@ from typing import Any, Callable, Optional
 import jax
 import jax.numpy as jnp
 
-from windflow_tpu.basic import RoutingMode, WindFlowError
+from windflow_tpu.basic import RoutingMode, WindFlowError, current_time_usecs
 from windflow_tpu.batch import DeviceBatch
+from windflow_tpu.monitoring import recorder as flightrec
 from windflow_tpu.ops.base import Operator, Replica
 
 
@@ -52,7 +53,27 @@ class _TPUReplica(Replica):
     def process_device_batch(self, batch: DeviceBatch) -> None:
         out = self._op_step(batch)
         self.stats.device_programs_launched += 1
+        if self.ring is not None and batch.trace is not None:
+            # `dispatched` stamps the ASYNC enqueue (the host is already
+            # free); the device-side completion is only observable through
+            # a real sync, so `device_done` blocks on the output for every
+            # M-th traced batch (Config.trace_device_sync_every) — 1 in
+            # (sample_every * M) batches pays the sync.
+            self.ring.record(batch.trace[0], flightrec.DISPATCHED,
+                             current_time_usecs())
+            self._traced_seen += 1
+            sync_every = self.config.trace_device_sync_every
+            if out is not None and sync_every \
+                    and self._traced_seen % sync_every == 0:
+                jax.block_until_ready(out.valid)
+                self.ring.record(batch.trace[0], flightrec.DEVICE_DONE,
+                                 current_time_usecs())
         if out is not None:
+            if out.trace is None:
+                # operator steps build fresh DeviceBatches; the trace lane
+                # is host metadata, relayed here so one hook covers every
+                # device operator (map/filter/reduce/stateful/windows)
+                out.trace = batch.trace
             self.stats.outputs_sent += out.known_size or 0
             self.emitter.emit_device_batch(out)
 

@@ -36,7 +36,8 @@ from windflow_tpu import staging
 from windflow_tpu.basic import RoutingMode, WindFlowError
 from windflow_tpu.batch import (DeviceBatch, HostBatch, Punctuation, WM_NONE,
                                 columns_to_device, host_to_device,
-                                stage_packed)
+                                stage_packed, transfer_nbytes)
+from windflow_tpu.monitoring import recorder as flightrec
 
 
 _M64 = (1 << 64) - 1
@@ -114,6 +115,35 @@ class Emitter:
         # dests: list of (replica, channel_id on that replica).
         self.dests = list(dests)
         self.output_batch_size = output_batch_size
+        # observability plumbing, bound by PipeGraph._build through the
+        # OWNING replica (the replica whose output this emitter routes):
+        # `stats` is that replica's StatsRecord (transfer byte counters —
+        # the reference credits H2D/D2H to the transferring replica,
+        # stats_record.hpp:152-160), `ring` its flight-recorder span ring,
+        # `flight` the graph's FlightRecorder (trace-id assignment at
+        # batch-birth sites).  All None when observability is off.
+        self.stats = None
+        self.ring = None
+        self.flight = None
+
+    def bind_observability(self, stats, ring, flight) -> None:
+        """Attach the owning replica's stats/ring and the graph recorder;
+        compound emitters (keyed staging, device→host, splitting) override
+        to propagate the binding to their inner emitters."""
+        self.stats = stats
+        self.ring = ring
+        self.flight = flight
+
+    def _new_trace(self, stage: int = flightrec.EMITTED):
+        """Trace lane for a batch BORN at this emitter: the 1-in-N sampling
+        decision plus the birth span event; None (and no work beyond one
+        check) when the recorder is off or the batch is not sampled."""
+        if self.flight is None:
+            return None
+        tr = self.flight.maybe_trace()
+        if tr is not None and self.ring is not None:
+            self.ring.record(tr[0], stage, tr[1])
+        return tr
 
     # -- host-tuple interface ----------------------------------------------
     def emit(self, item: Any, ts: int, wm: int,
@@ -176,6 +206,11 @@ def _concat(arrs):
     return arrs[0] if len(arrs) == 1 else np.concatenate(arrs)
 
 
+# transfer byte accounting: the packed staging path counts its buffer's
+# exact nbytes; every other path uses the shared whole-batch definition
+_db_nbytes = transfer_nbytes
+
+
 class _OpenBatch:
     """Accumulates tuples for one destination.
 
@@ -234,7 +269,8 @@ class ForwardEmitter(Emitter):
         if ob.items:
             self._send(d, HostBatch(ob.items, ob.tss, ob.wm,
                                     shared=ob.shared,
-                                    ids=ob.ids_or_none()))
+                                    ids=ob.ids_or_none(),
+                                    trace=self._new_trace()))
             self._open[d] = _OpenBatch()
 
     def emit_host_batch(self, hb):
@@ -272,7 +308,8 @@ class KeyByEmitter(Emitter):
         if ob.items:
             self._send(d, HostBatch(ob.items, ob.tss, ob.wm,
                                     shared=ob.shared,
-                                    ids=ob.ids_or_none()))
+                                    ids=ob.ids_or_none(),
+                                    trace=self._new_trace()))
             self._open[d] = _OpenBatch()
 
     def flush(self, wm):
@@ -302,7 +339,8 @@ class BroadcastEmitter(Emitter):
             # single_t.hpp:54, map.hpp:57-215)
             b = HostBatch(self._ob.items, self._ob.tss, self._ob.wm,
                           shared=len(self.dests) > 1 or self._ob.shared,
-                          ids=self._ob.ids_or_none())
+                          ids=self._ob.ids_or_none(),
+                          trace=self._new_trace())
             for d in range(len(self.dests)):
                 self._send(d, b)
             self._ob = _OpenBatch()
@@ -486,11 +524,15 @@ class DeviceStageEmitter(Emitter):
             return
         wm = self._b_wm if self._b_wm != WM_NONE else fallback_wm
         self._advance_frontier(wm)
-        db = stage_packed(b.finish(), self._b_treedef, self._b_dtypes,
+        buf = b.finish()
+        if self.stats is not None:
+            # the packed path's H2D transfer is exactly this buffer
+            self.stats.h2d_bytes += buf.nbytes
+        db = stage_packed(buf, self._b_treedef, self._b_dtypes,
                           b.capacity, b.n, watermark=wm, device=None,
                           frontier=self._frontier,
                           ts_max=self._b_ts_max, ts_min=self._b_ts_min,
-                          pool=b.pool)
+                          pool=b.pool, trace=self._new_trace(flightrec.STAGED))
         d = self._next
         self._next = (self._next + 1) % len(self.dests)
         self._send(d, db)
@@ -532,7 +574,10 @@ class DeviceStageEmitter(Emitter):
     def _stage_columns(self, cols, tss, wm):
         db = columns_to_device(cols, tss, self.output_batch_size,
                                watermark=wm, device=self._stage_target,
-                               frontier=self._frontier)
+                               frontier=self._frontier,
+                               trace=self._new_trace(flightrec.STAGED))
+        if self.stats is not None:
+            self.stats.h2d_bytes += _db_nbytes(db)
         d = self._next
         self._next = (self._next + 1) % len(self.dests)
         self._send(d, db)
@@ -558,7 +603,10 @@ class DeviceStageEmitter(Emitter):
         hb = HostBatch(self._ob.items, self._ob.tss, self._ob.wm)
         db = host_to_device(hb, capacity=self.output_batch_size,
                             device=self._stage_target,
-                            frontier=self._frontier)
+                            frontier=self._frontier,
+                            trace=self._new_trace(flightrec.STAGED))
+        if self.stats is not None:
+            self.stats.h2d_bytes += _db_nbytes(db)
         d = self._next
         self._next = (self._next + 1) % len(self.dests)
         self._send(d, db)
@@ -584,6 +632,11 @@ class KeyedDeviceStageEmitter(Emitter):
         # one single-destination staging emitter per partition
         self._inner = [DeviceStageEmitter([d], output_batch_size, mesh=mesh)
                        for d in dests]
+
+    def bind_observability(self, stats, ring, flight):
+        super().bind_observability(stats, ring, flight)
+        for e in self._inner:
+            e.bind_observability(stats, ring, flight)
 
     @staticmethod
     def _key32(k) -> int:
@@ -701,7 +754,8 @@ class DeviceKeyByEmitter(Emitter):
                                       watermark=batch.watermark, size=None,
                                       frontier=batch.frontier,
                                       ts_max=batch.ts_max,
-                                      ts_min=batch.ts_min))
+                                      ts_min=batch.ts_min,
+                                      trace=batch.trace))
 
 
 class DevicePassEmitter(Emitter):
@@ -741,11 +795,17 @@ class DeviceToHostEmitter(Emitter):
         super().__init__(inner.dests, inner.output_batch_size)
         self.inner = inner
 
+    def bind_observability(self, stats, ring, flight):
+        super().bind_observability(stats, ring, flight)
+        self.inner.bind_observability(stats, ring, flight)
+
     def emit(self, item, ts, wm, shared=False, tid=None):
         self.inner.emit(item, ts, wm, shared, tid=tid)
 
     def emit_device_batch(self, batch: DeviceBatch):
         from windflow_tpu.batch import device_to_host
+        if self.stats is not None:
+            self.stats.d2h_bytes += _db_nbytes(batch)
         hb = device_to_host(batch)
         if hb.items:  # all-invalid batches (post-filter, empty split
             self.inner.emit_host_batch(hb)  # partitions) carry no data
@@ -812,6 +872,11 @@ class SplittingEmitter(Emitter):
         self.split_fn = split_fn
         self.branches = list(branch_emitters)
         self._device_splits = {}  # capacity -> compiled split or None
+
+    def bind_observability(self, stats, ring, flight):
+        super().bind_observability(stats, ring, flight)
+        for b in self.branches:
+            b.bind_observability(stats, ring, flight)
 
     def emit(self, item, ts, wm, shared=False, tid=None):
         self._route(item, ts, wm, self.split_fn(item), shared, tid)
@@ -882,7 +947,8 @@ class SplittingEmitter(Emitter):
                                 watermark=batch.watermark,
                                 size=None, frontier=batch.frontier,
                                 ts_max=batch.ts_max,
-                                ts_min=batch.ts_min))
+                                ts_min=batch.ts_min,
+                                trace=batch.trace))
             return
         # Fallback: host-side per-tuple split (Python or multicast split fn).
         # A device-only branch emitter cannot accept host items, but that is

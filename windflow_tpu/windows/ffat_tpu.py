@@ -84,6 +84,9 @@ class FfatTPUReplica(_TPUReplica):
             outs = self.op._flush()
         for out in outs:
             self.stats.device_programs_launched += 1
+            # flush outputs carry size=None; .size counts the fired mask
+            # (one device sync each — EOS only, never the hot path)
+            self.stats.outputs_sent += out.size
             self.emitter.emit_device_batch(out)
 
 
@@ -372,8 +375,11 @@ class FfatWindowsTPU(Operator):
         else:
             self._states[sidx], out, fired, out_ts = self._jit_step(
                 self._states[sidx], batch.payload, batch.ts, batch.valid)
+        # fired-window results inherit the input batch's flight-recorder
+        # trace: the staged→sunk span then covers the whole window path
         return DeviceBatch(out, out_ts, fired,
-                           watermark=batch.watermark, size=None)
+                           watermark=batch.watermark, size=None,
+                           trace=batch.trace)
 
     def _flush(self) -> list:
         """EOS flush of the CB shared state: fire remaining partial windows
