@@ -1048,6 +1048,39 @@ def main() -> None:
         latency["e2e_p99_ms"] = result["e2e"].get("p99_window_latency_ms")
     result["latency"] = latency
 
+    # preflight cost (windflow_tpu/analysis, guarded by
+    # tools/check_bench_keys.py): time PipeGraph.check() over the
+    # representative e2e pipeline shape so the static-analysis cost every
+    # start() now pays stays visible in the perf trajectory
+    try:
+        import numpy as np
+        import windflow_tpu as wf
+        pf_cfg = CONFIGS[platform]
+        m = wf.MapTPU_Builder(
+            lambda t: {"key": t["key"], "v0": t["v0"] * 1.5 + 1.0}).build()
+        f = wf.FilterTPU_Builder(lambda t: (t["key"] & 7) != 7).build()
+        w = (wf.Ffat_WindowsTPU_Builder(lambda t: t["v0"],
+                                        lambda a, b: a + b)
+             .withCBWindows(pf_cfg["win"], pf_cfg["slide"])
+             .withKeyBy(lambda t: t["key"])
+             .withMaxKeys(pf_cfg["keys"]).build())
+        src = (wf.Source_Builder(lambda: iter(()))
+               .withOutputBatchSize(pf_cfg["cap"])
+               .withRecordSpec({"key": np.int32(0),
+                                "v0": np.float32(0.0)}).build())
+        pg = wf.PipeGraph("bench_preflight")
+        pipe = pg.add_source(src)
+        pipe.add(m)
+        pipe.chain(f)
+        pipe.add(w).add_sink(wf.Sink_Builder(lambda r: None).build())
+        diags = pg.check()
+        result["preflight"] = {"check_ms": pg._preflight_ms,
+                               "diagnostics": len(diags)}
+    except Exception as e:  # lint: broad-except-ok (the bench must not
+        # die on an analysis regression; the missing key fails
+        # check_bench_keys loudly instead)
+        result["preflight_error"] = f"{type(e).__name__}: {e}"[:200]
+
     now = time.time()
     hist = load_history()
     runs = hist.setdefault(platform, [])
@@ -1090,6 +1123,7 @@ def main() -> None:
                  "sum_decl_methodology": result.get("sum_decl_methodology"),
                  "p99_batch_latency_ms": result["p99_batch_latency_ms"],
                  "latency": result.get("latency"),
+                 "preflight": result.get("preflight"),
                  "e2e": result.get("e2e"),
                  "e2e_device_source": result.get("e2e_device_source"),
                  "ysb": result.get("ysb"),

@@ -6,15 +6,22 @@
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
-# fast tier-1 gate first: the staging-plane contracts (pool reuse, fused
-# transfer round-trip, prefetch ordering) and the observability contracts
+# static analysis first: wf_lint is pure AST (~1s, no jax import) and
+# fails on any hot-path/except/lock-discipline violation before anything
+# expensive runs
+python tools/wf_lint.py
+
+# fast tier-1 gate: the staging-plane contracts (pool reuse, fused
+# transfer round-trip, prefetch ordering), the observability contracts
 # (histogram percentile math, trace-export schema, recorder-off zero-cost,
-# the <2% overhead budget) fail in seconds, before the full suite spends
-# minutes.  The full-suite run below repeats them — accepted: the gate's
-# job is fast failure, and keeping the full suite unfiltered means its
-# pass count stays comparable with the tier-1 gate's.
+# the <2% overhead budget), and the analysis contracts (preflight
+# diagnostic codes, wf_lint fixtures, debug-mode race detector) fail in
+# seconds, before the full suite spends minutes.  The full-suite run
+# below repeats them — accepted: the gate's job is fast failure, and
+# keeping the full suite unfiltered means its pass count stays comparable
+# with the tier-1 gate's.
 python -m pytest tests/test_staging.py tests/test_observability.py \
-    -q -m 'not slow'
+    tests/test_analysis.py -q -m 'not slow'
 python -m pytest tests/ -q
 python __graft_entry__.py 8
 BENCH_PLATFORM=cpu BENCH_E2E_TUPLES=131072 python bench.py | tee bench_ci_out.txt

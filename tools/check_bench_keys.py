@@ -27,6 +27,12 @@ section (``batch_p99_ms`` always; ``e2e_p50_ms``/``e2e_p99_ms`` when the
 staged e2e leg ran) recorded into ``bench_history.json`` — the tail
 numbers the observability layer steers by (docs/OBSERVABILITY.md).  This
 check guards those keys the same way.
+
+Since the static-analysis round the bench also publishes a ``preflight``
+section whose ``check_ms`` times ``PipeGraph.check()`` over the
+representative e2e pipeline — every ``start()`` now pays that cost, so
+it must stay visible in bench_history.json (docs/ANALYSIS.md).  Guarded
+here identically.
 """
 
 import json
@@ -55,8 +61,11 @@ def check_source() -> None:
     if missing:
         fail(f"bench.py no longer emits the latency section keys "
              f"{missing} (docs/OBSERVABILITY.md contract)")
+    if '"preflight"' not in src or '"check_ms"' not in src:
+        fail("bench.py no longer emits the preflight section "
+             "('preflight'/'check_ms' — docs/ANALYSIS.md contract)")
     print("check_bench_keys: OK (bench.py source emits "
-          + ", ".join(KEYS + ("latency",)) + ")")
+          + ", ".join(KEYS + ("latency", "preflight")) + ")")
 
 
 def last_json_object(path: str):
@@ -109,6 +118,16 @@ def check_output(path: str) -> None:
         fail("'latency' section missing from bench output")
     if "batch_p99_ms" not in lat:
         fail("'latency.batch_p99_ms' missing from bench output")
+    pf = result.get("preflight")
+    if isinstance(pf, dict):
+        if "check_ms" not in pf:
+            fail("'preflight.check_ms' missing from bench output")
+    else:
+        # unlike the device-source leg, preflight is device-free — it has
+        # no legitimate environmental failure mode, so an error IS the
+        # analysis regression this guard exists to catch
+        fail("bench preflight timing absent or errored "
+             f"(preflight_error={result.get('preflight_error')!r})")
     if isinstance(result.get("e2e"), dict):
         missing = [k for k in ("e2e_p50_ms", "e2e_p99_ms") if k not in lat]
         if missing:

@@ -1,0 +1,119 @@
+#!/usr/bin/env python
+"""wf_check: run the pre-flight graph checker against an application.
+
+CLI face of ``PipeGraph.check()`` (windflow_tpu/analysis/preflight.py),
+mirroring the ``tools/trace_export.py --check`` pattern: point it at the
+module that builds your PipeGraph and get the FULL diagnostic list —
+dtype/shape chain mismatches, window-spec errors, mesh divisibility,
+watermark-mode conflicts — with zero device work and without running the
+stream.
+
+Usage::
+
+    python tools/wf_check.py APP_MODULE            # e.g. myapp.pipeline
+    python tools/wf_check.py APP_MODULE:ATTR       # a PipeGraph attribute
+                                                   # or zero-arg factory
+    python tools/wf_check.py ... --json            # machine-readable
+    python tools/wf_check.py ... --strict          # exit 1 on warnings too
+
+Without ``:ATTR`` the module is scanned for PipeGraph instances and
+zero-arg callables named ``make_graph``/``build_graph``/``graph``.  Exit
+status: 0 clean, 1 error-severity diagnostics found (or any diagnostic
+under ``--strict``), 2 usage/load failures.
+"""
+
+from __future__ import annotations
+
+import argparse
+import importlib
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+#: module-level names probed (in order) when no :ATTR is given
+FACTORY_NAMES = ("make_graph", "build_graph", "graph", "make_app", "app")
+
+
+def fail(msg: str) -> None:
+    print(f"wf_check: FAIL: {msg}", file=sys.stderr)
+    sys.exit(2)
+
+
+def _as_graph(obj):
+    """A PipeGraph from an attribute: the instance itself, or the result
+    of calling a zero-arg factory."""
+    from windflow_tpu.graph.pipegraph import PipeGraph
+    if isinstance(obj, PipeGraph):
+        return obj
+    if callable(obj):
+        out = obj()
+        if isinstance(out, PipeGraph):
+            return out
+    return None
+
+
+def load_graph(spec: str):
+    """``module`` or ``module:attr`` -> a composed (unstarted) PipeGraph."""
+    mod_name, _, attr = spec.partition(":")
+    try:
+        mod = importlib.import_module(mod_name)
+    except ImportError as e:
+        fail(f"cannot import '{mod_name}': {e}")
+    if attr:
+        if not hasattr(mod, attr):
+            fail(f"module '{mod_name}' has no attribute '{attr}'")
+        g = _as_graph(getattr(mod, attr))
+        if g is None:
+            fail(f"'{mod_name}:{attr}' is neither a PipeGraph nor a "
+                 "zero-arg factory returning one")
+        return g
+    from windflow_tpu.graph.pipegraph import PipeGraph
+    for name in FACTORY_NAMES:
+        if hasattr(mod, name):
+            g = _as_graph(getattr(mod, name))
+            if g is not None:
+                return g
+    for name in dir(mod):
+        if isinstance(getattr(mod, name), PipeGraph):
+            return getattr(mod, name)
+    fail(f"no PipeGraph found in '{mod_name}' — expose one (or a factory "
+         f"named one of {FACTORY_NAMES}), or pass 'module:attr'")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("app", help="APP_MODULE or APP_MODULE:ATTR building "
+                                "the PipeGraph")
+    ap.add_argument("--json", action="store_true",
+                    help="emit diagnostics as a JSON array")
+    ap.add_argument("--strict", action="store_true",
+                    help="exit nonzero on warnings too")
+    args = ap.parse_args(argv)
+
+    g = load_graph(args.app)
+    diags = g.check()
+    errors = [d for d in diags if d.severity == "error"]
+    if args.json:
+        print(json.dumps({
+            "app": args.app,
+            "graph": g.name,
+            "check_ms": g._preflight_ms,
+            "errors": len(errors),
+            "warnings": len(diags) - len(errors),
+            "diagnostics": [d.to_json() for d in diags],
+        }, indent=2))
+    else:
+        for d in diags:
+            print(str(d))
+        print(f"wf_check: {g.name}: {len(errors)} error(s), "
+              f"{len(diags) - len(errors)} warning(s) "
+              f"in {g._preflight_ms} ms")
+    if errors or (args.strict and diags):
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

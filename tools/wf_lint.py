@@ -1,0 +1,340 @@
+#!/usr/bin/env python
+"""wf_lint: pure-AST lint enforcing windflow_tpu's hot-path invariants.
+
+PRs 1-2 established three hot-path rules by convention — no allocation,
+no host synchronization, no lock acquisition on the staging pack loop,
+the flight-recorder ring writes, and the emitter/collector service
+loops.  Functions carrying the ``@hot_path`` mark
+(``windflow_tpu/analysis/hotpath.py``) now get them enforced statically,
+alongside two repo-wide hygiene rules.  Pure ``ast`` — no imports of the
+package, no jax, so the whole tree lints in well under ten seconds.
+
+Rules (codes from ``windflow_tpu/analysis/diagnostics.py``):
+
+* **WF701** allocation in ``@hot_path``: ``np.zeros``-family /
+  ``np.concatenate``-family calls, ``list()``/``dict()``/``set()``
+  calls, list/set/dict comprehensions.  Small literals are allowed.
+* **WF702** host sync in ``@hot_path``: ``np.asarray``,
+  ``.block_until_ready()``, ``jax.device_get`` /
+  ``jax.block_until_ready``.
+* **WF703** lock acquisition in ``@hot_path``: ``with ...lock...`` or
+  ``.acquire()``.
+* **WF711** bare ``except:`` anywhere.
+* **WF712** broad ``except Exception``/``BaseException`` anywhere,
+  unless justified inline with a ``lint: broad-except-ok (reason)``
+  comment on (or within two lines below) the ``except`` line.
+* **WF721** declared-lock discipline: a class declaring
+  ``__lock_guards__ = {"_lock": ("attr", ...)}`` promises those
+  ``self`` attributes are only touched inside ``with self._lock``
+  (``__init__`` construction excepted).
+
+Usage::
+
+    python tools/wf_lint.py                  # lint windflow_tpu/
+    python tools/wf_lint.py PATH [PATH...]   # lint specific files/trees
+    python tools/wf_lint.py --json           # machine-readable findings
+
+Exit status 1 when any violation is found (the CI gate runs this).
+"""
+
+from __future__ import annotations
+
+import argparse
+import ast
+import json
+import os
+import sys
+from typing import List, Optional
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+DEFAULT_PATHS = [os.path.join(REPO, "windflow_tpu")]
+
+#: np/jnp allocator calls banned on hot paths
+ALLOC_ATTRS = {
+    "zeros", "ones", "empty", "full", "zeros_like", "ones_like",
+    "empty_like", "full_like", "concatenate", "stack", "vstack",
+    "hstack", "arange", "array", "tile",
+}
+NP_NAMES = {"np", "numpy", "jnp"}
+#: builder calls banned on hot paths (literals stay allowed)
+ALLOC_BUILDERS = {"list", "dict", "set"}
+#: host-sync calls banned on hot paths, any receiver
+SYNC_ANY = {"block_until_ready", "device_get"}
+#: host-sync calls banned on hot paths when called on np/numpy/jnp
+SYNC_NP = {"asarray"}
+#: substring that justifies a broad except within 2 lines of the handler
+ALLOW_BROAD = "lint: broad-except-ok"
+
+
+def _finding(path: str, node, code: str, message: str,
+             hint: Optional[str] = None) -> dict:
+    return {
+        "code": code,
+        "severity": "error",
+        "message": message,
+        "node": None,
+        "location": f"{os.path.relpath(path, REPO)}:{node.lineno}",
+        "hint": hint,
+    }
+
+
+def _is_hot_path_deco(dec) -> bool:
+    if isinstance(dec, ast.Name):
+        return dec.id == "hot_path"
+    if isinstance(dec, ast.Attribute):
+        return dec.attr == "hot_path"
+    return False
+
+
+def _receiver_name(func) -> Optional[str]:
+    """Name of the object a method is called on: ``np`` for
+    ``np.zeros(...)``, None for plain calls."""
+    if isinstance(func, ast.Attribute) and isinstance(func.value, ast.Name):
+        return func.value.id
+    return None
+
+
+def _lockish(expr) -> bool:
+    """A with-context expression that smells like a lock: any name/attr
+    containing "lock" (``self._lock``, ``self._inflight_lock``, a bare
+    ``lock``), or an explicit ``.acquire()``/``.lock()`` call."""
+    if isinstance(expr, ast.Call):
+        return _lockish(expr.func)
+    if isinstance(expr, ast.Attribute):
+        return "lock" in expr.attr.lower() or _lockish(expr.value)
+    if isinstance(expr, ast.Name):
+        return "lock" in expr.id.lower()
+    return False
+
+
+def _check_hot_function(path: str, fn, findings: List[dict]) -> None:
+    name = fn.name
+    for node in ast.walk(fn):
+        if isinstance(node, (ast.ListComp, ast.SetComp, ast.DictComp)):
+            findings.append(_finding(
+                path, node, "WF701",
+                f"@hot_path function '{name}' builds a comprehension",
+                hint="preallocate outside the hot path or stream through "
+                     "an existing buffer"))
+        elif isinstance(node, ast.Call):
+            recv = _receiver_name(node.func)
+            attr = node.func.attr if isinstance(node.func, ast.Attribute) \
+                else None
+            callee = node.func.id if isinstance(node.func, ast.Name) \
+                else None
+            if callee in ALLOC_BUILDERS:
+                findings.append(_finding(
+                    path, node, "WF701",
+                    f"@hot_path function '{name}' calls {callee}() — "
+                    "allocation on the hot path",
+                    hint="hoist the container to construction time"))
+            elif attr in ALLOC_ATTRS and recv in NP_NAMES:
+                findings.append(_finding(
+                    path, node, "WF701",
+                    f"@hot_path function '{name}' calls {recv}.{attr} — "
+                    "array allocation on the hot path",
+                    hint="recycle a pooled/preallocated buffer "
+                         "(windflow_tpu/staging.py)"))
+            elif attr in SYNC_ANY or (attr in SYNC_NP and recv in NP_NAMES):
+                findings.append(_finding(
+                    path, node, "WF702",
+                    f"@hot_path function '{name}' calls "
+                    f"{(recv + '.') if recv else '.'}{attr} — host "
+                    "synchronization stalls the dispatch loop",
+                    hint="keep device syncs on the sampled/diagnostic "
+                         "paths only"))
+            elif attr == "acquire" and _lockish(node.func.value):
+                findings.append(_finding(
+                    path, node, "WF703",
+                    f"@hot_path function '{name}' acquires a lock",
+                    hint="hot paths are single-consumer by construction; "
+                         "move locking to the cold setup path"))
+        elif isinstance(node, ast.With):
+            for item in node.items:
+                if _lockish(item.context_expr):
+                    findings.append(_finding(
+                        path, node, "WF703",
+                        f"@hot_path function '{name}' acquires a lock "
+                        "(with-block)",
+                        hint="hot paths are single-consumer by "
+                             "construction; move locking to the cold "
+                             "setup path"))
+
+
+def _check_excepts(path: str, tree, lines: List[str],
+                   findings: List[dict]) -> None:
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.ExceptHandler):
+            continue
+        if node.type is None:
+            findings.append(_finding(
+                path, node, "WF711",
+                "bare 'except:' swallows KeyboardInterrupt/SystemExit "
+                "and masks real faults",
+                hint="catch the specific exceptions the block can raise"))
+            continue
+        names = []
+        for t in ([node.type.elts] if isinstance(node.type, ast.Tuple)
+                  else [[node.type]]):
+            for e in t:
+                if isinstance(e, ast.Name):
+                    names.append(e.id)
+                elif isinstance(e, ast.Attribute):
+                    names.append(e.attr)
+        if not any(n in ("Exception", "BaseException") for n in names):
+            continue
+        # a broad handler whose LAST statement is a bare `raise` is a
+        # cleanup trampoline (release resources, re-raise the original) —
+        # it swallows nothing
+        if node.body and isinstance(node.body[-1], ast.Raise) \
+                and node.body[-1].exc is None:
+            continue
+        lo = node.lineno - 1
+        window = "\n".join(lines[lo:lo + 3])
+        if ALLOW_BROAD in window:
+            continue
+        findings.append(_finding(
+            path, node, "WF712",
+            "broad 'except Exception' without justification",
+            hint="catch specific exceptions, or justify inline with a "
+                 f"'{ALLOW_BROAD} (reason)' comment"))
+
+
+class _GuardVisitor(ast.NodeVisitor):
+    """Within one method of a __lock_guards__ class, track the with-stack
+    and flag guarded-attribute touches outside their declared lock."""
+
+    def __init__(self, path: str, cls_name: str, fn_name: str,
+                 guards: dict, findings: List[dict]) -> None:
+        self.path = path
+        self.cls = cls_name
+        self.fn = fn_name
+        self.guards = guards        # attr -> lock attr
+        self.findings = findings
+        self.held: List[str] = []   # lock attrs currently held
+
+    @staticmethod
+    def _self_attr(expr) -> Optional[str]:
+        if isinstance(expr, ast.Attribute) \
+                and isinstance(expr.value, ast.Name) \
+                and expr.value.id == "self":
+            return expr.attr
+        return None
+
+    def visit_With(self, node: ast.With) -> None:
+        locks = [a for item in node.items
+                 for a in [self._self_attr(item.context_expr)]
+                 if a is not None]
+        self.held.extend(locks)
+        for item in node.items:
+            self.visit(item.context_expr)
+        for stmt in node.body:
+            self.visit(stmt)
+        del self.held[len(self.held) - len(locks):]
+
+    def visit_Attribute(self, node: ast.Attribute) -> None:
+        attr = self._self_attr(node)
+        if attr in self.guards and self.guards[attr] not in self.held:
+            self.findings.append(_finding(
+                self.path, node, "WF721",
+                f"{self.cls}.{self.fn} touches self.{attr} outside "
+                f"'with self.{self.guards[attr]}' (declared in "
+                "__lock_guards__)",
+                hint="take the declared lock around every access, or "
+                     "amend the declaration if the discipline changed"))
+        self.generic_visit(node)
+
+
+def _lock_guards_of(cls: ast.ClassDef) -> dict:
+    """attr -> lock-attr map from a literal ``__lock_guards__``
+    declaration; {} when the class declares none."""
+    out = {}
+    for stmt in cls.body:
+        if isinstance(stmt, ast.Assign) \
+                and any(isinstance(t, ast.Name)
+                        and t.id == "__lock_guards__"
+                        for t in stmt.targets) \
+                and isinstance(stmt.value, ast.Dict):
+            for k, v in zip(stmt.value.keys, stmt.value.values):
+                if not isinstance(k, ast.Constant):
+                    continue
+                if isinstance(v, (ast.Tuple, ast.List)):
+                    for e in v.elts:
+                        if isinstance(e, ast.Constant):
+                            out[e.value] = k.value
+    return out
+
+
+def _check_lock_guards(path: str, tree, findings: List[dict]) -> None:
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.ClassDef):
+            continue
+        guards = _lock_guards_of(node)
+        if not guards:
+            continue
+        for fn in node.body:
+            if not isinstance(fn, (ast.FunctionDef,
+                                   ast.AsyncFunctionDef)):
+                continue
+            if fn.name == "__init__":
+                continue    # construction precedes sharing
+            _GuardVisitor(path, node.name, fn.name, guards,
+                          findings).visit(fn)
+
+
+def lint_file(path: str) -> List[dict]:
+    with open(path) as f:
+        src = f.read()
+    try:
+        tree = ast.parse(src, filename=path)
+    except SyntaxError as e:
+        return [{"code": "WF711", "severity": "error",
+                 "message": f"cannot parse: {e}", "node": None,
+                 "location": f"{os.path.relpath(path, REPO)}:"
+                             f"{e.lineno or 0}", "hint": None}]
+    lines = src.splitlines()
+    findings: List[dict] = []
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                and any(_is_hot_path_deco(d) for d in node.decorator_list):
+            _check_hot_function(path, node, findings)
+    _check_excepts(path, tree, lines, findings)
+    _check_lock_guards(path, tree, findings)
+    return findings
+
+
+def lint_paths(paths) -> List[dict]:
+    findings: List[dict] = []
+    for p in paths:
+        if os.path.isdir(p):
+            for root, dirs, files in os.walk(p):
+                dirs[:] = [d for d in dirs if d != "__pycache__"]
+                for f in sorted(files):
+                    if f.endswith(".py"):
+                        findings.extend(lint_file(os.path.join(root, f)))
+        else:
+            findings.extend(lint_file(p))
+    return findings
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("paths", nargs="*", default=None,
+                    help="files or directories (default: windflow_tpu/)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit findings as a JSON array")
+    args = ap.parse_args(argv)
+    findings = lint_paths(args.paths or DEFAULT_PATHS)
+    if args.json:
+        print(json.dumps(findings, indent=2))
+    else:
+        for f in findings:
+            hint = f" (hint: {f['hint']})" if f.get("hint") else ""
+            print(f"{f['location']}: {f['code']} {f['message']}{hint}")
+        print(f"wf_lint: {len(findings)} violation(s)"
+              if findings else "wf_lint: OK (0 violations)")
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

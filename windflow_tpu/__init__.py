@@ -63,6 +63,10 @@ from windflow_tpu.persistent import (DBHandle, LogKV, PFilter, PFlatMap,
                                      P_Reduce_Builder, P_Sink_Builder)
 from windflow_tpu import staging
 from windflow_tpu.staging import StagingPool
+from windflow_tpu.analysis import (ConcurrencyViolation, Diagnostic,
+                                   hot_path)
+from windflow_tpu.analysis.diagnostics import (PreflightError,
+                                               PreflightWarning)
 
 __version__ = "0.3.0"  # keep in sync with pyproject.toml
 
@@ -88,4 +92,6 @@ __all__ = [
     "P_FlatMap_Builder", "P_Reduce_Builder", "P_Sink_Builder",
     "P_Keyed_Windows_Builder",
     "staging", "StagingPool",
+    "ConcurrencyViolation", "Diagnostic", "PreflightError",
+    "PreflightWarning", "hot_path",
 ]

@@ -1,0 +1,33 @@
+"""Static analysis subsystem: pre-flight graph checking, the ``@hot_path``
+lint contract, and the debug-mode race detector.
+
+Three coordinated passes share one :class:`Diagnostic` record type
+(``WFxxx`` code, severity, graph node / file:line, fix hint):
+
+* ``analysis.preflight`` — ``PipeGraph.check()``: abstract evaluation of
+  the whole dataflow graph before any device dispatch (auto-run at
+  ``start()`` under ``Config.preflight``);
+* ``analysis.hotpath`` — the ``@hot_path`` annotation enforced statically
+  by ``tools/wf_lint.py``;
+* ``analysis.debug_concurrency`` — ``WF_TPU_DEBUG_CONCURRENCY=1`` runtime
+  race detection on the shared mutable structures.
+
+See docs/ANALYSIS.md for the diagnostic code table and contracts.
+"""
+
+from windflow_tpu.analysis.debug_concurrency import (ConcurrencyViolation,
+                                                     set_enabled)
+from windflow_tpu.analysis.diagnostics import CODES, Diagnostic
+from windflow_tpu.analysis.hotpath import hot_path
+
+
+def check_graph(graph):
+    """Run every pre-flight pass over an unstarted PipeGraph (lazy import:
+    the pass pulls in jax and the operator modules; this package stays
+    cheap for the hot-path consumers of ``hot_path``/``ENABLED``)."""
+    from windflow_tpu.analysis.preflight import check_graph as _cg
+    return _cg(graph)
+
+
+__all__ = ["CODES", "ConcurrencyViolation", "Diagnostic", "check_graph",
+           "hot_path", "set_enabled"]

@@ -1,0 +1,231 @@
+"""Debug-mode race detector (``WF_TPU_DEBUG_CONCURRENCY=1``).
+
+The driver loop's shared mutable structures — the staging pool's slot
+dict, the flight-recorder rings, a replica's inbox/inflight state, the
+stats accumulators — are protected by a mix of locks and single-consumer
+conventions.  A convention violated (two pool threads draining one
+replica, a refactor touching ``StagingPool._slots`` outside its lock)
+corrupts silently: wrong counters, aliased buffers, torn batches.  Under
+the debug flag those violations become immediate
+:class:`ConcurrencyViolation` diagnostics:
+
+* **lock-held assertions** — :class:`DebugLock` records its owning
+  thread and :class:`LockCheckedDict` rejects any mutation performed
+  while the guarding lock is not held by the mutating thread
+  (``StagingPool`` swaps both in when the flag is on);
+* **owner-thread tagging / entry guards** — :func:`enter`/:func:`exit_`
+  bracket single-consumer critical sections (replica drains, ring
+  writes, stats samples, the staging pack loop); overlapping entry from
+  a second thread raises with both thread names and sites.
+
+Cost when the flag is off: every instrumentation site is guarded by a
+single module-level flag check (``if debug_concurrency.ENABLED``) — no
+wrapper objects, no dict lookups, nothing on the hot path.  The flag is
+read from the environment once at import; tests flip it with
+:func:`set_enabled`.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from collections import deque
+
+from windflow_tpu.basic import WindFlowError
+
+#: module-level switch — the ONLY thing hot paths check when the
+#: detector is off.  Import-time environment read; set_enabled() for
+#: tests and embedders.
+ENABLED = bool(int(os.environ.get("WF_TPU_DEBUG_CONCURRENCY", "0")))
+
+
+class ConcurrencyViolation(WindFlowError):
+    """A cross-thread access broke a documented concurrency contract."""
+
+
+def set_enabled(on: bool) -> None:
+    """Flip the detector at runtime (tests; embedders that cannot set the
+    environment before import).  Clears the entry-guard table so stale
+    bracket state from a prior enablement cannot false-positive."""
+    global ENABLED
+    ENABLED = bool(on)
+    _active.clear()
+
+
+# -- entry guards (single-consumer critical sections) ------------------------
+
+#: id(obj) -> (thread_id, thread_name, site) while a guarded section is
+#: active.  Plain dict: CPython dict ops are atomic under the GIL, and the
+#: guard only ever compares/installs whole entries.
+_active: dict = {}
+
+
+def enter(obj, site: str) -> None:
+    """Enter a single-consumer critical section on ``obj``.  A second
+    thread entering while the first is still inside is exactly the race
+    the single-consumer convention forbids — raise with both sites."""
+    me = threading.get_ident()
+    cur = _active.get(id(obj))
+    if cur is not None and cur[0] != me:
+        raise ConcurrencyViolation(
+            f"{site}: thread '{threading.current_thread().name}' entered "
+            f"while thread '{cur[1]}' is inside {cur[2]} on the same "
+            f"{type(obj).__name__} — this structure is single-consumer "
+            "by construction (WF_TPU_DEBUG_CONCURRENCY)")
+    _active[id(obj)] = (me, threading.current_thread().name, site)
+
+
+def exit_(obj) -> None:
+    """Leave a critical section entered with :func:`enter`."""
+    _active.pop(id(obj), None)
+
+
+class entry_guard:
+    """``with entry_guard(obj, site):`` form of enter/exit_ for sections
+    with multiple return paths (e.g. ``Replica.drain``)."""
+
+    __slots__ = ("obj", "site")
+
+    def __init__(self, obj, site: str) -> None:
+        self.obj = obj
+        self.site = site
+
+    def __enter__(self) -> None:
+        enter(self.obj, self.site)
+
+    def __exit__(self, *exc) -> None:
+        exit_(self.obj)
+
+
+# -- lock-held assertions -----------------------------------------------------
+
+class DebugLock:
+    """A ``threading.Lock`` that records its owning thread, so guarded
+    structures can assert "my lock is held by whoever is mutating me".
+    Drop-in for the ``with``/acquire/release surface the framework uses."""
+
+    __slots__ = ("_lock", "_owner", "name")
+
+    def __init__(self, name: str = "lock") -> None:
+        self._lock = threading.Lock()
+        self._owner = None
+        self.name = name
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        got = self._lock.acquire(blocking, timeout)
+        if got:
+            self._owner = threading.get_ident()
+        return got
+
+    def release(self) -> None:
+        self._owner = None
+        self._lock.release()
+
+    def locked(self) -> bool:
+        return self._lock.locked()
+
+    def held_by_current_thread(self) -> bool:
+        return self._owner == threading.get_ident()
+
+    def __enter__(self) -> "DebugLock":
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+
+class LockCheckedDict(dict):
+    """A dict whose MUTATIONS assert that a :class:`DebugLock` is held by
+    the mutating thread.  Reads stay unchecked (lock-free reads of
+    at-most-stale values are a documented pattern, see
+    ``PipeGraph._backpressured``); it is unlocked *writes* that corrupt."""
+
+    def __init__(self, guard: DebugLock, what: str, *args, **kw) -> None:
+        super().__init__(*args, **kw)
+        self._guard = guard
+        self._what = what
+
+    def _check(self) -> None:
+        if not self._guard.held_by_current_thread():
+            raise ConcurrencyViolation(
+                f"{self._what} mutated by thread "
+                f"'{threading.current_thread().name}' without holding "
+                f"{self._guard.name} — take the lock around every "
+                "mutation (WF_TPU_DEBUG_CONCURRENCY)")
+
+    def __setitem__(self, k, v):
+        self._check()
+        return super().__setitem__(k, v)
+
+    def __delitem__(self, k):
+        self._check()
+        return super().__delitem__(k)
+
+    def setdefault(self, k, default=None):
+        self._check()
+        return super().setdefault(k, default)
+
+    def pop(self, *a):
+        self._check()
+        return super().pop(*a)
+
+    def popitem(self):
+        self._check()
+        return super().popitem()
+
+    def update(self, *a, **kw):
+        self._check()
+        return super().update(*a, **kw)
+
+    def clear(self):
+        self._check()
+        return super().clear()
+
+
+class LockCheckedDeque(deque):
+    """Deque counterpart of :class:`LockCheckedDict`: reads through dict
+    lookups hand out the *mutable container*, so the values stored in a
+    guarded dict must enforce the same discipline or the race just moves
+    one level down (``pool._slots[n].append(...)`` without the lock)."""
+
+    def __init__(self, guard: DebugLock, what: str, *args) -> None:
+        super().__init__(*args)
+        self._guard = guard
+        self._what = what
+
+    def _check(self) -> None:
+        if not self._guard.held_by_current_thread():
+            raise ConcurrencyViolation(
+                f"{self._what} mutated by thread "
+                f"'{threading.current_thread().name}' without holding "
+                f"{self._guard.name} — take the lock around every "
+                "mutation (WF_TPU_DEBUG_CONCURRENCY)")
+
+    def append(self, x):
+        self._check()
+        return super().append(x)
+
+    def appendleft(self, x):
+        self._check()
+        return super().appendleft(x)
+
+    def extend(self, it):
+        self._check()
+        return super().extend(it)
+
+    def pop(self):
+        self._check()
+        return super().pop()
+
+    def popleft(self):
+        self._check()
+        return super().popleft()
+
+    def remove(self, x):
+        self._check()
+        return super().remove(x)
+
+    def clear(self):
+        self._check()
+        return super().clear()

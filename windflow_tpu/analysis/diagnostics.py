@@ -1,0 +1,134 @@
+"""Diagnostic records shared by every static-analysis pass.
+
+One record type surfaces everything the analysis subsystem finds: the
+pre-flight graph checker (``analysis/preflight.py``), the hot-path AST
+lint (``tools/wf_lint.py``), and the debug-mode race detector
+(``analysis/debug_concurrency.py``).  WindFlow gets the same guarantees
+from C++ template/concept errors at compile time; a Python/JAX framework
+has no compiler seam, so the seam is built here: stable ``WFxxx`` codes,
+a severity, the graph node or file:line the finding anchors to, and a fix
+hint — machine-consumable (``to_json``) and human-readable (``__str__``)
+from the same record.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional
+
+from windflow_tpu.basic import WindFlowError
+
+#: code -> (default severity, one-line description).  The table is the
+#: contract: tests assert codes, docs/ANALYSIS.md renders it, and
+#: tools/wf_check.py --json ships it.  Codes are append-only — a released
+#: code never changes meaning.
+CODES = {
+    # -- abstract evaluation of operator chains (WF1xx) ----------------------
+    "WF101": ("error", "operator kernel failed abstract evaluation "
+                       "(dtype/shape mismatch in the chain)"),
+    "WF102": ("error", "filter predicate must return a boolean scalar"),
+    "WF103": ("error", "reduce combiner must preserve the record "
+                       "structure, shapes and dtypes"),
+    "WF104": ("error", "key extractor of a keyed device operator must "
+                       "return an integer scalar"),
+    "WF105": ("error", "window combiner must preserve the lifted "
+                       "aggregate structure"),
+    "WF106": ("warning", "merged branches deliver different record "
+                         "structures"),
+    # -- window specifications (WF2xx) ---------------------------------------
+    "WF201": ("error", "window length and slide must be positive"),
+    # warning, not error: hopping windows WITH gaps are a supported
+    # semantic (the FFAT spec sweep exercises them against an oracle) —
+    # but an accidental swap of (length, slide) silently drops gap
+    # tuples, so it is surfaced loudly
+    "WF202": ("warning", "window slide exceeds window length: tuples in "
+                         "the gaps belong to no window"),
+    "WF203": ("warning", "lateness on a count-based window is ignored"),
+    "WF204": ("error", "window lateness must be non-negative"),
+    # -- graph composition / routing (WF3xx) ---------------------------------
+    "WF301": ("error", "operator follows a terminal (sink) operator"),
+    "WF302": ("error", "pipeline does not end in a sink"),
+    "WF303": ("error", "KEYBY routing requires a key extractor"),
+    "WF304": ("error", "malformed graph composition"),
+    # -- mesh / sharding (WF4xx) ---------------------------------------------
+    "WF401": ("error", "staged batch capacity not divisible across the "
+                       "mesh devices"),
+    "WF402": ("error", "keyed state space not divisible by the mesh key "
+                       "axis"),
+    "WF403": ("error", "merged upstream paths deliver unequal fixed "
+                       "batch capacities"),
+    # -- watermarks / time (WF5xx) -------------------------------------------
+    "WF501": ("error", "EVENT time policy requires a timestamp "
+                       "extractor on every source"),
+    "WF502": ("error", "merge joins branches with mixed watermark modes"),
+    "WF503": ("warning", "time-based windows fed by a watermark-less "
+                         "source fire only at end-of-stream"),
+    # -- hot-path lint (WF7xx, emitted by tools/wf_lint.py) ------------------
+    "WF701": ("error", "allocation inside a @hot_path function"),
+    "WF702": ("error", "host synchronization inside a @hot_path function"),
+    "WF703": ("error", "lock acquisition inside a @hot_path function"),
+    "WF711": ("error", "bare except"),
+    "WF712": ("error", "broad 'except Exception' without an allowlist "
+                       "justification"),
+    "WF721": ("error", "lock-guarded attribute accessed outside its "
+                       "declared lock"),
+}
+
+
+@dataclasses.dataclass
+class Diagnostic:
+    """One analysis finding.
+
+    ``node`` names the graph operator (pre-flight passes) and ``location``
+    carries ``file:line`` (lint passes); either may be None — the two
+    anchor styles share the record so ``wf_check --json`` and
+    ``wf_lint --json`` emit the same schema.
+    """
+
+    code: str
+    message: str
+    node: Optional[str] = None
+    location: Optional[str] = None
+    hint: Optional[str] = None
+    severity: str = ""
+
+    def __post_init__(self) -> None:
+        if not self.severity:
+            self.severity = CODES.get(self.code, ("error",))[0]
+
+    def to_json(self) -> dict:
+        return {
+            "code": self.code,
+            "severity": self.severity,
+            "message": self.message,
+            "node": self.node,
+            "location": self.location,
+            "hint": self.hint,
+        }
+
+    def __str__(self) -> str:
+        where = self.location or (f"node '{self.node}'" if self.node
+                                  else "graph")
+        s = f"{self.code} [{self.severity}] {where}: {self.message}"
+        if self.hint:
+            s += f" (hint: {self.hint})"
+        return s
+
+
+class PreflightWarning(UserWarning):
+    """Carrier for warning-severity pre-flight diagnostics (and for
+    error-severity ones under ``Config.preflight = "warn"``)."""
+
+
+class PreflightError(WindFlowError):
+    """Raised by ``PipeGraph.start()`` under ``Config.preflight="error"``
+    when the checker finds error-severity diagnostics.  Carries ALL of
+    them — the message lists every violation, not just the first."""
+
+    def __init__(self, diagnostics: List[Diagnostic]) -> None:
+        self.diagnostics = list(diagnostics)
+        n = len(self.diagnostics)
+        lines = "\n  ".join(str(d) for d in self.diagnostics)
+        super().__init__(
+            f"pre-flight check found {n} error(s) "
+            f"(Config.preflight='warn'/'off' to bypass):\n  {lines}")

@@ -1,0 +1,38 @@
+"""The ``@hot_path`` annotation: a machine-checkable performance contract.
+
+PRs 1-2 established three hot-path invariants by convention — no
+allocation, no host synchronization, no lock acquisition — on the staging
+pack loop, the flight-recorder ring writes, and the emitter/collector
+service loops.  This decorator makes the convention visible to the AST
+lint (``tools/wf_lint.py``), which enforces it on every function carrying
+the mark:
+
+* **no allocation** — no ``np.zeros``-family calls, no ``list()``/
+  ``dict()``/``set()`` calls, no comprehensions (small literals are fine:
+  they are arena-cheap and unavoidable for message passing);
+* **no host sync** — no ``np.asarray``, ``.block_until_ready()``,
+  ``jax.device_get`` (each can stall the driver on device work);
+* **no locks** — no ``with ...lock`` / ``.acquire()`` (a hot-path lock
+  serializes the worker pool on its hottest path).
+
+At runtime the decorator is an identity function plus one attribute — it
+adds NOTHING to the marked function's cost; the enforcement is entirely
+static.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, TypeVar
+
+F = TypeVar("F", bound=Callable)
+
+#: attribute stamped on marked functions (introspection / tests)
+HOT_PATH_ATTR = "__wf_hot_path__"
+
+
+def hot_path(fn: F) -> F:
+    """Mark ``fn`` as hot-path code: ``tools/wf_lint.py`` rejects
+    allocation, host synchronization and lock acquisition in its body
+    (codes WF701/WF702/WF703)."""
+    setattr(fn, HOT_PATH_ATTR, True)
+    return fn

@@ -1,0 +1,771 @@
+"""Pre-flight graph checker: abstract evaluation of a whole PipeGraph.
+
+WindFlow rejects illegal pipeline compositions at C++ compile time through
+template/concept checks; a Python/JAX graph has no compiler seam, so shape
+and dtype mistakes historically surfaced only when a batch hit the device
+mid-run (deep in ``ops/tpu.py`` or ``windows/ffat_tpu.py``) — and only the
+FIRST one.  This module walks the *built-but-not-started* graph and reports
+**every** violation it can prove, with zero device work:
+
+* operator chains are abstractly evaluated with ``jax.eval_shape`` on the
+  user kernels (DrJAX idiom: abstract evaluation type-checks the dataflow
+  without touching an accelerator) — dtype/shape mismatches, non-boolean
+  filter predicates, combiner contract drift, non-integer key extractors;
+* window specs are checked for length/slide/lateness consistency;
+* keyby routing, mesh shard-divisibility (``parallel/mesh.py`` contracts)
+  and fixed-capacity merge consistency are validated structurally;
+* watermark modes are folded across merge/split points
+  (``graph/multipipe.py``): a branch that can never produce watermarks
+  stalls every time window downstream of the merge.
+
+Entry point: :func:`check_graph`, surfaced as ``PipeGraph.check()`` and
+auto-run at ``start()`` under ``Config.preflight`` ("error" | "warn" |
+"off").  Abstract record specs flow from sources: declared via
+``Source_Builder.withRecordSpec(example)`` or inferred from a
+``DeviceSource``'s traced generator; chains fed by undeclared sources skip
+the kernel passes (structure/spec checks still run).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from windflow_tpu.analysis.diagnostics import Diagnostic
+from windflow_tpu.basic import (RoutingMode, TimePolicy, WindFlowError,
+                                WinType)
+
+#: sentinel for "record structure unknown at this point of the chain"
+_UNKNOWN = None
+
+
+# ---------------------------------------------------------------------------
+# record specs
+# ---------------------------------------------------------------------------
+
+def _as_struct(example):
+    """An example record (pytree of scalars/arrays) or a pytree of
+    ``jax.ShapeDtypeStruct`` -> per-record abstract spec.  Host numpy
+    only — never touches a device."""
+    import jax
+
+    def leaf(x):
+        if isinstance(x, jax.ShapeDtypeStruct):
+            return x
+        a = np.asarray(x)
+        return jax.ShapeDtypeStruct(a.shape, a.dtype)
+
+    return jax.tree.map(leaf, example)
+
+
+def _batched(spec, capacity: int):
+    """Per-record spec -> batch spec (leading dim = capacity)."""
+    import jax
+    return jax.tree.map(
+        lambda s: jax.ShapeDtypeStruct((capacity,) + tuple(s.shape),
+                                       s.dtype), spec)
+
+
+def _same_struct(a, b) -> bool:
+    import jax
+    return jax.tree.structure(a) == jax.tree.structure(b)
+
+
+def _leaf_mismatch(want, got) -> Optional[str]:
+    """First leaf whose shape/dtype drifts between two same-structure
+    specs, rendered for the message; None when they agree."""
+    import jax
+    in_leaves, _ = jax.tree_util.tree_flatten_with_path(want)
+    out_leaves = jax.tree.leaves(got)
+    for (path, a), b in zip(in_leaves, out_leaves):
+        if tuple(a.shape) != tuple(b.shape) or a.dtype != b.dtype:
+            return (f"field {jax.tree_util.keystr(path) or '.'} is "
+                    f"{tuple(a.shape)}/{a.dtype} in the records but came "
+                    f"back {tuple(b.shape)}/{b.dtype}")
+    return None
+
+
+# ---------------------------------------------------------------------------
+# graph structure helpers (shared with PipeGraph._build)
+# ---------------------------------------------------------------------------
+
+def _upstream_map(edges) -> Dict[int, Tuple[Any, list]]:
+    """id(op) -> (op, [upstream ops]) over every graph edge, including
+    split fan-outs (same traversal as ``PipeGraph._check_fixed_capacity_ops``
+    used before it moved here)."""
+    upstreams: Dict[int, Tuple[Any, list]] = {}
+    for edge in edges:
+        if edge[0] == "op":
+            _, a, b = edge
+            upstreams.setdefault(id(b), (b, []))[1].append(a)
+        else:  # split: each child's head is fed by the split source
+            _, mp = edge
+            src_op = mp.operators[-1]
+            for child in mp.split_children:
+                if child.operators:
+                    head = child.operators[0]
+                    upstreams.setdefault(id(head), (head, []))[1].append(
+                        src_op)
+    return upstreams
+
+
+def _effective_caps(op, upstreams, seen=None) -> set:
+    """Batch capacities a device batch can arrive with at ``op``: host
+    operators stamp their ``output_batch_size``; TPU operators pass their
+    input capacity through."""
+    seen = seen or set()
+    if id(op) in seen:
+        return set()
+    seen.add(id(op))
+    if not op.is_tpu:
+        return {op.output_batch_size}
+    caps = set()
+    for up in upstreams.get(id(op), (None, []))[1]:
+        caps |= _effective_caps(up, upstreams, seen)
+    return caps
+
+
+def capacity_conflicts(graph, upstreams=None) -> List[Tuple[Any, str, set]]:
+    """Fixed-capacity device operators fed by upstream paths delivering
+    unequal batch capacities: ``[(op, label, caps), ...]``.  Shared by the
+    pre-flight pass (code WF403) and ``PipeGraph._build``'s
+    ``preflight="off"`` backstop; ``upstreams`` lets check_graph reuse
+    the map it already built."""
+    if upstreams is None:
+        upstreams = _upstream_map(graph._edges())
+    out = []
+    for _, (op, ups) in upstreams.items():
+        label = op.fixed_capacity_label
+        if label is not None:
+            caps = set()
+            for up in ups:
+                caps |= _effective_caps(up, upstreams)
+            if len(caps) > 1:
+                out.append((op, label, caps))
+    return out
+
+
+def _all_ops(graph) -> list:
+    seen, out = set(), []
+    for mp in graph._all_pipes():
+        for op in mp.operators:
+            if id(op) not in seen:
+                seen.add(id(op))
+                out.append(op)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# the passes
+# ---------------------------------------------------------------------------
+
+def check_graph(graph) -> List[Diagnostic]:
+    """Run every pre-flight pass over an unstarted PipeGraph and return
+    the full list of diagnostics (errors AND warnings — never just the
+    first).  Performs no device work: the kernel pass is pure
+    ``jax.eval_shape`` abstract evaluation."""
+    diags: List[Diagnostic] = []
+    try:
+        edges = graph._edges()
+    except WindFlowError as e:
+        diags.append(Diagnostic("WF304", str(e)))
+        return diags
+    except IndexError:
+        # merged MultiPipe with no operators yet: _edges() indexes
+        # merged.operators[0] — report it instead of crashing the
+        # diagnostic API that exists to explain malformed compositions
+        diags.append(Diagnostic(
+            "WF304",
+            "a merged MultiPipe has no operators — add an operator (and "
+            "a sink) to the merge result before running"))
+        return diags
+    ops = _all_ops(graph)
+    upstreams = _upstream_map(edges)
+
+    _structural_pass(graph, ops, edges, diags)
+    _window_spec_pass(ops, diags)
+    _capacity_pass(graph, upstreams, diags)
+    _mesh_pass(graph, ops, edges, diags)
+    _watermark_pass(graph, ops, upstreams, diags)
+    _kernel_pass(graph, ops, edges, upstreams, diags)
+    return diags
+
+
+def _structural_pass(graph, ops, edges, diags) -> None:
+    has_downstream = set()
+    for edge in edges:
+        if edge[0] == "op":
+            _, a, b = edge
+            has_downstream.add(id(a))
+            if a.is_terminal:
+                diags.append(Diagnostic(
+                    "WF301",
+                    f"operator '{b.name}' is composed downstream of sink "
+                    f"'{a.name}' — a sink terminates its pipeline and "
+                    "forwards nothing",
+                    node=b.name,
+                    hint="route the data before the sink (split the pipe) "
+                         "or drop the trailing operators"))
+        else:
+            _, mp = edge
+            has_downstream.add(id(mp.operators[-1]))
+    for op in ops:
+        if not op.is_terminal and id(op) not in has_downstream:
+            diags.append(Diagnostic(
+                "WF302",
+                f"operator '{op.name}' has no downstream consumer — "
+                "every MultiPipe must end in a Sink",
+                node=op.name, hint="append add_sink(...) to the pipeline"))
+        if op.routing == RoutingMode.KEYBY and op.key_extractor is None:
+            diags.append(Diagnostic(
+                "WF303",
+                f"operator '{op.name}' uses KEYBY routing but declares no "
+                "key extractor",
+                node=op.name, hint="pass withKeyBy(fn) on the builder"))
+
+
+def _window_spec_pass(ops, diags) -> None:
+    from windflow_tpu.windows.engine import WindowSpec
+    for op in ops:
+        spec = getattr(op, "spec", None)
+        if not isinstance(spec, WindowSpec):
+            continue
+        if spec.win_len <= 0 or spec.slide <= 0:
+            diags.append(Diagnostic(
+                "WF201",
+                f"operator '{op.name}': window length {spec.win_len} / "
+                f"slide {spec.slide} must both be positive",
+                node=op.name))
+            continue   # the remaining spec arithmetic assumes positives
+        if spec.slide > spec.win_len:
+            diags.append(Diagnostic(
+                "WF202",
+                f"operator '{op.name}': slide {spec.slide} exceeds window "
+                f"length {spec.win_len} — tuples landing in the "
+                f"{spec.slide - spec.win_len}-wide gaps belong to no "
+                "window (hopping-with-gaps is supported, but a swapped "
+                "(length, slide) pair silently drops data)",
+                node=op.name,
+                hint="use slide <= length unless the gaps are intended"))
+        if spec.lateness < 0:
+            diags.append(Diagnostic(
+                "WF204",
+                f"operator '{op.name}': lateness {spec.lateness} is "
+                "negative", node=op.name))
+        elif spec.lateness > 0 and spec.win_type == WinType.CB:
+            diags.append(Diagnostic(
+                "WF203",
+                f"operator '{op.name}': lateness "
+                f"{spec.lateness} declared on a count-based window — "
+                "lateness gates time-based windows only and is ignored "
+                "here", node=op.name,
+                hint="drop withLateness or switch to withTBWindows"))
+
+
+def _capacity_pass(graph, upstreams, diags) -> None:
+    for op, label, caps in capacity_conflicts(graph, upstreams):
+        diags.append(Diagnostic(
+            "WF403",
+            f"'{op.name}' ({label}) compiles for one fixed batch capacity "
+            f"but its upstream paths deliver {sorted(caps)}; give the "
+            "merged branches equal withOutputBatchSize",
+            node=op.name))
+
+
+def _mesh_pass(graph, ops, edges, diags) -> None:
+    mesh = graph.config.mesh
+    if mesh is None:
+        return
+    total = int(math.prod(mesh.devices.shape))
+    extents = dict(zip(mesh.axis_names, mesh.devices.shape))
+    key_extent = int(extents.get("key", 1))
+    # host -> TPU staging edges: the staged batch lays out data-sharded
+    # over the whole mesh (DeviceStageEmitter contract)
+    for edge in edges:
+        if edge[0] != "op":
+            continue
+        _, a, b = edge
+        if b.is_tpu and not a.is_tpu and a.output_batch_size > 0 \
+                and a.output_batch_size % total:
+            diags.append(Diagnostic(
+                "WF401",
+                f"staging edge '{a.name}' -> '{b.name}': output batch "
+                f"size {a.output_batch_size} not divisible by the mesh's "
+                f"{total} devices",
+                node=b.name,
+                hint=f"pick a withOutputBatchSize that is a multiple of "
+                     f"{total}"))
+    # key-sharded state spaces (parallel/mesh.py raises the same at
+    # compile time; reported here for the whole graph at once)
+    from windflow_tpu.ops.tpu_stateful import _StatefulTPUBase
+    from windflow_tpu.windows.ffat_tpu import FfatWindowsTPU
+    for op in ops:
+        if isinstance(op, FfatWindowsTPU) and op.max_keys % key_extent:
+            diags.append(Diagnostic(
+                "WF402",
+                f"operator '{op.name}': max_keys {op.max_keys} not "
+                f"divisible by key axis {key_extent}",
+                node=op.name))
+        elif isinstance(op, _StatefulTPUBase) \
+                and op.num_key_slots % key_extent:
+            diags.append(Diagnostic(
+                "WF402",
+                f"operator '{op.name}': num_key_slots {op.num_key_slots} "
+                f"not divisible by key axis {key_extent}",
+                node=op.name))
+
+
+def _source_wm_mode(op, time_policy, diags) -> str:
+    """Classify how a source advances watermarks: "ingress" (wall clock),
+    "event" (data timestamps) or "none" (cannot advance — the stalling
+    mode the merge pass hunts).  Unknown Source subclasses (Kafka, user
+    sources with custom replicas) are assumed to manage time themselves."""
+    from windflow_tpu.io.device_source import DeviceSource
+    from windflow_tpu.ops.source import Source, SourceReplica
+    if isinstance(op, DeviceSource):
+        if time_policy == TimePolicy.EVENT:
+            if op.ts_fn is None or op.wm_fn is None:
+                diags.append(Diagnostic(
+                    "WF501",
+                    f"device source '{op.name}': EVENT time policy needs "
+                    "both ts_fn (device lane) and wm_fn (host frontier)",
+                    node=op.name, hint="use withTimestampFn(ts_fn, wm_fn)"))
+                return "none"
+            return "event"
+        if op.ts_fn is not None:
+            diags.append(Diagnostic(
+                "WF501",
+                f"device source '{op.name}': withTimestampFn requires the "
+                "EVENT time policy (INGRESS stamps arrival time itself)",
+                node=op.name))
+        return "ingress"
+    if type(op) is Source or op.replica_class is SourceReplica:
+        if time_policy == TimePolicy.EVENT:
+            if op.ts_extractor is None:
+                diags.append(Diagnostic(
+                    "WF501",
+                    f"source '{op.name}': EVENT time policy requires a "
+                    "timestamp extractor",
+                    node=op.name,
+                    hint="use withTimestampExtractor(fn) on the builder"))
+                return "none"
+            return "event"
+        return "ingress"
+    return "event" if time_policy == TimePolicy.EVENT else "ingress"
+
+
+def _watermark_pass(graph, ops, upstreams, diags) -> None:
+    from windflow_tpu.ops.source import Source
+    from windflow_tpu.windows.engine import WindowSpec
+    # demand-driven fold over the upstream map (merge-connection edges
+    # sort last in _edges(), so a forward sweep would leave everything
+    # past a merged pipe's head without modes — same ordering hazard the
+    # kernel pass avoids the same way)
+    memo: Dict[int, set] = {}
+
+    def modes_of(op, stack=frozenset()):
+        if id(op) in memo:
+            return memo[id(op)]
+        if id(op) in stack:         # defensive: compositions cannot cycle
+            return set()
+        if isinstance(op, Source):
+            m = {_source_wm_mode(op, graph.time_policy, diags)}
+        else:
+            m = set()
+            for up in upstreams.get(id(op), (None, []))[1]:
+                m |= modes_of(up, stack | {id(op)})
+        memo[id(op)] = m
+        return m
+
+    for op in ops:
+        modes_of(op)    # classifies every source (WF501) exactly once
+    # merge points: the WatermarkCollector min-folds channel watermarks, so
+    # one watermark-less parent pins the merged frontier at WM_NONE forever
+    for merged in graph._merges:
+        if not merged.operators:
+            continue
+        head = merged.operators[0]
+        got = memo.get(id(head), set())
+        if len(got) > 1:
+            diags.append(Diagnostic(
+                "WF502",
+                f"merge into '{head.name}' joins branches with mixed "
+                f"watermark modes {sorted(got)} — the merged watermark "
+                "min-folds over channels, so the least-advancing branch "
+                "gates every time window downstream",
+                node=head.name,
+                hint="give every merged branch the same timestamping "
+                     "(all event-timestamped, or all ingress)"))
+    # TB windows downstream of a watermark-less branch never fire mid-run
+    for op in ops:
+        got = memo.get(id(op), set())
+        if "none" not in got:
+            continue
+        spec = getattr(op, "spec", None)
+        if isinstance(spec, WindowSpec) and spec.win_type == WinType.TB:
+            diags.append(Diagnostic(
+                "WF503",
+                f"time-based window operator '{op.name}' is fed by a "
+                "branch that never advances watermarks — its windows "
+                "fire only at end-of-stream",
+                node=op.name))
+
+
+# ---------------------------------------------------------------------------
+# abstract kernel evaluation
+# ---------------------------------------------------------------------------
+
+def _eval(fn, *specs):
+    """``jax.eval_shape`` with the exception surfaced as a string (the
+    diagnostic payload); no device work either way."""
+    import jax
+    try:
+        return jax.eval_shape(fn, *specs), None
+    except Exception as e:  # noqa: BLE001 - lint: broad-except-ok (user
+        # kernels raise arbitrary exception types under abstract eval; the
+        # whole point of this pass is to turn ANY of them into a WF101)
+        return None, f"{type(e).__name__}: {e}"
+
+
+def _check_key_extractor(op, spec, diags) -> None:
+    if op.key_extractor is None:
+        return
+    out, err = _eval(op.key_extractor, spec)
+    if err is not None:
+        diags.append(Diagnostic(
+            "WF104",
+            f"operator '{op.name}': key extractor failed abstract "
+            f"evaluation over the record spec — {err}",
+            node=op.name))
+        return
+    shape = tuple(getattr(out, "shape", ())) if out is not None else ()
+    dtype = getattr(out, "dtype", None)
+    if shape != () or dtype is None \
+            or not np.issubdtype(np.dtype(dtype), np.integer):
+        diags.append(Diagnostic(
+            "WF104",
+            f"operator '{op.name}': key extractor must return an integer "
+            f"scalar, got shape {shape} dtype {dtype} — keys are "
+            "extracted inside the compiled program and index dense key "
+            "tables",
+            node=op.name,
+            hint="return an int field (cast with .astype(jnp.int32))"))
+
+
+def _check_comb(op, one, code, what, diags) -> bool:
+    """Combiner must map (rec, rec) -> rec with structure, shapes and
+    dtypes preserved — the associativity contract every fold path
+    (sort/scan, dense tables, mesh collectives) compiles against."""
+    import jax
+    out, err = _eval(op.comb, one, one)
+    if err is not None:
+        diags.append(Diagnostic(
+            code,
+            f"operator '{op.name}': {what} combiner failed abstract "
+            f"evaluation — {err}", node=op.name))
+        return False
+    if not _same_struct(one, out):
+        want = jax.tree.structure(one)
+        got = jax.tree.structure(out)
+        diags.append(Diagnostic(
+            code,
+            f"operator '{op.name}': {what} combiner must return the same "
+            f"record structure as its inputs (records have {want}, "
+            f"combiner returned {got}); carry every field through the "
+            "combine", node=op.name))
+        return False
+    drift = _leaf_mismatch(one, out)
+    if drift is not None:
+        diags.append(Diagnostic(
+            code,
+            f"operator '{op.name}': {what} combiner must preserve each "
+            f"field's shape and dtype: {drift}", node=op.name))
+        return False
+    return True
+
+
+def _kernel_pass(graph, ops, edges, upstreams, diags) -> None:
+    """Propagate abstract record specs from the sources through every
+    chain, eval-shaping each user kernel where a spec is known."""
+    import jax
+    from windflow_tpu.io.device_source import DeviceSource
+    from windflow_tpu.ops.chained import ChainedTPU
+    from windflow_tpu.ops.filter_op import Filter
+    from windflow_tpu.ops.source import Source
+    from windflow_tpu.ops.tpu import FilterTPU, MapTPU, ReduceTPU
+    from windflow_tpu.ops.tpu_stateful import (StatefulFilterTPU,
+                                               StatefulMapTPU)
+    from windflow_tpu.windows.ffat_tpu import FfatWindowsTPU
+
+    in_spec: Dict[int, Any] = {}
+
+    def cap_of(op) -> int:
+        caps = sorted(c for c in _effective_caps(op, upstreams) if c)
+        return caps[0] if caps else (graph.config.default_batch_size or 1)
+
+    def source_spec(op):
+        if getattr(op, "record_spec", None) is not None:
+            try:
+                return _as_struct(op.record_spec)
+            except Exception as e:  # noqa: BLE001 - lint: broad-except-ok
+                # (withRecordSpec takes arbitrary user pytrees; a bad one
+                # must degrade to "unknown", never crash the checker)
+                diags.append(Diagnostic(
+                    "WF101",
+                    f"source '{op.name}': withRecordSpec example could "
+                    f"not be abstracted — {type(e).__name__}: {e}",
+                    node=op.name))
+                return _UNKNOWN
+        if isinstance(op, DeviceSource) and op.batch_fn is not None:
+            out, err = _eval(op.batch_fn,
+                             jax.ShapeDtypeStruct((), np.int32))
+            if err is None and out is not None:
+                # per-record view of the [capacity] batch leaves
+                return jax.tree.map(
+                    lambda s: jax.ShapeDtypeStruct(tuple(s.shape)[1:],
+                                                   s.dtype), out)
+        return _UNKNOWN
+
+    def out_spec(op, spec):
+        """Abstract output record spec of ``op`` given input ``spec``
+        (which may be _UNKNOWN), appending diagnostics for provable
+        kernel violations.  Device kernels MUST trace (WF101); host
+        functions are best-effort (arbitrary Python degrades to
+        unknown, never to an error)."""
+        if spec is not _UNKNOWN and op.is_keyed:
+            # device-traced integer extractors only: ReduceTPU and FFAT
+            # extract keys INSIDE the compiled program; dense-key stateful
+            # ops index slot tables directly.  (Interned stateful keys and
+            # host keyby extractors may return any hashable — no check.)
+            if isinstance(op, (ReduceTPU, FfatWindowsTPU)) \
+                    or (isinstance(op, (StatefulMapTPU, StatefulFilterTPU))
+                        and op.dense_keys):
+                _check_key_extractor(op, spec, diags)
+        if isinstance(op, MapTPU):
+            if spec is _UNKNOWN:
+                return _UNKNOWN
+            if op.batch_fn:
+                cap = cap_of(op)
+                out, err = _eval(op.fn, _batched(spec, cap),
+                                 jax.ShapeDtypeStruct((cap,), np.bool_))
+                if err is not None:
+                    diags.append(Diagnostic(
+                        "WF101",
+                        f"operator '{op.name}': batch kernel failed "
+                        f"abstract evaluation over the incoming record "
+                        f"spec — {err}", node=op.name))
+                    return _UNKNOWN
+                return jax.tree.map(
+                    lambda s: jax.ShapeDtypeStruct(tuple(s.shape)[1:],
+                                                   s.dtype), out)
+            out, err = _eval(op.fn, spec)
+            if err is not None:
+                diags.append(Diagnostic(
+                    "WF101",
+                    f"operator '{op.name}': kernel failed abstract "
+                    f"evaluation over the incoming record spec — {err}",
+                    node=op.name,
+                    hint="the record fields/dtypes reaching this operator "
+                         "do not match what the kernel expects"))
+                return _UNKNOWN
+            return out
+        if isinstance(op, FilterTPU):
+            if spec is _UNKNOWN:
+                return _UNKNOWN
+            out, err = _eval(op.fn, spec)
+            if err is not None:
+                diags.append(Diagnostic(
+                    "WF101",
+                    f"operator '{op.name}': predicate failed abstract "
+                    f"evaluation — {err}", node=op.name))
+            else:
+                shape = tuple(getattr(out, "shape", (-1,)))
+                dtype = getattr(out, "dtype", None)
+                if shape != () or dtype is None \
+                        or np.dtype(dtype) != np.dtype(np.bool_):
+                    diags.append(Diagnostic(
+                        "WF102",
+                        f"operator '{op.name}': predicate must return a "
+                        f"boolean scalar, got shape {shape} dtype "
+                        f"{dtype} — the validity-mask intersection needs "
+                        "a bool lane", node=op.name))
+            return spec
+        if isinstance(op, ChainedTPU):
+            cur = spec
+            for kind, fn in op.specs:
+                if cur is _UNKNOWN:
+                    return _UNKNOWN
+                if kind == "map":
+                    out, err = _eval(fn, cur)
+                    if err is not None:
+                        diags.append(Diagnostic(
+                            "WF101",
+                            f"operator '{op.name}': fused map stage "
+                            f"failed abstract evaluation — {err}",
+                            node=op.name))
+                        return _UNKNOWN
+                    cur = out
+                elif kind == "batch_map":
+                    cap = cap_of(op)
+                    out, err = _eval(
+                        fn, _batched(cur, cap),
+                        jax.ShapeDtypeStruct((cap,), np.bool_))
+                    if err is not None:
+                        diags.append(Diagnostic(
+                            "WF101",
+                            f"operator '{op.name}': fused batch-map "
+                            f"stage failed abstract evaluation — {err}",
+                            node=op.name))
+                        return _UNKNOWN
+                    cur = jax.tree.map(
+                        lambda s: jax.ShapeDtypeStruct(
+                            tuple(s.shape)[1:], s.dtype), out)
+                else:   # filter
+                    out, err = _eval(fn, cur)
+                    if err is not None:
+                        diags.append(Diagnostic(
+                            "WF101",
+                            f"operator '{op.name}': fused predicate "
+                            f"failed abstract evaluation — {err}",
+                            node=op.name))
+                    elif tuple(getattr(out, "shape", (-1,))) != () \
+                            or np.dtype(out.dtype) != np.dtype(np.bool_):
+                        diags.append(Diagnostic(
+                            "WF102",
+                            f"operator '{op.name}': fused predicate must "
+                            "return a boolean scalar, got shape "
+                            f"{tuple(getattr(out, 'shape', ()))} dtype "
+                            f"{getattr(out, 'dtype', None)}",
+                            node=op.name))
+            return cur
+        if isinstance(op, ReduceTPU):
+            if spec is not _UNKNOWN:
+                _check_comb(op, spec, "WF103", "reduce", diags)
+            return spec
+        if isinstance(op, FfatWindowsTPU):
+            if spec is not _UNKNOWN:
+                agg, err = _eval(op.lift, spec)
+                if err is not None:
+                    diags.append(Diagnostic(
+                        "WF101",
+                        f"operator '{op.name}': lift failed abstract "
+                        f"evaluation over the incoming record spec — "
+                        f"{err}", node=op.name))
+                else:
+                    _check_ffat_comb(op, agg, diags)
+            return _UNKNOWN   # emits window results, not input records
+        if isinstance(op, (StatefulMapTPU, StatefulFilterTPU)):
+            if spec is not _UNKNOWN and op.assoc is None:
+                state = jax.tree.map(
+                    lambda a: jax.ShapeDtypeStruct(
+                        tuple(np.shape(a))[1:],
+                        np.asarray(a).dtype if not hasattr(a, "dtype")
+                        else a.dtype), op._state)
+                out, err = _eval(op.fn, spec, state)
+                if err is not None:
+                    diags.append(Diagnostic(
+                        "WF101",
+                        f"operator '{op.name}': stateful kernel failed "
+                        f"abstract evaluation — {err}", node=op.name))
+                    return _UNKNOWN
+                if isinstance(op, StatefulMapTPU):
+                    try:
+                        return out[0]
+                    except (TypeError, IndexError):
+                        return _UNKNOWN
+                return spec
+            return spec if isinstance(op, StatefulFilterTPU) else _UNKNOWN
+        if isinstance(op, Filter):
+            # the predicate is not invoked (host functions may be
+            # side-effectful); records pass through unchanged either way
+            return spec
+        # host Map/FlatMap/Reduce, window engines, sinks, unknown types:
+        # arbitrary Python the runtime never traces — calling it here
+        # (even abstractly) could fire side effects before the stream
+        # runs, so the spec goes unknown instead.  Device kernels above
+        # are different: jit traces them at the first batch anyway, so
+        # abstract evaluation adds no new execution contract.
+        return _UNKNOWN
+
+    # Demand-driven propagation over the upstream map (which already
+    # includes merge and split fan-in edges): order-independent, so a
+    # merged pipe's internal chain sees the specs its parents deliver
+    # even though the merge-connection edges sort last in _edges().
+    out_cache: Dict[int, Any] = {}
+    visiting: set = set()
+
+    def in_of(op):
+        if id(op) in in_spec:
+            return in_spec[id(op)]
+        spec = _UNKNOWN
+        first = True
+        for up in upstreams.get(id(op), (None, []))[1]:
+            s = out_of(up)
+            if first:
+                spec, first = s, False
+            elif spec is _UNKNOWN or s is _UNKNOWN:
+                spec = _UNKNOWN
+            else:
+                # structure AND leaf shapes/dtypes must agree: a merge of
+                # {"v": int32} with {"v": float32} would otherwise be
+                # checked against only the first branch
+                drift = (f"record structures {jax.tree.structure(spec)} "
+                         f"vs {jax.tree.structure(s)}"
+                         if not _same_struct(spec, s)
+                         else _leaf_mismatch(spec, s))
+                if drift is not None:
+                    diags.append(Diagnostic(
+                        "WF106",
+                        f"operator '{op.name}': merged branches deliver "
+                        f"different records ({drift}) — downstream "
+                        "kernels were checked against neither",
+                        node=op.name))
+                    spec = _UNKNOWN
+        in_spec[id(op)] = spec
+        return spec
+
+    def out_of(op):
+        if id(op) in out_cache:
+            return out_cache[id(op)]
+        if id(op) in visiting:      # defensive: compositions cannot cycle
+            return _UNKNOWN
+        visiting.add(id(op))
+        if isinstance(op, Source):
+            spec = source_spec(op)
+        else:
+            spec = out_spec(op, in_of(op))
+        visiting.discard(id(op))
+        out_cache[id(op)] = spec
+        return spec
+
+    for op in ops:
+        out_of(op)      # force every operator's kernel checks
+
+
+def _check_ffat_comb(op, agg, diags) -> None:
+    """FFAT comb folds *lifted aggregates*: (agg, agg) -> agg with the
+    lift's structure preserved (WF105)."""
+    import jax
+    out, err = _eval(op.comb, agg, agg)
+    if err is not None:
+        diags.append(Diagnostic(
+            "WF105",
+            f"operator '{op.name}': window combiner failed abstract "
+            f"evaluation over the lifted aggregate — {err}",
+            node=op.name))
+        return
+    if not _same_struct(agg, out):
+        diags.append(Diagnostic(
+            "WF105",
+            f"operator '{op.name}': window combiner must return the "
+            f"lift's aggregate structure ({jax.tree.structure(agg)}), "
+            f"got {jax.tree.structure(out)}", node=op.name))
+        return
+    drift = _leaf_mismatch(agg, out)
+    if drift is not None:
+        diags.append(Diagnostic(
+            "WF105",
+            f"operator '{op.name}': window combiner must preserve the "
+            f"aggregate's shapes and dtypes: {drift}", node=op.name))

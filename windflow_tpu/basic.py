@@ -185,6 +185,12 @@ class Config:
     # argsort regardless — the counting ids are int32.
     ffat_grouping: str = os.environ.get("WF_TPU_FFAT_GROUPING",
                                         "rank_scatter")
+    # Pre-flight static analysis (windflow_tpu/analysis): PipeGraph.start()
+    # runs PipeGraph.check() — abstract evaluation of the whole graph, zero
+    # device work — and "error" fails fast with the FULL list of
+    # error-severity diagnostics (warnings are warned), "warn" downgrades
+    # everything to warnings, "off" skips the pass entirely.
+    preflight: str = os.environ.get("WF_TPU_PREFLIGHT", "error")
     # Multi-chip execution: a jax.sharding.Mesh with ("data", "key") axes
     # (see windflow_tpu.parallel.mesh.make_mesh).  When set, staging emitters
     # lay batches out data-sharded across the mesh and mesh-aware TPU

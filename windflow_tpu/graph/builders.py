@@ -111,11 +111,21 @@ class Source_Builder(_BuilderBase):
         super().__init__()
         self._gen_fn = gen_fn
         self._ts_extractor = None
+        self._record_spec = None
 
     def withTimestampExtractor(self, fn: Callable[[Any], int]):
         """EVENT-time sources: extract the event timestamp (µs) from each
         generated item (reference: ``Source_Shipper::pushWithTimestamp``)."""
         self._ts_extractor = fn
+        return self
+
+    def withRecordSpec(self, example: Any):
+        """Declare the records this source emits — an example record
+        (pytree of scalars/arrays) or a pytree of ``jax.ShapeDtypeStruct``
+        — so ``PipeGraph.check()`` can abstractly evaluate every
+        downstream kernel before dispatch (docs/ANALYSIS.md).  Static
+        metadata only: never fed to the generator."""
+        self._record_spec = example
         return self
 
     def withKeyBy(self, *_):
@@ -128,7 +138,8 @@ class Source_Builder(_BuilderBase):
         return Source(self._gen_fn, name=self._name,
                       parallelism=self._parallelism,
                       output_batch_size=self._output_batch_size,
-                      ts_extractor=self._ts_extractor)
+                      ts_extractor=self._ts_extractor,
+                      record_spec=self._record_spec)
 
 
 class DeviceSource_Builder(_BuilderBase):

@@ -98,6 +98,10 @@ class PipeGraph:
         self._pool = None
         self._pool_replicas = []
         self._main_replicas = []
+        # pre-flight analysis (windflow_tpu/analysis): last check()'s
+        # diagnostics + wall cost, surfaced through stats() and bench.py
+        self._preflight_diags = None
+        self._preflight_ms = None
 
     # -- construction --------------------------------------------------------
     def add_source(self, source: Source) -> MultiPipe:
@@ -137,47 +141,16 @@ class PipeGraph:
         state layout tied to ONE batch capacity) fed by several upstream
         paths — a merge relayed through capacity-preserving TPU stages —
         must see ONE capacity; surface the mismatch at build time with the
-        offending sizes instead of a mid-run step error."""
-        upstreams = {}
-        for edge in self._edges():
-            if edge[0] == "op":
-                _, a, b = edge
-                upstreams.setdefault(id(b), (b, []))[1].append(a)
-            else:  # split: each child's head is fed by the split source
-                _, mp = edge
-                src_op = mp.operators[-1]
-                for child in mp.split_children:
-                    if child.operators:
-                        head = child.operators[0]
-                        upstreams.setdefault(
-                            id(head), (head, []))[1].append(src_op)
-
-        def effective_caps(op, seen=None):
-            # capacity a device batch arrives with: host ops stamp their
-            # output_batch_size; TPU ops pass their input capacity through
-            seen = seen or set()
-            if id(op) in seen:
-                return set()
-            seen.add(id(op))
-            if not op.is_tpu:
-                return {op.output_batch_size}
-            caps = set()
-            for up in upstreams.get(id(op), (None, []))[1]:
-                caps |= effective_caps(up, seen)
-            return caps
-
-        for _, (op, ups) in upstreams.items():
-            label = op.fixed_capacity_label
-            if label is not None:
-                caps = set()
-                for up in ups:
-                    caps |= effective_caps(up)
-                if len(caps) > 1:
-                    raise WindFlowError(
-                        f"'{op.name}' ({label}) compiles for one "
-                        f"fixed batch capacity but its upstream paths "
-                        f"deliver {sorted(caps)}; give the merged branches "
-                        "equal withOutputBatchSize")
+        offending sizes instead of a mid-run step error.  (Backstop for
+        ``Config.preflight="off"`` runs: the walk itself lives in
+        analysis/preflight.py, where :meth:`check` reports it as WF403.)"""
+        from windflow_tpu.analysis.preflight import capacity_conflicts
+        for op, label, caps in capacity_conflicts(self):
+            raise WindFlowError(
+                f"'{op.name}' ({label}) compiles for one "
+                f"fixed batch capacity but its upstream paths "
+                f"deliver {sorted(caps)}; give the merged branches "
+                "equal withOutputBatchSize")
 
     def _edges(self):
         """Yield (src_op, dst_op_or_split, routing) for every graph edge, in
@@ -215,7 +188,11 @@ class PipeGraph:
                 self._source_replicas.extend(op.replicas)
         for rep in self._all_replicas:
             rep.config = self.config
-        self._check_fixed_capacity_ops()
+        if getattr(self.config, "preflight", "error") == "off":
+            # preflight reported capacity conflicts already (WF403: raised
+            # under "error", warned under "warn" — the promised bypass);
+            # only an "off" run needs the original hard build-time check
+            self._check_fixed_capacity_ops()
 
         # 2. wire edges: emitters on sources of the edge, collectors +
         #    channels on destinations
@@ -332,9 +309,46 @@ class PipeGraph:
         self._finalize()
         return self
 
+    # -- static analysis (windflow_tpu/analysis) -----------------------------
+    def check(self) -> list:
+        """Pre-flight static analysis of the composed graph: abstract
+        evaluation of every operator chain (``jax.eval_shape`` on the user
+        kernels — zero device work), window-spec consistency, keyby/mesh
+        shard-divisibility, and watermark-mode compatibility across
+        merge/split points.  Returns the FULL list of
+        :class:`~windflow_tpu.analysis.Diagnostic` findings (never just
+        the first); ``start()`` runs it automatically under
+        ``Config.preflight`` and ``tools/wf_check.py`` wraps it as a CLI."""
+        from windflow_tpu.analysis.preflight import check_graph
+        t0 = time.perf_counter()
+        diags = check_graph(self)
+        self._preflight_ms = round((time.perf_counter() - t0) * 1e3, 3)
+        self._preflight_diags = diags
+        return diags
+
+    def _run_preflight(self) -> None:
+        mode = getattr(self.config, "preflight", "error")
+        if mode not in ("error", "warn", "off"):
+            raise WindFlowError(
+                f"Config.preflight must be 'error', 'warn' or 'off', "
+                f"got {mode!r}")
+        if mode == "off":
+            return
+        import warnings
+        from windflow_tpu.analysis.diagnostics import (PreflightError,
+                                                       PreflightWarning)
+        diags = self.check()
+        errors = [d for d in diags if d.severity == "error"]
+        for d in diags:
+            if d.severity != "error" or mode == "warn":
+                warnings.warn(str(d), PreflightWarning, stacklevel=3)
+        if errors and mode == "error":
+            raise PreflightError(errors)
+
     def start(self) -> None:
         if self._started:
             raise WindFlowError("PipeGraph already started")
+        self._run_preflight()
         self._started = True
         self._build()
         try:
@@ -622,6 +636,15 @@ class PipeGraph:
             "Flight_recorder": (self._recorder.summary()
                                 if self._recorder is not None
                                 else {"enabled": False}),
+            # pre-flight analysis (windflow_tpu/analysis): check() cost +
+            # finding counts, so preflight stays visible in every dump
+            "Preflight": {
+                "mode": getattr(self.config, "preflight", "error"),
+                "check_ms": self._preflight_ms,
+                "diagnostics": (None if self._preflight_diags is None
+                                else [str(d) for d in
+                                      self._preflight_diags]),
+            },
             "Latency": self._latency_section(),
             "Gauges": self.gauges(),
             "Operators": [op.dump_stats() for op in self._operators],

@@ -103,6 +103,8 @@ def to_svg(graph) -> str:
             out = subprocess.run(["dot", "-Tsvg"], input=dot.encode(),
                                  capture_output=True, timeout=10, check=True)
             return out.stdout.decode()
-        except Exception:
+        except (OSError, subprocess.SubprocessError, UnicodeDecodeError):
+            # graphviz missing/broken/timed out: the hand-rolled fallback
+            # SVG below is always available
             pass
     return _fallback_svg(graph)

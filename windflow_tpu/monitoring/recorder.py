@@ -50,6 +50,8 @@ from typing import List, Optional
 
 import numpy as np
 
+from windflow_tpu.analysis import debug_concurrency as _dbg
+from windflow_tpu.analysis.hotpath import hot_path
 from windflow_tpu.basic import current_time_usecs
 
 #: span stage codes (ring buffers store the code, exports the name)
@@ -85,6 +87,7 @@ class LatencyHistogram:
         self.min = float("inf")
         self.max = 0.0
 
+    @hot_path
     def add(self, usec: float) -> None:
         if usec < 0:
             usec = 0.0
@@ -167,7 +170,19 @@ class ReplicaRing:
         self.t = np.zeros(self.size, np.int64)
         self.n = 0          # total events ever recorded (wraps the index)
 
+    @hot_path
     def record(self, trace_id: int, stage: int, t_usec: int) -> None:
+        if _dbg.ENABLED:
+            # the lock-free write is safe ONLY because one thread drains a
+            # replica at a time; overlapping record()s are the race the
+            # debug mode turns into a diagnostic (context-managed so an
+            # exception cannot leave a stale guard entry)
+            with _dbg.entry_guard(self, "ReplicaRing.record"):
+                return self._record_impl(trace_id, stage, t_usec)
+        return self._record_impl(trace_id, stage, t_usec)
+
+    @hot_path
+    def _record_impl(self, trace_id: int, stage: int, t_usec: int) -> None:
         i = self.n % self.size
         self.trace[i] = trace_id
         self.stage[i] = stage

@@ -17,6 +17,7 @@ from __future__ import annotations
 import dataclasses
 import time
 
+from windflow_tpu.analysis import debug_concurrency as _dbg
 from windflow_tpu.basic import current_time_usecs
 from windflow_tpu.monitoring.recorder import LatencyHistogram
 
@@ -55,6 +56,11 @@ class StatsRecord:
     _t0: float = 0.0
 
     def start_sample(self) -> None:
+        if _dbg.ENABLED:
+            # a stats record belongs to one replica whose processing is
+            # single-consumer; an overlapping sample bracket from another
+            # thread means two threads are driving the same replica
+            _dbg.enter(self, "StatsRecord.start_sample")
         self._t0 = time.perf_counter()
 
     def end_sample(self) -> None:
@@ -62,6 +68,8 @@ class StatsRecord:
         self.service_time_usec += dur
         self.num_service_samples += 1
         self.service_hist.add(dur)
+        if _dbg.ENABLED:
+            _dbg.exit_(self)
 
     def avg_service_time_usec(self) -> float:
         if self.num_service_samples == 0:

@@ -62,7 +62,9 @@ def _build() -> bool:
             os.replace(built, final)
             _SO_PATH = final
             return True
-    except Exception:
+    except (OSError, subprocess.SubprocessError):
+        # no toolchain / read-only everything / make failure: callers fall
+        # back to the numpy implementations
         return False
 
 

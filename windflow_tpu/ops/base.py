@@ -22,6 +22,7 @@ import threading
 from collections import deque
 from typing import Any, Callable, List, Optional
 
+from windflow_tpu.analysis import debug_concurrency as _dbg
 from windflow_tpu.basic import (ExecutionMode, RoutingMode, TimePolicy,
                                 WindFlowError, current_time_usecs,
                                 default_config)
@@ -40,6 +41,12 @@ class Replica:
     #: (multicast) tuples before processing (reference ``copyOnWrite``,
     #: ``map.hpp:57-215``)
     copy_on_shared = False
+
+    #: lock discipline declaration enforced by tools/wf_lint.py (WF721):
+    #: the in-transit device-batch counter mutates only under its lock
+    #: (deliberately lock-free READS live in PipeGraph._backpressured —
+    #: the discipline covers this class's own accesses)
+    __lock_guards__ = {"_inflight_lock": ("inflight_device",)}
 
     def __init__(self, op: "Operator", index: int) -> None:
         self.op = op
@@ -95,6 +102,15 @@ class Replica:
         driver bounds per-sweep work so sibling replicas interleave fairly,
         approximating the reference's thread-parallel arrival order).
         Returns True if any progress was made."""
+        if _dbg.ENABLED:
+            # single-consumer contract: the driver/pool schedules at most
+            # one drain per replica at a time (the sweep barrier); a
+            # second thread draining concurrently is a scheduler race
+            with _dbg.entry_guard(self, "Replica.drain"):
+                return self._drain_impl(limit)
+        return self._drain_impl(limit)
+
+    def _drain_impl(self, limit: int) -> bool:
         progressed = False
         n = 0
         while self.inbox:
@@ -140,6 +156,18 @@ class Replica:
         self.stats.is_terminated = True
 
     def _dispatch(self, msg) -> None:
+        if _dbg.ENABLED:
+            # the stats sample bracket (start_sample enters a debug guard,
+            # end_sample exits it) spans this whole method; an operator
+            # raising mid-processing must not leave a stale guard entry
+            # that would false-positive a later, unrelated access
+            try:
+                return self._dispatch_impl(msg)
+            finally:
+                _dbg.exit_(self.stats)
+        return self._dispatch_impl(msg)
+
+    def _dispatch_impl(self, msg) -> None:
         if isinstance(msg, Punctuation):
             self._advance_wm(msg.watermark)
             self._maybe_hook_wm()

@@ -145,8 +145,14 @@ class Source(Operator):
 
     def __init__(self, gen_fn: Callable[..., Iterable], name: str = "source",
                  parallelism: int = 1, output_batch_size: int = 0,
-                 ts_extractor: Optional[Callable[[Any], int]] = None) -> None:
+                 ts_extractor: Optional[Callable[[Any], int]] = None,
+                 record_spec: Optional[Any] = None) -> None:
         super().__init__(name, parallelism, routing=RoutingMode.NONE,
                          output_batch_size=output_batch_size)
         self.gen_fn = gen_fn
         self.ts_extractor = ts_extractor
+        #: abstract record declaration for the pre-flight checker
+        #: (analysis/preflight.py): an example record, or a pytree of
+        #: jax.ShapeDtypeStruct.  Purely static — never fed to gen_fn;
+        #: None leaves downstream kernel checks skipped.
+        self.record_spec = record_spec

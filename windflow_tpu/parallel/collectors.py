@@ -21,6 +21,7 @@ import heapq
 from collections import deque
 from typing import List
 
+from windflow_tpu.analysis.hotpath import hot_path
 from windflow_tpu.basic import ExecutionMode
 from windflow_tpu.batch import DeviceBatch, HostBatch, Punctuation, WM_NONE
 
@@ -81,6 +82,7 @@ class WatermarkCollector(Collector):
     def _frontier(self) -> int:
         return self._fold(self._wms)
 
+    @hot_path
     def on_message(self, channel, msg):
         wm = msg.watermark
         if wm != WM_NONE and wm > self._wms[channel]:

@@ -33,6 +33,7 @@ import jax
 import numpy as np
 
 from windflow_tpu import staging
+from windflow_tpu.analysis.hotpath import hot_path
 from windflow_tpu.basic import RoutingMode, WindFlowError
 from windflow_tpu.batch import (DeviceBatch, HostBatch, Punctuation, WM_NONE,
                                 columns_to_device, host_to_device,
@@ -233,6 +234,7 @@ class _OpenBatch:
         self.tids: list = []
         self.any_tid: bool = False
 
+    @hot_path
     def add(self, item, ts, wm, shared=False, tid=None):
         self.items.append(item)
         self.tss.append(ts)
@@ -256,6 +258,7 @@ class ForwardEmitter(Emitter):
         self._open = [_OpenBatch() for _ in dests]
         self._next = 0
 
+    @hot_path
     def emit(self, item, ts, wm, shared=False, tid=None):
         d = self._next
         self._next = (self._next + 1) % len(self.dests)
@@ -296,6 +299,7 @@ class KeyByEmitter(Emitter):
         self.key_extractor = key_extractor
         self._open = [_OpenBatch() for _ in dests]
 
+    @hot_path
     def emit(self, item, ts, wm, shared=False, tid=None):
         d = stable_hash(self.key_extractor(item)) % len(self.dests)
         ob = self._open[d]
@@ -665,7 +669,10 @@ class KeyedDeviceStageEmitter(Emitter):
                 # int64→int32: the device's int32 truncation first, so
                 # routing collapses exactly the keys the state collapses
                 keys = k.astype(np.int64).astype(np.int32).astype(np.int64)
-        except Exception:
+        except Exception:   # lint: broad-except-ok (speculative
+            # vectorization probe of an arbitrary user extractor — ANY
+            # failure means "not elementwise", handled by the per-row
+            # fallback below)
             pass
         if keys is None:
             # Non-elementwise or scalar-returning extractor: per-row path.
@@ -919,7 +926,9 @@ class SplittingEmitter(Emitter):
             shape = jax.eval_shape(lambda p: jax.vmap(split_fn)(p), payload)
             ok = (getattr(shape, "shape", None) == (capacity,)
                   and jnp.issubdtype(shape.dtype, jnp.integer))
-        except Exception:
+        except Exception:   # lint: broad-except-ok (eval_shape probe of an
+            # arbitrary user split function — ANY failure means "host
+            # per-tuple path", the documented fallback)
             ok = False
         if ok:
             @jax.jit

@@ -42,12 +42,16 @@ where a 4-byte lane contributes 1 word/row and an int64 lane 2 words/row
 
 from __future__ import annotations
 
+import itertools
 import os
 import threading
 from collections import deque
 from typing import Optional, Sequence
 
 import numpy as np
+
+from windflow_tpu.analysis import debug_concurrency as _dbg
+from windflow_tpu.analysis.hotpath import hot_path
 
 #: retained buffers per distinct buffer size (the recycling queue depth);
 #: 4 covers the driver loop's double buffering with margin for the keyed
@@ -83,13 +87,29 @@ class StagingPool:
     counted miss) — and only ever waits on a recycled buffer's gate.
     """
 
+    #: lock discipline declaration enforced by tools/wf_lint.py (WF721):
+    #: the slot dict and retained-byte counter mutate only under _lock
+    __lock_guards__ = {"_lock": ("_slots", "_held_bytes")}
+
     def __init__(self, depth: int = DEFAULT_DEPTH,
                  max_bytes: int = DEFAULT_MAX_BYTES) -> None:
         self.depth = max(1, depth)
         self.max_bytes = max_bytes
-        self._slots: dict = {}          # nwords -> deque[(buf, gate)]
         self._held_bytes = 0
-        self._lock = threading.Lock()
+        if _dbg.ENABLED:
+            # race detector (analysis/debug_concurrency): the lock records
+            # its owning thread and every mutation of _slots AND of the
+            # slot deques it hands out asserts it is held — silent
+            # unlocked writes become immediate diagnostics
+            self._lock = _dbg.DebugLock("StagingPool._lock")
+            self._slots = _dbg.LockCheckedDict(self._lock,
+                                               "StagingPool._slots")
+            self._new_slot = lambda: _dbg.LockCheckedDeque(
+                self._lock, "StagingPool._slots slot deque")
+        else:
+            self._slots = {}        # nwords -> deque[(buf, gate)]
+            self._lock = threading.Lock()
+            self._new_slot = deque
         # counters (exposed via stats() and the PipeGraph monitoring dump)
         self.hits = 0
         self.misses = 0
@@ -119,7 +139,10 @@ class StagingPool:
             ready = True
             try:
                 ready = bool(gate.is_ready())
-            except Exception:
+            except (AttributeError, RuntimeError, TypeError):
+                # gate arrays are backend-supplied: deleted buffers raise
+                # RuntimeError, non-jax gates lack is_ready — treat any of
+                # these as "not provably ready" and sync below
                 ready = False
             if not ready:
                 self.gate_waits += 1
@@ -134,7 +157,7 @@ class StagingPool:
         buffer is dropped instead of pooled — allocation pressure, never
         blocking."""
         with self._lock:
-            dq = self._slots.setdefault(buf.shape[0], deque())
+            dq = self._slots.setdefault(buf.shape[0], self._new_slot())
             if len(dq) >= self.depth \
                     or self._held_bytes + buf.nbytes > self.max_bytes:
                 self.drops += 1
@@ -148,6 +171,8 @@ class StagingPool:
         """Counter snapshot for the monitoring stats layer
         (``PipeGraph.stats()["Staging_pool"]``)."""
         total = self.hits + self.misses
+        with self._lock:
+            held = self._held_bytes
         return {
             "hits": self.hits,
             "misses": self.misses,
@@ -155,7 +180,7 @@ class StagingPool:
             "releases": self.releases,
             "drops_at_capacity": self.drops,
             "gate_waits": self.gate_waits,
-            "held_bytes": self._held_bytes,
+            "held_bytes": held,
             "depth": self.depth,
         }
 
@@ -202,7 +227,7 @@ class PackedBatchBuilder:
     """
 
     __slots__ = ("capacity", "dtypes", "_words", "_offsets", "total",
-                 "buf", "n", "pool")
+                 "buf", "n", "pool", "_lane_dtypes")
 
     def __init__(self, dtypes: Sequence, capacity: int,
                  pool: Optional[StagingPool] = None) -> None:
@@ -210,6 +235,9 @@ class PackedBatchBuilder:
         self.dtypes = tuple(np.dtype(d) for d in dtypes)
         if not all(packable_dtype(d) for d in self.dtypes):
             raise ValueError(f"unpackable lane dtypes {self.dtypes}")
+        # payload dtypes + the implicit int64 ts lane, precomputed so the
+        # @hot_path append builds nothing per call
+        self._lane_dtypes = self.dtypes + (np.dtype(np.int64),)
         self._words = [lane_words(d) for d in self.dtypes] + [2]  # + ts
         self._offsets = []
         off = 0
@@ -225,25 +253,46 @@ class PackedBatchBuilder:
     def room(self) -> int:
         return self.capacity - self.n
 
+    @hot_path
     def append(self, lanes: Sequence[np.ndarray], tss: np.ndarray) -> None:
         """Write ``len(tss)`` rows: ``lanes`` are 1-D payload columns in
         ``dtypes`` order, ``tss`` the int64 timestamps.  Slices of
         contiguous source columns view as uint32 without copying."""
+        if _dbg.ENABLED:
+            # a builder is single-consumer: one replica's emitter fills it
+            # (possibly from different pool threads across sweeps, never
+            # concurrently) — overlapping appends are a race.  The guard
+            # is a context manager so a mid-append exception cannot leave
+            # a stale entry behind.
+            with _dbg.entry_guard(self, "PackedBatchBuilder.append"):
+                return self._append_impl(lanes, tss)
+        return self._append_impl(lanes, tss)
+
+    @hot_path
+    def _append_impl(self, lanes, tss) -> None:
         m = len(tss)
         for off, w, dt, lane in zip(self._offsets, self._words,
-                                    self.dtypes + (np.dtype(np.int64),),
-                                    list(lanes) + [tss]):
+                                    self._lane_dtypes,
+                                    itertools.chain(lanes, (tss,))):
             src = np.ascontiguousarray(lane, dt).view(np.uint32)
             lo = off + w * self.n
             self.buf[lo:lo + w * m] = src
         self.n += m
 
+    @hot_path
     def finish(self) -> np.ndarray:
         """Zero each lane's unwritten tail (recycled buffers carry stale
         words; the old per-batch ``np.zeros`` padded with zeros, and
         downstream equality depends on it only for partial batches), stamp
         the fill count, and hand the buffer over.  The caller owns it
         until ``pool.release(buf, gate)``."""
+        if _dbg.ENABLED:
+            with _dbg.entry_guard(self, "PackedBatchBuilder.finish"):
+                return self._finish_impl()
+        return self._finish_impl()
+
+    @hot_path
+    def _finish_impl(self) -> np.ndarray:
         if self.n < self.capacity:
             for off, w in zip(self._offsets, self._words):
                 self.buf[off + w * self.n:off + w * self.capacity] = 0
