@@ -27,6 +27,11 @@ import sys
 import time
 from typing import Optional
 
+# device-plane observability: the bench opts into the full compiled-HLO
+# cost analysis (the compile watcher's default is the cheap "lowered"
+# estimate — tier-1 wall budget); must be set before windflow_tpu import
+os.environ.setdefault("WF_TPU_COST_ANALYSIS", "compiled")
+
 TPU_PROBE_TIMEOUT_S = int(os.environ.get("BENCH_TPU_TIMEOUT", "150"))
 TPU_PROBE_RETRIES = int(os.environ.get("BENCH_TPU_RETRIES", "1"))
 HISTORY_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
@@ -1081,6 +1086,37 @@ def main() -> None:
         # check_bench_keys loudly instead)
         result["preflight_error"] = f"{type(e).__name__}: {e}"[:200]
 
+    # device-plane section (windflow_tpu/monitoring/jit_registry, guarded
+    # by tools/check_bench_keys.py): the compile watcher's process totals
+    # over every leg above — compile wall cost, recompile events (any
+    # nonzero here is a recompilation-storm regression in the bench
+    # pipelines), plus the window kernel's cost table where the backend
+    # reported one
+    try:
+        from windflow_tpu.monitoring.jit_registry import default_registry
+        reg = default_registry()
+        snap = reg.snapshot()
+        flops = None
+        for name, entry in sorted(snap.items()):
+            f = (entry.get("cost") or {}).get("flops")
+            if not f:
+                continue
+            if flops is None:
+                flops = f           # any-op fallback: first with a cost
+            if "ffat" in name or "win" in name:
+                flops = f           # prefer the window kernel's number
+                break
+        totals = reg.totals()
+        result["device"] = {"ops_compiled": totals["ops_compiled"],
+                            "compiles": totals["compiles"],
+                            "recompiles": totals["recompiles"],
+                            "compile_ms_total": totals["compile_ms_total"],
+                            "flops_per_batch": flops}
+    except Exception as e:  # lint: broad-except-ok (same stance as the
+        # preflight leg: a watcher regression must fail check_bench_keys,
+        # not kill the bench artifact)
+        result["device_error"] = f"{type(e).__name__}: {e}"[:200]
+
     now = time.time()
     hist = load_history()
     runs = hist.setdefault(platform, [])
@@ -1124,6 +1160,7 @@ def main() -> None:
                  "p99_batch_latency_ms": result["p99_batch_latency_ms"],
                  "latency": result.get("latency"),
                  "preflight": result.get("preflight"),
+                 "device": result.get("device"),
                  "e2e": result.get("e2e"),
                  "e2e_device_source": result.get("e2e_device_source"),
                  "ysb": result.get("ysb"),

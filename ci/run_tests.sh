@@ -14,14 +14,15 @@ python tools/wf_lint.py
 # fast tier-1 gate: the staging-plane contracts (pool reuse, fused
 # transfer round-trip, prefetch ordering), the observability contracts
 # (histogram percentile math, trace-export schema, recorder-off zero-cost,
-# the <2% overhead budget), and the analysis contracts (preflight
-# diagnostic codes, wf_lint fixtures, debug-mode race detector) fail in
-# seconds, before the full suite spends minutes.  The full-suite run
-# below repeats them — accepted: the gate's job is fast failure, and
-# keeping the full suite unfiltered means its pass count stays comparable
-# with the tier-1 gate's.
+# the <2% overhead budget), the analysis contracts (preflight diagnostic
+# codes, wf_lint fixtures, debug-mode race detector), and the
+# device-plane contracts (compile watcher, OpenMetrics exposition,
+# HBM-gauge CPU guard) fail in seconds, before the full suite spends
+# minutes.  The full-suite run below repeats them — accepted: the gate's
+# job is fast failure, and keeping the full suite unfiltered means its
+# pass count stays comparable with the tier-1 gate's.
 python -m pytest tests/test_staging.py tests/test_observability.py \
-    tests/test_analysis.py -q -m 'not slow'
+    tests/test_analysis.py tests/test_device_metrics.py -q -m 'not slow'
 python -m pytest tests/ -q
 python __graft_entry__.py 8
 BENCH_PLATFORM=cpu BENCH_E2E_TUPLES=131072 python bench.py | tee bench_ci_out.txt

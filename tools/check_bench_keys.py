@@ -33,6 +33,13 @@ section whose ``check_ms`` times ``PipeGraph.check()`` over the
 representative e2e pipeline — every ``start()`` now pays that cost, so
 it must stay visible in bench_history.json (docs/ANALYSIS.md).  Guarded
 here identically.
+
+Since the device-plane round the bench also publishes a ``device``
+section from the compile watcher (``compile_ms_total``, ``recompiles``,
+``flops_per_batch`` where the backend reports cost analysis —
+docs/OBSERVABILITY.md "Device plane").  ``recompiles`` doubles as a
+regression tripwire: the bench pipelines pad to fixed capacities, so any
+nonzero value is a shape-drift bug.  Guarded here identically.
 """
 
 import json
@@ -42,6 +49,7 @@ import sys
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 KEYS = ("ratio_vs_kernel", "staging_share_of_staged_run")
 LATENCY_KEYS = ("batch_p99_ms", "e2e_p50_ms", "e2e_p99_ms")
+DEVICE_KEYS = ("compile_ms_total", "recompiles", "flops_per_batch")
 
 
 def fail(msg: str) -> None:
@@ -64,8 +72,13 @@ def check_source() -> None:
     if '"preflight"' not in src or '"check_ms"' not in src:
         fail("bench.py no longer emits the preflight section "
              "('preflight'/'check_ms' — docs/ANALYSIS.md contract)")
+    missing = [k for k in ("device", "flops_per_batch") if f'"{k}"' not in src]
+    if missing or "compile_ms_total" not in src:
+        fail(f"bench.py no longer emits the device section keys "
+             f"{missing or ['compile_ms_total']} (compile watcher — "
+             "docs/OBSERVABILITY.md device-plane contract)")
     print("check_bench_keys: OK (bench.py source emits "
-          + ", ".join(KEYS + ("latency", "preflight")) + ")")
+          + ", ".join(KEYS + ("latency", "preflight", "device")) + ")")
 
 
 def last_json_object(path: str):
@@ -118,6 +131,22 @@ def check_output(path: str) -> None:
         fail("'latency' section missing from bench output")
     if "batch_p99_ms" not in lat:
         fail("'latency.batch_p99_ms' missing from bench output")
+    dev_sec = result.get("device")
+    if isinstance(dev_sec, dict):
+        missing = [k for k in DEVICE_KEYS if k not in dev_sec]
+        if missing:
+            fail(f"'device' section missing {missing} from bench output")
+        if dev_sec.get("recompiles"):
+            # fixed-capacity pipelines must never re-trace: a nonzero
+            # recompile count is the shape-drift regression the compile
+            # watcher exists to catch
+            fail(f"bench run recompiled {dev_sec['recompiles']} time(s) — "
+                 "recompilation storm in a fixed-capacity pipeline")
+    else:
+        # like preflight, the watcher is environment-independent: its
+        # absence IS the observability regression this guard catches
+        fail("bench device section absent or errored "
+             f"(device_error={result.get('device_error')!r})")
     pf = result.get("preflight")
     if isinstance(pf, dict):
         if "check_ms" not in pf:

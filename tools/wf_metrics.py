@@ -1,0 +1,135 @@
+#!/usr/bin/env python
+"""wf_metrics: standalone OpenMetrics exporter for windflow_tpu stats.
+
+Renders a ``PipeGraph.stats()`` JSON dump (what ``dump_stats()`` writes,
+or any ``/apps/<id>/latest`` dashboard payload) in Prometheus text
+exposition format — the offline counterpart of the dashboard's live
+``GET /metrics`` endpoint.  Loads ``monitoring/openmetrics.py``
+file-direct (pure stdlib), so it runs on scrape/relay hosts with no jax
+installed.
+
+Usage::
+
+    python tools/wf_metrics.py log/app_stats.json            # render
+    python tools/wf_metrics.py log/app_stats.json --check    # render,
+        # then re-parse with the strict exposition parser: exit 1 on any
+        # format violation (escaping, bucket monotonicity, typing)
+    python tools/wf_metrics.py --check http://localhost:20208/metrics
+        # validate a live dashboard endpoint instead of a file
+    python tools/wf_metrics.py log/app_stats.json --serve 9100
+        # tiny exporter: GET /metrics re-reads + re-renders the file per
+        # scrape (point a Prometheus job at it)
+
+The CI golden-format tests (tests/test_device_metrics.py) run the same
+``--check`` round trip over a real graph's stats dump.
+"""
+
+from __future__ import annotations
+
+import argparse
+import importlib.util
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_openmetrics():
+    """File-direct import of monitoring/openmetrics.py: skips the
+    ``windflow_tpu`` package __init__ (which imports jax)."""
+    path = os.path.join(REPO, "windflow_tpu", "monitoring",
+                        "openmetrics.py")
+    spec = importlib.util.spec_from_file_location("_wf_openmetrics", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _read_source(src: str) -> tuple:
+    """(kind, payload): exposition text from an http(s) URL, stats JSON
+    from a file path or '-' (stdin)."""
+    if src.startswith(("http://", "https://")):
+        import urllib.request
+        with urllib.request.urlopen(src, timeout=10) as r:
+            return "exposition", r.read().decode("utf-8", "replace")
+    text = sys.stdin.read() if src == "-" else open(src).read()
+    return "stats", json.loads(text)
+
+
+def render(stats: dict, om) -> str:
+    return om.render_openmetrics(stats)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("source", help="stats JSON path, '-' for stdin, or an "
+                                   "http(s) /metrics URL (with --check)")
+    ap.add_argument("--check", action="store_true",
+                    help="validate the exposition with the strict parser "
+                         "instead of printing it")
+    ap.add_argument("--serve", type=int, metavar="PORT",
+                    help="serve GET /metrics, re-reading the stats file "
+                         "on every scrape")
+    args = ap.parse_args(argv)
+    om = _load_openmetrics()
+
+    kind, payload = _read_source(args.source)
+    if kind == "exposition":
+        if not args.check:
+            print("wf_metrics: URL sources are for --check (the endpoint "
+                  "already serves exposition)", file=sys.stderr)
+            return 2
+        text = payload
+    else:
+        text = render(payload, om)
+
+    if args.check:
+        try:
+            families = om.parse_exposition(text)
+        except ValueError as e:
+            print(f"wf_metrics: FAIL: {e}", file=sys.stderr)
+            return 1
+        n = sum(len(f["samples"]) for f in families.values())
+        print(f"wf_metrics: OK ({len(families)} families, {n} samples)")
+        return 0
+
+    if args.serve is not None:
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+        src = args.source
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def do_GET(self):
+                try:
+                    _, stats = _read_source(src)
+                    body = render(stats, om).encode()
+                    code = 200
+                except (OSError, ValueError) as e:
+                    body = f"# wf_metrics error: {e}\n".encode()
+                    code = 500
+                self.send_response(code)
+                self.send_header(
+                    "Content-Type",
+                    "text/plain; version=0.0.4; charset=utf-8")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        server = ThreadingHTTPServer(("0.0.0.0", args.serve), Handler)
+        print(f"wf_metrics: serving {src} at "
+              f"http://0.0.0.0:{server.server_address[1]}/metrics")
+        try:
+            server.serve_forever()
+        except KeyboardInterrupt:
+            pass
+        return 0
+
+    sys.stdout.write(text)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

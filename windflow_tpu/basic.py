@@ -185,6 +185,13 @@ class Config:
     # argsort regardless — the counting ids are int32.
     ffat_grouping: str = os.environ.get("WF_TPU_FFAT_GROUPING",
                                         "rank_scatter")
+    # Profiler bridge (monitoring/device_metrics, docs/OBSERVABILITY.md):
+    # directory PipeGraph.profile(duration_ms) writes its jax.profiler
+    # capture into ("" = "{log_dir}/{name}_xprof").  The capture lines up
+    # with dump_trace()'s Chrome trace through the per-batch
+    # "op:<name> trace:<id>" TraceAnnotations the dispatch path puts on
+    # sampled (trace-lane) batches.
+    profiler_dir: str = os.environ.get("WF_TPU_PROFILER_DIR", "")
     # Pre-flight static analysis (windflow_tpu/analysis): PipeGraph.start()
     # runs PipeGraph.check() — abstract evaluation of the whole graph, zero
     # device work — and "error" fails fast with the FULL list of

@@ -35,6 +35,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from windflow_tpu import staging
+from windflow_tpu.monitoring.jit_registry import wf_jit
 
 TS_DTYPE = jnp.int64
 #: Watermark value meaning "no watermark yet".
@@ -253,7 +254,7 @@ def _get_unpack(treedef, dtypes, capacity: int):
             n_valid = b[-1].astype(jnp.int32)
             return cols[:-1], cols[-1], \
                 jnp.arange(capacity, dtype=jnp.int32) < n_valid
-        unpack = jax.jit(unpack_fn)
+        unpack = wf_jit(unpack_fn, op_name="staging.unpack")
         _UNPACK_CACHE[key] = unpack
     return unpack
 
@@ -272,6 +273,9 @@ def stage_packed(buf: np.ndarray, treedef, dtypes, capacity: int, n: int,
     unpack = _get_unpack(treedef, dtypes, capacity)
     dbuf = jnp.asarray(buf) if device is None \
         else jax.device_put(buf, device)
+    # device-plane accounting (monitoring/device_metrics): every fused
+    # staging transfer credits the process-wide staged-byte gauge
+    staging.device_bytes.note(buf.nbytes)
     cols, ts, valid = unpack(dbuf)
     if pool is not None:
         pool.release(buf, gate=valid)
@@ -325,9 +329,13 @@ def _stage_soa(soa, tss, n: int, capacity: int, watermark: int,
         # process, desynchronizing sharded state shapes.  The eviction-
         # cadence regrow (SPMD-consistent n_evicted sums) remains the
         # ring's growth path on multi-host meshes.
-        return DeviceBatch(payload, ts, valid, watermark=watermark,
-                           size=None, frontier=frontier,
-                           ts_max=None, ts_min=None, trace=trace)
+        out = DeviceBatch(payload, ts, valid, watermark=watermark,
+                          size=None, frontier=frontier,
+                          ts_max=None, ts_min=None, trace=trace)
+        # device-plane accounting: this process's local shard share of the
+        # assembled global batch (the packed path credits via stage_packed)
+        staging.device_bytes.note(transfer_nbytes(out) // nproc)
+        return out
     packable = (
         device is None or isinstance(device, jax.Device)
     ) and all(l.ndim == 1 and _packable_dtype(l.dtype) for l in leaves)
@@ -355,9 +363,13 @@ def _stage_soa(soa, tss, n: int, capacity: int, watermark: int,
         payload = jax.device_put(payload, device)
         ts = jax.device_put(ts, device)
         valid = jax.device_put(valid, device)
-    return DeviceBatch(payload, ts, valid, watermark=watermark, size=n,
-                       frontier=frontier, ts_max=ts_max, ts_min=ts_min,
-                       trace=trace)
+    out = DeviceBatch(payload, ts, valid, watermark=watermark, size=n,
+                      frontier=frontier, ts_max=ts_max, ts_min=ts_min,
+                      trace=trace)
+    # unpackable-lane fallback (per-lane transfers): still a staged batch
+    # for the device-plane accounting stage_packed credits on the fused path
+    staging.device_bytes.note(transfer_nbytes(out))
+    return out
 
 
 def host_to_device(batch: HostBatch, capacity: Optional[int] = None,
@@ -465,7 +477,7 @@ def _egress_pack(batch: DeviceBatch, leaves, treedef, cap):
             parts.extend(to_words(ts))
             parts.append(vld.astype(jnp.uint32))
             return jnp.concatenate(parts)
-        pack = jax.jit(pack_fn)
+        pack = wf_jit(pack_fn, op_name="staging.egress_pack")
         _EGRESS_PACK_CACHE[key] = pack
     return pack(leaves, batch.ts, batch.valid), specs
 

@@ -102,6 +102,10 @@ class PipeGraph:
         # diagnostics + wall cost, surfaced through stats() and bench.py
         self._preflight_diags = None
         self._preflight_ms = None
+        # profiler bridge: directory the last profile() capture actually
+        # landed in, so dump_trace()'s cross-reference points at a real
+        # capture even when profile(log_dir=...) overrode the config
+        self._last_profile_dir = None
 
     # -- construction --------------------------------------------------------
     def add_source(self, source: Source) -> MultiPipe:
@@ -570,13 +574,45 @@ class PipeGraph:
         return {"service_usec_per_operator": per_op,
                 "end_to_end_usec": e2e.quantiles()}
 
+    def profile(self, duration_ms: float = 1000.0,
+                log_dir: Optional[str] = None) -> str:
+        """Profiler bridge: capture a ``jax.profiler`` device trace while
+        driving the started graph for ``duration_ms`` (or until it
+        finishes).  The capture lands in ``log_dir`` /
+        ``Config.profiler_dir`` (default ``{log_dir}/{name}_xprof``) as a
+        TensorBoard/Perfetto ``plugins/profile`` directory; because the
+        dispatch path wraps every sampled trace-lane batch in a
+        ``TraceAnnotation("op:<name> trace:<id>")`` (ops/tpu.py), the XLA
+        device spans in that capture line up with :meth:`dump_trace`'s
+        flight-recorder spans by trace id.  Returns the capture
+        directory."""
+        if not self._started:
+            raise WindFlowError("profile() needs a started graph — call "
+                                "start() first (run() profiles nothing: "
+                                "it returns only when the graph is done)")
+        import jax.profiler
+        d = log_dir or self.config.profiler_dir \
+            or os.path.join(self.config.log_dir, f"{self.name}_xprof")
+        os.makedirs(d, exist_ok=True)
+        self._last_profile_dir = d
+        jax.profiler.start_trace(d)
+        try:
+            deadline = time.monotonic() + duration_ms / 1e3
+            while time.monotonic() < deadline and not self.is_done():
+                if not self.step():
+                    break
+        finally:
+            jax.profiler.stop_trace()
+        return d
+
     def dump_trace(self, path: Optional[str] = None) -> str:
         """Write the flight recorder's span events as Chrome-trace JSON
         (``{name}_trace.json`` under ``Config.log_dir``), loadable in
-        ``chrome://tracing`` / Perfetto next to a ``jax.profiler`` capture;
-        the raw events ride along as ``{name}_events.json`` for offline
-        re-export through ``tools/trace_export.py``.  Returns the trace
-        path."""
+        ``chrome://tracing`` / Perfetto next to a ``jax.profiler`` capture
+        (``otherData`` carries the annotation format + capture directory
+        that cross-reference the two); the raw events ride along as
+        ``{name}_events.json`` for offline re-export through
+        ``tools/trace_export.py``.  Returns the trace path."""
         if self._recorder is None:
             raise WindFlowError(
                 "flight recorder is off (Config.flight_recorder) or the "
@@ -586,7 +622,14 @@ class PipeGraph:
         os.makedirs(d, exist_ok=True)
         path = path or os.path.join(d, f"{self.name}_trace.json")
         events = self._recorder.events()
-        write_chrome_trace(events, path)
+        write_chrome_trace(events, path, metadata={
+            # profiler-bridge cross-reference: the jax.profiler capture's
+            # device spans carry these annotations for the same trace ids
+            "profiler_annotation_format": "op:<operator> trace:<trace_id>",
+            "profiler_dir": self._last_profile_dir
+            or self.config.profiler_dir
+            or os.path.join(self.config.log_dir, f"{self.name}_xprof"),
+        })
         root, ext = os.path.splitext(path)
         base = root[:-len("_trace")] if root.endswith("_trace") else root
         with open(f"{base}_events{ext or '.json'}", "w") as f:
@@ -647,8 +690,23 @@ class PipeGraph:
             },
             "Latency": self._latency_section(),
             "Gauges": self.gauges(),
+            # device plane (monitoring/device_metrics.py): compile-watcher
+            # per-op table, HBM/live-buffer gauges, staging-attributed
+            # device bytes — the ``"Device"`` half of the telemetry story
+            "Device": self._device_section(),
             "Operators": [op.dump_stats() for op in self._operators],
         }
+
+    def _device_section(self) -> dict:
+        """Guarded: a metrics read must never take the pipeline down
+        (same stance as the monitoring thread's quiet switch-off)."""
+        from windflow_tpu.monitoring import device_metrics
+        try:
+            return device_metrics.device_section(self)
+        except Exception as e:  # lint: broad-except-ok (backend probes —
+            # memory_stats/live_arrays — may fail arbitrarily on exotic
+            # runtimes; telemetry degrades, the report still ships)
+            return {"error": f"{type(e).__name__}: {e}"[:200]}
 
     def dump_stats(self, log_dir: Optional[str] = None) -> str:
         d = log_dir or self.config.log_dir

@@ -31,6 +31,7 @@ import jax.numpy as jnp
 from windflow_tpu.basic import RoutingMode, TimePolicy, WindFlowError, \
     current_time_usecs
 from windflow_tpu.batch import DeviceBatch
+from windflow_tpu.monitoring.jit_registry import wf_jit
 from windflow_tpu.ops.base import Operator
 from windflow_tpu.ops.source import BaseSourceReplica, Source
 
@@ -63,7 +64,7 @@ class DeviceSourceReplica(BaseSourceReplica):
                   else jnp.full((cap,), base_ts, jnp.int64))
             return payload, ts, jnp.ones((cap,), bool)
 
-        self._jit = jax.jit(program)
+        self._jit = wf_jit(program, op_name=self.op.name)
 
     def tick(self, max_items: int) -> bool:
         """One device batch per tick (``max_items`` is a host-tuple notion;

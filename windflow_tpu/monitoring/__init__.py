@@ -7,7 +7,16 @@ in docs/OBSERVABILITY.md)."""
 from windflow_tpu.monitoring.dashboard import DashboardServer
 from windflow_tpu.monitoring.diagram import to_dot, to_svg
 from windflow_tpu.monitoring.monitor import MonitoringThread
+from windflow_tpu.monitoring.openmetrics import (parse_exposition,
+                                                 render_openmetrics)
 from windflow_tpu.monitoring.recorder import (FlightRecorder,
                                               LatencyHistogram,
                                               chrome_trace_from_events)
 from windflow_tpu.monitoring.stats import StatsRecord
+
+# The compile watcher (jit_registry.wf_jit) and device gauges
+# (device_metrics) are intentionally NOT re-exported here: both import
+# jax at module scope — import them by full path from code that already
+# owns a backend.  openmetrics stays pure stdlib so tools/wf_metrics.py
+# can load it file-direct without importing the package (no jax on a
+# scrape host).

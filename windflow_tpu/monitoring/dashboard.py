@@ -14,6 +14,9 @@ endpoint serves what the reference exposes via REST
 * ``GET /apps/<id>``         — full report history (JSON)
 * ``GET /apps/<id>/latest``  — most recent report
 * ``GET /apps/<id>/diagram`` — the registered SVG diagram
+* ``GET /metrics``           — Prometheus text exposition of every app's
+  latest report (monitoring/openmetrics.py; point a Prometheus scrape job
+  or ``tools/wf_metrics.py --check`` at it)
 
 Run standalone: ``python -m windflow_tpu.monitoring.dashboard [tcp_port
 [http_port]]``.
@@ -142,6 +145,26 @@ class DashboardServer:
                     self.send_response(200)
                     self.send_header("Content-Type",
                                      "text/html; charset=utf-8")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                    return
+                if parts == ["metrics"]:
+                    # snapshot under the lock, render OUTSIDE it (same
+                    # stance as the JSON endpoints below)
+                    with server._lock:
+                        latest = [(a.ident, a.name, a.reports[-1])
+                                  for a in server.apps.values()
+                                  if a.reports]
+                    from windflow_tpu.monitoring.openmetrics import \
+                        render_openmetrics_multi
+                    body = render_openmetrics_multi(
+                        [({"app": name, "app_id": str(ident)}, report)
+                         for ident, name, report in latest]).encode()
+                    self.send_response(200)
+                    self.send_header(
+                        "Content-Type",
+                        "text/plain; version=0.0.4; charset=utf-8")
                     self.send_header("Content-Length", str(len(body)))
                     self.end_headers()
                     self.wfile.write(body)

@@ -1,0 +1,104 @@
+"""Device-plane gauges: HBM occupancy, live buffers, compile telemetry.
+
+The host plane reports through the flight recorder; this module is the
+device half of ``PipeGraph.stats()`` — the ``"Device"`` section shipped
+in every dashboard ``NEW_REPORT`` and rendered by the OpenMetrics layer:
+
+* **jit** — the compile watcher's per-op table (compile count, cumulative
+  compile wall-ms, recompiles, first-compile cost analysis) from
+  :mod:`windflow_tpu.monitoring.jit_registry`.
+* **memory** — ``device.memory_stats()`` per local device.  TPU runtimes
+  report ``bytes_in_use`` / ``peak_bytes_in_use`` / ``bytes_limit``;
+  the CPU backend returns ``None`` — surfaced as-is (the documented
+  guard, pinned by tests/test_device_metrics.py), never a crash.
+* **live_buffers** — count and total bytes of live ``jax.Array``s per
+  device (``jax.live_arrays()``): the HBM number the allocator stats
+  can't give on backends without ``memory_stats``.  Multi-device arrays
+  are attributed to a ``"sharded:N"`` pseudo-device rather than
+  double-counted per shard-holding device.
+* **staging** — the staging plane's device-byte accounting: cumulative
+  packed bytes shipped host→device (``staging.device_bytes``) next to
+  the pool's retained host bytes, so HBM growth can be told apart from
+  host-pool growth at a glance.
+
+Everything here runs at stats cadence (the 1 Hz monitor thread, test
+dumps) — never on the per-batch path — and every backend probe is
+guarded: a metrics read must not take the pipeline down.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+
+def memory_stats_per_device() -> list:
+    """``device.memory_stats()`` for every local device, ``stats=None``
+    where the backend has no allocator stats (CPU)."""
+    import jax
+    out = []
+    for d in jax.local_devices():
+        try:
+            stats = d.memory_stats()
+        except (AttributeError, RuntimeError, NotImplementedError):
+            stats = None
+        if isinstance(stats, dict):
+            stats = {k: v for k, v in stats.items()
+                     if isinstance(v, (int, float))}
+        out.append({"device": str(d), "platform": d.platform,
+                    "stats": stats})
+    return out
+
+
+def live_buffer_gauges() -> dict:
+    """Count/bytes of live device arrays, grouped per device."""
+    import jax
+    per_device: dict = {}
+    count = 0
+    total = 0
+    try:
+        arrays = jax.live_arrays()
+    except (AttributeError, RuntimeError):
+        return {"count": 0, "bytes": 0, "per_device": {},
+                "note": "live_arrays unavailable on this backend"}
+    for a in arrays:
+        try:
+            nbytes = int(a.nbytes)
+            devs = a.devices()
+        except (AttributeError, RuntimeError):
+            continue    # deleted/donated out from under the iteration
+        count += 1
+        total += nbytes
+        key = str(next(iter(devs))) if len(devs) == 1 \
+            else f"sharded:{len(devs)}"
+        slot = per_device.setdefault(key, {"count": 0, "bytes": 0})
+        slot["count"] += 1
+        slot["bytes"] += nbytes
+    return {"count": count, "bytes": total, "per_device": per_device}
+
+
+def device_section(graph: Optional[object] = None) -> dict:
+    """The ``stats()["Device"]`` payload.  ``graph`` supplies the config
+    for the profiler-bridge pointer; the jit/memory/live-buffer gauges
+    are process-scoped (one XLA client per process — same stance as the
+    staging pool)."""
+    from windflow_tpu import staging
+    from windflow_tpu.monitoring.jit_registry import default_registry
+    reg = default_registry()
+    section = {
+        "jit": reg.snapshot(),
+        "jit_totals": reg.totals(),
+        "memory": memory_stats_per_device(),
+        "live_buffers": live_buffer_gauges(),
+        "staging": {
+            "pool_host_held_bytes":
+                staging.default_pool().stats()["held_bytes"],
+            "staged_device_bytes_total":
+                staging.device_bytes.staged_bytes_total,
+            "staged_device_batches_total":
+                staging.device_bytes.staged_batches_total,
+        },
+    }
+    if graph is not None:
+        cfg = getattr(graph, "config", None)
+        section["profiler_dir"] = getattr(cfg, "profiler_dir", "") or None
+    return section

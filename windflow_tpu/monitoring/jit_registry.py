@@ -1,0 +1,334 @@
+"""Compile watcher: :func:`wf_jit`, a drop-in ``jax.jit`` with telemetry.
+
+The flight recorder (monitoring/recorder.py) made the HOST plane legible;
+the ~20 ``jax.jit`` sites across ops/, windows/, parallel/ and the staging
+plane stayed a black box: nothing reported how often a program compiled,
+how long compilation stalled the driver, or — the #1 silent streaming
+killer — when a shape/dtype drift put an operator into a **recompilation
+storm** (every batch pays a multi-ms trace+compile instead of a µs cache
+hit, and the pipeline's latency SLO dies without a single error).
+
+:func:`wf_jit` wraps ``jax.jit`` and feeds a process-wide
+:class:`JitRegistry` (one aggregate entry per ``op_name``, the same
+process-scope stance as ``staging.default_pool``):
+
+* **compile count + wall time** — a call whose input signature (pytree
+  structure + per-leaf shape/dtype) was never seen by this wrapper is
+  timed end to end; the delta is trace+lower+backend-compile (dispatch of
+  a cached program is µs — the timing is dominated by the compile).
+* **recompile events** — a NEW signature after the wrapper's first
+  compile increments the per-op recompile counter and, once per op name,
+  raises a ``RuntimeWarning`` naming the op and both signatures.
+* **cost table** — on the first compile of an op name the watcher
+  captures XLA cost analysis (FLOPs, bytes accessed) and, in ``compiled``
+  mode, the executable's memory footprint.  ``WF_TPU_COST_ANALYSIS``
+  picks the mode: ``lowered`` (default) uses the client-side
+  ``Lowered.cost_analysis()`` estimate — a few ms, no second backend
+  compile; ``compiled`` runs ``lowered.compile().cost_analysis()`` for
+  optimized-HLO numbers plus ``memory_analysis()`` (one extra backend
+  compile per op name per process — bench.py opts in, the test gate's
+  tight wall budget keeps the default cheap); ``off`` disables capture.
+
+Steady-state cost per call (the hot path): one pytree flatten, one
+shape/dtype tuple, one set hash-compare — the ``@hot_path`` contract
+``tools/wf_lint.py`` enforces on :meth:`WfJit._signature` /
+:meth:`WfJit.__call__`.  ``WF_TPU_JIT_WATCH=0`` removes even that:
+:func:`wf_jit` then returns the plain ``jax.jit`` callable.
+
+``PipeGraph.stats()["Device"]`` ships the registry snapshot (see
+monitoring/device_metrics.py); ``tools/wf_metrics.py`` and the dashboard
+``GET /metrics`` render it in Prometheus text exposition format.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+import warnings
+from typing import Callable, Dict, Optional
+
+import jax
+
+from windflow_tpu.analysis.hotpath import hot_path
+
+#: cost-analysis capture mode on an op name's first compile (see module
+#: docstring): "lowered" | "compiled" | "off"
+COST_MODE = os.environ.get("WF_TPU_COST_ANALYSIS", "lowered")
+#: kill switch: WF_TPU_JIT_WATCH=0 turns wf_jit into plain jax.jit
+WATCH_ENABLED = os.environ.get("WF_TPU_JIT_WATCH", "1").lower() \
+    not in ("0", "", "false", "off")
+
+
+def _leaf_sig(x):
+    """Hashable (shape, dtype) of one argument leaf.  Python numeric
+    scalars key by TYPE, mirroring ``jax.jit``'s cache: jit traces a
+    weak-typed scalar once per dtype, not per value, so keying by value
+    would fabricate a recompile (and a storm warning) for every distinct
+    int while JAX never re-traces.  str/bytes keep their value — they are
+    only legal as static args, where the value IS the cache key."""
+    dt = getattr(x, "dtype", None)
+    if dt is not None:
+        return (getattr(x, "shape", ()), dt)
+    if isinstance(x, (str, bytes)):
+        return x
+    return type(x)
+
+
+def format_sig(sig) -> str:
+    """Human-readable signature for the recompile warning:
+    ``f32[4096],i32[4096]``-style, structure elided."""
+    if sig is None:
+        return "<none>"
+    _, leaves = sig
+    parts = []
+    for leaf in leaves:
+        if isinstance(leaf, tuple) and len(leaf) == 2 \
+                and isinstance(leaf[0], tuple):
+            shape, dt = leaf
+            parts.append(f"{dt}[{','.join(str(d) for d in shape)}]")
+        elif isinstance(leaf, type):
+            parts.append(leaf.__name__)
+        else:
+            parts.append(repr(leaf))
+    return ",".join(parts) if parts else "<no args>"
+
+
+class OpCompileEntry:
+    """Aggregate compile telemetry for one op name (process-wide; several
+    wrapper instances — one per operator instance or cached capacity —
+    may feed the same entry)."""
+
+    __slots__ = ("op_name", "compiles", "recompiles", "compile_ms_total",
+                 "last_compile_ms", "cost", "cost_attempted", "memory",
+                 "warned", "lock")
+
+    def __init__(self, op_name: str) -> None:
+        self.op_name = op_name
+        self.compiles = 0
+        self.recompiles = 0
+        self.compile_ms_total = 0.0
+        self.last_compile_ms = 0.0
+        self.cost: Optional[dict] = None     # captured on first compile
+        self.cost_attempted = False          # one attempt per op name,
+        #                                      even when the backend fails it
+        self.memory: Optional[dict] = None   # "compiled" mode only
+        self.warned = False                  # one-time recompile warning
+        self.lock = threading.Lock()
+
+    def to_json(self) -> dict:
+        return {
+            "compiles": self.compiles,
+            "recompiles": self.recompiles,
+            "compile_ms_total": round(self.compile_ms_total, 3),
+            "last_compile_ms": round(self.last_compile_ms, 3),
+            "cost": self.cost,
+            "memory": self.memory,
+        }
+
+
+class JitRegistry:
+    """Process-wide op-name → :class:`OpCompileEntry` table."""
+
+    def __init__(self) -> None:
+        self._entries: Dict[str, OpCompileEntry] = {}
+        self._lock = threading.Lock()
+
+    def entry(self, op_name: str) -> OpCompileEntry:
+        with self._lock:
+            e = self._entries.get(op_name)
+            if e is None:
+                e = self._entries[op_name] = OpCompileEntry(op_name)
+            return e
+
+    def snapshot(self) -> dict:
+        """JSON-serializable per-op table (``stats()["Device"]["jit"]``).
+        Ops that never compiled (entry created, no call yet) are skipped."""
+        with self._lock:
+            entries = dict(self._entries)
+        return {name: e.to_json() for name, e in sorted(entries.items())
+                if e.compiles or e.recompiles}
+
+    def totals(self) -> dict:
+        """Graph-agnostic aggregates (bench.py's ``device`` section)."""
+        with self._lock:
+            entries = tuple(self._entries.values())
+        return {
+            "ops_compiled": sum(1 for e in entries if e.compiles),
+            "compiles": sum(e.compiles for e in entries),
+            "recompiles": sum(e.recompiles for e in entries),
+            "compile_ms_total": round(sum(e.compile_ms_total
+                                          for e in entries), 3),
+        }
+
+    def reset(self) -> None:
+        """Drop every entry (tests).  Live wrappers re-create their entry
+        lazily on the next compile."""
+        with self._lock:
+            self._entries.clear()
+
+
+_default_registry = JitRegistry()
+
+
+def default_registry() -> JitRegistry:
+    """The process-wide compile registry every :func:`wf_jit` wrapper
+    reports into (same sharing stance as ``staging.default_pool``)."""
+    return _default_registry
+
+
+class WfJit:
+    """One watched ``jax.jit`` callable.  The seen-signature set is
+    per-wrapper (a fresh operator instance compiling its first batch is a
+    compile, not a recompile); counters aggregate per op name in the
+    process-wide registry."""
+
+    __slots__ = ("op_name", "_jit", "_seen", "_last_sig", "_lock")
+
+    def __init__(self, fn: Callable, op_name: str, jit_kwargs: dict) -> None:
+        self.op_name = op_name
+        self._jit = jax.jit(fn, **jit_kwargs)
+        self._seen = set()
+        self._last_sig = None
+        # serializes the cold compile path only: replicas of one operator
+        # share one wrapper and may first-call concurrently from the host
+        # worker pool — without this, both would count a compile and the
+        # loser could mint a spurious same-signature "recompile" (which
+        # would trip check_bench_keys' recompile tripwire).  The hot path
+        # stays lock-free; a racy miss there lands here and re-checks.
+        self._lock = threading.Lock()
+
+    # -- hot path ------------------------------------------------------------
+    @hot_path
+    def _signature(self, args, kwargs):
+        """Input signature: pytree structure + per-leaf shape/dtype.  The
+        whole per-batch cost of the compile watcher is building this tuple
+        and one set hash-compare in :meth:`__call__`."""
+        leaves, treedef = jax.tree_util.tree_flatten((args, kwargs))
+        return (treedef, tuple(_leaf_sig(x) for x in leaves))
+
+    @hot_path
+    def __call__(self, *args, **kwargs):
+        sig = self._signature(args, kwargs)
+        if sig in self._seen:       # hash-compare only: steady state
+            return self._jit(*args, **kwargs)
+        return self._compile_call(sig, args, kwargs)
+
+    # -- cold path: a compile is happening -----------------------------------
+    def _compile_call(self, sig, args, kwargs):
+        with self._lock:
+            return self._compile_call_locked(sig, args, kwargs)
+
+    def _compile_call_locked(self, sig, args, kwargs):
+        if sig in self._seen:
+            # lost the race: another replica thread compiled this
+            # signature while we waited — plain cached dispatch
+            return self._jit(*args, **kwargs)
+        entry = default_registry().entry(self.op_name)
+        is_recompile = bool(self._seen)
+        prev_sig = self._last_sig
+        with entry.lock:
+            capture_cost = not entry.cost_attempted and COST_MODE != "off"
+            entry.cost_attempted = True     # one attempt per op name,
+            #                                 even if the backend fails it
+        if capture_cost:
+            # BEFORE the dispatch: donated buffers are dead afterwards
+            self._capture_cost(entry, args, kwargs)
+        t0 = time.perf_counter()
+        out = self._jit(*args, **kwargs)
+        dt_ms = (time.perf_counter() - t0) * 1e3
+        self._seen.add(sig)
+        self._last_sig = sig
+        warn = False
+        with entry.lock:
+            entry.compiles += 1
+            entry.compile_ms_total += dt_ms
+            entry.last_compile_ms = dt_ms
+            if is_recompile:
+                entry.recompiles += 1
+                if not entry.warned:
+                    entry.warned = True
+                    warn = True
+        if warn:
+            warnings.warn(
+                f"wf_jit('{self.op_name}'): input signature changed from "
+                f"[{format_sig(prev_sig)}] to [{format_sig(sig)}] — the "
+                "operator recompiled.  A signature that keeps drifting is "
+                "a recompilation storm (every batch pays trace+compile "
+                "instead of a cache hit); pad batches to a fixed capacity "
+                "or split the op per shape.  Counted in "
+                'stats()["Device"]["jit"]; warning shown once per op.',
+                RuntimeWarning, stacklevel=3)
+        return out
+
+    def _capture_cost(self, entry: OpCompileEntry, args, kwargs) -> None:
+        """Best-effort XLA cost capture on the op name's first compile
+        (module docstring: 'lowered' estimate vs 'compiled' optimized-HLO
+        numbers + memory footprint)."""
+        cost_src = None
+        memory = None
+        try:
+            lowered = self._jit.lower(*args, **kwargs)
+            if COST_MODE == "compiled":
+                compiled = lowered.compile()
+                cost_src = compiled.cost_analysis()
+                if isinstance(cost_src, (list, tuple)):
+                    cost_src = cost_src[0] if cost_src else None
+                mem = compiled.memory_analysis()
+                if mem is not None:
+                    memory = {
+                        "argument_bytes":
+                            getattr(mem, "argument_size_in_bytes", None),
+                        "output_bytes":
+                            getattr(mem, "output_size_in_bytes", None),
+                        "temp_bytes":
+                            getattr(mem, "temp_size_in_bytes", None),
+                        "generated_code_bytes":
+                            getattr(mem, "generated_code_size_in_bytes",
+                                    None),
+                    }
+            else:
+                cost_src = lowered.cost_analysis()
+                if isinstance(cost_src, (list, tuple)):
+                    cost_src = cost_src[0] if cost_src else None
+        except Exception:  # lint: broad-except-ok (cost analysis is a
+            # best-effort probe of backend-specific AOT APIs — any failure
+            # must degrade to "no cost table", never break dispatch)
+            cost_src = None
+        cost = None
+        if isinstance(cost_src, dict):
+            cost = {"mode": COST_MODE}
+            for key, out_key in (("flops", "flops"),
+                                 ("bytes accessed", "bytes_accessed"),
+                                 ("transcendentals", "transcendentals")):
+                v = cost_src.get(key)
+                if isinstance(v, (int, float)):
+                    cost[out_key] = float(v)
+        with entry.lock:
+            if entry.cost is None and cost is not None:
+                entry.cost = cost
+                entry.memory = memory
+            # a failed capture stays failed: cost_attempted (set by the
+            # caller) stops every later compile of this op name from
+            # re-paying the probe — in "compiled" mode that would be a
+            # whole extra backend compile per compile
+
+    # -- AOT passthroughs (parity with jax.jit's stages API) -----------------
+    def lower(self, *args, **kwargs):
+        return self._jit.lower(*args, **kwargs)
+
+
+def wf_jit(fn: Optional[Callable] = None, *, op_name: str,
+           **jit_kwargs) -> Callable:
+    """Drop-in ``jax.jit`` replacement reporting compiles / recompiles /
+    compile wall time / first-compile cost into the process-wide
+    :class:`JitRegistry` under ``op_name``.  All other keyword arguments
+    pass straight through to ``jax.jit`` (``donate_argnums`` etc.).
+
+    Usable both as a call (``step = wf_jit(step_fn, op_name=...)``) and a
+    decorator (``@wf_jit(op_name=...)``)."""
+    if fn is None:
+        return lambda f: wf_jit(f, op_name=op_name, **jit_kwargs)
+    if not WATCH_ENABLED:
+        return jax.jit(fn, **jit_kwargs)
+    return WfJit(fn, op_name, jit_kwargs)

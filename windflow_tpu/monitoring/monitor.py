@@ -18,9 +18,15 @@ watermark lag, queue depths, staging-pool occupancy, rolling 1s/10s
 throughput — sampled by THIS thread's once-per-second cadence (the
 rolling-rate window is fed by ``PipeGraph.sample_gauges``).
 
-Like the reference (``monitoring.hpp:197-200``), the thread switches itself
-off quietly if the dashboard is unreachable or any send fails — monitoring
-must never take the pipeline down.
+Like the reference (``monitoring.hpp:197-200``), the thread ships no more
+reports once the dashboard is unreachable or any send fails — monitoring
+must never take the pipeline down.  Unlike the reference, SAMPLING is
+decoupled from SHIPPING: the rolling 1s/10s throughput gauges are fed by
+this thread's cadence (``PipeGraph.sample_gauges``), so a headless run —
+no dashboard listening, or a dashboard that died mid-run — keeps sampling
+on the same cadence and only stops sending.  (Before this split the
+gauges starved whenever the TCP connection was down: ``stats()`` read at
+the end of a run saw a throughput window that had never advanced.)
 """
 
 from __future__ import annotations
@@ -49,13 +55,16 @@ def recv_exact(sock: socket.socket, n: int) -> bytes:
 
 
 class MonitoringThread:
-    def __init__(self, graph) -> None:
+    def __init__(self, graph,
+                 interval: float = SAMPLE_INTERVAL_SEC) -> None:
         self.graph = graph
+        self.interval = interval
         self.identifier = -1
         self._sock = None
         self._thread = None
         self._stop = threading.Event()
-        self.active = False
+        self.active = False      # a dashboard connection is up
+        self.samples_taken = 0   # gauge samples on cadence (shipped or not)
 
     # -- protocol ------------------------------------------------------------
     def _register_app(self) -> None:
@@ -84,10 +93,13 @@ class MonitoringThread:
                 (self.graph.config.dashboard_host,
                  self.graph.config.dashboard_port), timeout=2.0)
             self._register_app()
+            self.active = True
         except OSError:
+            # reference: "Monitoring thread switched off" — but only the
+            # SHIPPING half: the sampling loop below still runs, because
+            # the rolling-throughput gauges are fed by this cadence and
+            # must not starve on a headless run
             self.active = False
-            return  # reference: "Monitoring thread switched off"
-        self.active = True
         try:
             last = time.monotonic()
             # Check ~20×/s: fine-grained enough for END_APP latency without
@@ -95,21 +107,34 @@ class MonitoringThread:
             # usleep(100) spin is cheap only because its poll is off-GIL).
             while not self._stop.wait(0.05) and not self.graph.is_done():
                 now = time.monotonic()
-                if now - last >= SAMPLE_INTERVAL_SEC:
-                    # stats() inside _send_report samples the throughput
-                    # gauges, so this 1 Hz cadence is what feeds the
-                    # rolling 1s/10s windows
-                    self._send_report(TYPE_NEW_REPORT)
+                if now - last >= self.interval:
                     last = now
-            self._send_report(TYPE_END_APP)
+                    self.samples_taken += 1
+                    if self.active:
+                        # stats() inside _send_report samples the gauges,
+                        # so the shipped report and the rolling window
+                        # advance on the same tick
+                        try:
+                            self._send_report(TYPE_NEW_REPORT)
+                        except OSError:
+                            self._disconnect()  # keep sampling headless
+                    else:
+                        self.graph.sample_gauges()
+            if self.active:
+                self._send_report(TYPE_END_APP)
         except OSError:
             pass
         finally:
-            self.active = False
+            self._disconnect()
+
+    def _disconnect(self) -> None:
+        self.active = False
+        if self._sock is not None:
             try:
                 self._sock.close()
             except OSError:
                 pass
+            self._sock = None
 
     def start(self) -> None:
         self._thread = threading.Thread(target=self._run, daemon=True,

@@ -1,0 +1,459 @@
+"""Prometheus text exposition of ``PipeGraph.stats()``.
+
+One stats report (the dashboard ``NEW_REPORT`` payload / ``dump_stats``
+JSON) renders into the Prometheus text format (version 0.0.4 — what every
+Prometheus/OpenMetrics scraper ingests): counters for the lifetime
+totals, gauges for the point-in-time sections, and real
+``_bucket``/``_sum``/``_count`` histograms re-exposed from the flight
+recorder's log2-bucketed latency histograms (bucket upper bounds are the
+``2^b`` bucket edges, cumulative counts, ``+Inf`` closing the series).
+
+Escaping follows the exposition-format spec: label values escape ``\\``,
+``"`` and newline; HELP text escapes ``\\`` and newline.  The module is
+pure stdlib (no jax, no numpy) so ``tools/wf_metrics.py`` and the
+dashboard render without touching a backend.
+
+:func:`parse_exposition` is the matching strict parser — the round-trip
+check behind ``wf_metrics.py --check`` and the golden-format tests: it
+rejects samples with no preceding ``# TYPE``, malformed metric/label
+names, broken escaping, non-monotonic histogram buckets, and
+``+Inf``/``_count`` disagreement.
+"""
+
+from __future__ import annotations
+
+import math
+import re
+from typing import Dict, List, Optional, Tuple
+
+_NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
+_LABEL_RE = re.compile(r"^[a-zA-Z_][a-zA-Z0-9_]*$")
+
+
+def escape_label_value(v) -> str:
+    return str(v).replace("\\", "\\\\").replace('"', '\\"') \
+                 .replace("\n", "\\n")
+
+
+def _escape_help(v: str) -> str:
+    return v.replace("\\", "\\\\").replace("\n", "\\n")
+
+
+def _fmt_value(v) -> str:
+    if isinstance(v, bool):
+        return "1" if v else "0"
+    if isinstance(v, int):
+        return str(v)
+    f = float(v)
+    if math.isinf(f):
+        return "+Inf" if f > 0 else "-Inf"
+    if f == int(f) and abs(f) < 1e15:
+        return str(int(f))
+    return repr(f)
+
+
+def _fmt_le(le: float) -> str:
+    return "+Inf" if math.isinf(le) else _fmt_value(le)
+
+
+class MetricFamily:
+    """One family: name, type, help, and its samples (suffix + labels +
+    value; histogram bucket/sum/count samples carry their suffix)."""
+
+    def __init__(self, name: str, mtype: str, help_text: str) -> None:
+        self.name = name
+        self.mtype = mtype
+        self.help = help_text
+        self.samples: List[Tuple[str, dict, object]] = []
+
+    def add(self, value, labels: Optional[dict] = None,
+            suffix: str = "") -> None:
+        self.samples.append((suffix, dict(labels or {}), value))
+
+    def add_histogram(self, buckets: List[Tuple[float, int]], hsum: float,
+                      count: int, labels: Optional[dict] = None) -> None:
+        """``buckets`` are (upper_bound, per-bucket count) pairs — this
+        accumulates and closes the series with ``+Inf``."""
+        labels = dict(labels or {})
+        cum = 0
+        for le, c in sorted(buckets, key=lambda p: p[0]):
+            cum += c
+            self.add(cum, dict(labels, le=_fmt_le(le)), suffix="_bucket")
+        self.add(count, dict(labels, le="+Inf"), suffix="_bucket")
+        self.add(hsum, labels, suffix="_sum")
+        self.add(count, labels, suffix="_count")
+
+    def render(self) -> str:
+        lines = [f"# HELP {self.name} {_escape_help(self.help)}",
+                 f"# TYPE {self.name} {self.mtype}"]
+        for suffix, labels, value in self.samples:
+            if labels:
+                lab = ",".join(
+                    f'{k}="{escape_label_value(v)}"'
+                    for k, v in labels.items())
+                lines.append(f"{self.name}{suffix}{{{lab}}} "
+                             f"{_fmt_value(value)}")
+            else:
+                lines.append(f"{self.name}{suffix} {_fmt_value(value)}")
+        return "\n".join(lines)
+
+
+def _hist_from_stats(fam: MetricFamily, q: Optional[dict],
+                     labels: dict) -> None:
+    """Re-expose one LatencyHistogram.quantiles() dict (with its
+    ``buckets``/``sum`` extension) as a real Prometheus histogram."""
+    if not isinstance(q, dict) or "buckets" not in q:
+        return
+    fam.add_histogram([(float(le), int(c)) for le, c in q["buckets"]],
+                      float(q.get("sum", 0.0)), int(q.get("count", 0)),
+                      labels)
+
+
+def render_openmetrics(stats: dict,
+                       base_labels: Optional[dict] = None) -> str:
+    """Render one ``PipeGraph.stats()`` dict as Prometheus text
+    exposition.  ``base_labels`` (e.g. ``{"app": name}``) are attached to
+    every sample."""
+    return render_openmetrics_multi([(base_labels, stats)])
+
+
+def render_openmetrics_multi(reports) -> str:
+    """Render several ``(base_labels, stats)`` reports into ONE valid
+    exposition: each metric family appears once (a single
+    ``# HELP``/``# TYPE`` pair) with every report's samples merged under
+    it — duplicate TYPE lines per family are a format violation the
+    strict parser rejects, so the dashboard's multi-app ``/metrics`` must
+    merge, not concatenate."""
+    merged: Dict[str, MetricFamily] = {}
+    order: List[str] = []
+    for base_labels, stats in reports:
+        for f in _families(stats, base_labels):
+            m = merged.get(f.name)
+            if m is None:
+                merged[f.name] = f
+                order.append(f.name)
+            else:
+                m.samples.extend(f.samples)
+    return "\n".join(merged[n].render() for n in order
+                     if merged[n].samples) + "\n"
+
+
+def _families(stats: dict,
+              base_labels: Optional[dict] = None) -> List["MetricFamily"]:
+    base = dict(base_labels or {})
+    if "app" not in base and stats.get("PipeGraph_name"):
+        base["app"] = stats["PipeGraph_name"]
+    fams: List[MetricFamily] = []
+
+    def fam(name, mtype, help_text) -> MetricFamily:
+        f = MetricFamily(name, mtype, help_text)
+        fams.append(f)
+        return f
+
+    # -- per-operator lifetime counters --------------------------------------
+    ops = stats.get("Operators") or []
+    f_in = fam("wf_operator_inputs_total", "counter",
+               "Tuples received per operator (summed over replicas)")
+    f_out = fam("wf_operator_outputs_total", "counter",
+                "Tuples emitted per operator")
+    f_ign = fam("wf_operator_inputs_ignored_total", "counter",
+                "Tuples ignored per operator (e.g. late at windows)")
+    f_prog = fam("wf_operator_device_programs_total", "counter",
+                 "Compiled-program dispatches per operator")
+    for op in ops:
+        name = op.get("Operator_name") or op.get("Name") or "?"
+        reps = op.get("Replicas") or []
+        lab = dict(base, operator=name)
+        f_in.add(sum(r.get("Inputs_received", 0) for r in reps), lab)
+        f_out.add(sum(r.get("Outputs_sent", 0) for r in reps), lab)
+        f_ign.add(sum(r.get("Inputs_ignored", 0) for r in reps), lab)
+        f_prog.add(sum(r.get("Device_programs_launched", 0)
+                       for r in reps), lab)
+
+    # -- graph-level counters / gauges ---------------------------------------
+    for key, mname, mtype, help_text in (
+            ("Bytes_H2D_total", "wf_bytes_h2d_total", "counter",
+             "Host-to-device bytes shipped by the staging plane"),
+            ("Bytes_D2H_total", "wf_bytes_d2h_total", "counter",
+             "Device-to-host bytes fetched at egress"),
+            ("Dropped_tuples", "wf_dropped_tuples_total", "counter",
+             "Tuples dropped graph-wide"),
+            ("Backpressure_throttle_events",
+             "wf_backpressure_throttle_events_total", "counter",
+             "Scheduler sweeps that deferred source ticks"),
+            ("rss_size_kb", "wf_rss_kb", "gauge",
+             "Resident set size of the driver process (KiB)")):
+        if key in stats:
+            fam(mname, mtype, help_text).add(stats[key] or 0, base)
+
+    # -- gauges section ------------------------------------------------------
+    gauges = stats.get("Gauges") or {}
+    f_lag = fam("wf_watermark_lag_usec", "gauge",
+                "Wall clock minus operator watermark frontier")
+    f_depth = fam("wf_queue_depth", "gauge",
+                  "Queued inbox messages per operator")
+    for name, g in (gauges.get("operators") or {}).items():
+        lab = dict(base, operator=name)
+        if g.get("watermark_lag_usec") is not None:
+            f_lag.add(g["watermark_lag_usec"], lab)
+        f_depth.add(g.get("queue_depth", 0), lab)
+    f_thr = fam("wf_throughput_tps", "gauge",
+                "Rolling sunk-tuples/sec over the trailing window")
+    for window, key in (("1s", "throughput_1s_tps"),
+                        ("10s", "throughput_10s_tps")):
+        if key in gauges:
+            f_thr.add(gauges[key], dict(base, window=window))
+    if "staging_pool_held_bytes" in gauges:
+        fam("wf_staging_pool_held_bytes", "gauge",
+            "Host bytes retained by the staging recycling pool") \
+            .add(gauges["staging_pool_held_bytes"], base)
+
+    # -- latency histograms --------------------------------------------------
+    lat = stats.get("Latency") or {}
+    f_svc = fam("wf_service_latency_usec", "histogram",
+                "Per-batch service span per operator (microseconds)")
+    for name, q in (lat.get("service_usec_per_operator") or {}).items():
+        _hist_from_stats(f_svc, q, dict(base, operator=name))
+    f_e2e = fam("wf_end_to_end_latency_usec", "histogram",
+                "Staged-to-sunk end-to-end latency (microseconds)")
+    _hist_from_stats(f_e2e, lat.get("end_to_end_usec"), base)
+
+    # -- device plane --------------------------------------------------------
+    device = stats.get("Device") or {}
+    jit = device.get("jit") or {}
+    f_cmp = fam("wf_jit_compiles_total", "counter",
+                "XLA compiles per op (compile watcher)")
+    f_rcmp = fam("wf_jit_recompiles_total", "counter",
+                 "Signature-change recompiles per op")
+    f_cms = fam("wf_jit_compile_ms_total", "counter",
+                "Cumulative compile wall milliseconds per op")
+    f_flops = fam("wf_jit_cost_flops", "gauge",
+                  "XLA cost analysis: FLOPs per execution")
+    f_bytes = fam("wf_jit_cost_bytes_accessed", "gauge",
+                  "XLA cost analysis: bytes accessed per execution")
+    for name, e in jit.items():
+        lab = dict(base, op=name)
+        f_cmp.add(e.get("compiles", 0), lab)
+        f_rcmp.add(e.get("recompiles", 0), lab)
+        f_cms.add(e.get("compile_ms_total", 0.0), lab)
+        cost = e.get("cost") or {}
+        if isinstance(cost.get("flops"), (int, float)):
+            f_flops.add(cost["flops"], lab)
+        if isinstance(cost.get("bytes_accessed"), (int, float)):
+            f_bytes.add(cost["bytes_accessed"], lab)
+    f_mem = fam("wf_device_memory_bytes", "gauge",
+                "device.memory_stats() gauges per local device")
+    for dev in device.get("memory") or []:
+        st = dev.get("stats")
+        if not isinstance(st, dict):
+            continue    # CPU backend: memory_stats() is None
+        for stat, v in st.items():
+            f_mem.add(v, dict(base, device=dev.get("device", "?"),
+                              stat=stat))
+    live = device.get("live_buffers") or {}
+    f_lb = fam("wf_live_buffer_bytes", "gauge",
+               "Bytes of live jax arrays per device ('all' = total)")
+    f_lc = fam("wf_live_buffer_count", "gauge",
+               "Count of live jax arrays per device ('all' = total)")
+    if "bytes" in live:
+        f_lb.add(live["bytes"], dict(base, device="all"))
+        f_lc.add(live.get("count", 0), dict(base, device="all"))
+    for dev, slot in (live.get("per_device") or {}).items():
+        lab = dict(base, device=dev)
+        f_lb.add(slot.get("bytes", 0), lab)
+        f_lc.add(slot.get("count", 0), lab)
+    staging = device.get("staging") or {}
+    if "staged_device_bytes_total" in staging:
+        fam("wf_staged_device_bytes_total", "counter",
+            "Cumulative packed bytes shipped host-to-device") \
+            .add(staging["staged_device_bytes_total"], base)
+
+    return fams
+
+
+# ---------------------------------------------------------------------------
+# strict parser (wf_metrics --check, golden-format tests)
+# ---------------------------------------------------------------------------
+
+_SUFFIXES = ("_bucket", "_sum", "_count")
+
+
+def _unescape_label_value(raw: str, where: str) -> str:
+    out = []
+    i = 0
+    while i < len(raw):
+        c = raw[i]
+        if c == "\\":
+            if i + 1 >= len(raw):
+                raise ValueError(f"{where}: dangling escape")
+            n = raw[i + 1]
+            if n == "\\":
+                out.append("\\")
+            elif n == '"':
+                out.append('"')
+            elif n == "n":
+                out.append("\n")
+            else:
+                raise ValueError(f"{where}: bad escape '\\{n}'")
+            i += 2
+        elif c == '"':
+            raise ValueError(f"{where}: unescaped quote in label value")
+        else:
+            out.append(c)
+            i += 1
+    return "".join(out)
+
+
+def _parse_labels(raw: str, where: str) -> dict:
+    labels: Dict[str, str] = {}
+    i = 0
+    while i < len(raw):
+        m = re.match(r'([a-zA-Z_][a-zA-Z0-9_]*)="', raw[i:])
+        if not m:
+            raise ValueError(f"{where}: malformed label at '{raw[i:]}'")
+        name = m.group(1)
+        i += m.end()
+        # scan to the closing unescaped quote
+        j = i
+        while j < len(raw):
+            if raw[j] == "\\":
+                j += 2
+                continue
+            if raw[j] == '"':
+                break
+            j += 1
+        if j >= len(raw):
+            raise ValueError(f"{where}: unterminated label value")
+        labels[name] = _unescape_label_value(raw[i:j], where)
+        i = j + 1
+        if i < len(raw):
+            if raw[i] != ",":
+                raise ValueError(f"{where}: expected ',' between labels")
+            i += 1
+    return labels
+
+
+def _parse_value(raw: str, where: str) -> float:
+    if raw == "+Inf":
+        return math.inf
+    if raw == "-Inf":
+        return -math.inf
+    if raw == "NaN":
+        return math.nan
+    try:
+        return float(raw)
+    except ValueError:
+        raise ValueError(f"{where}: bad sample value {raw!r}") from None
+
+
+def parse_exposition(text: str) -> dict:
+    """Parse + validate Prometheus text exposition.  Returns
+    ``{family: {"type": t, "help": h, "samples": [(name, labels, value)]}}``
+    and raises ``ValueError`` on any format violation: samples without a
+    preceding ``# TYPE``, bad metric/label names, broken escaping,
+    non-monotonic histogram buckets, ``+Inf`` bucket disagreeing with
+    ``_count``."""
+    families: Dict[str, dict] = {}
+    for lineno, line in enumerate(text.splitlines(), 1):
+        where = f"line {lineno}"
+        if not line.strip():
+            continue
+        if line.startswith("#"):
+            parts = line.split(None, 3)
+            if len(parts) < 3 or parts[1] not in ("HELP", "TYPE"):
+                continue        # free-form comment
+            kind, name = parts[1], parts[2]
+            if not _NAME_RE.match(name):
+                raise ValueError(f"{where}: bad metric name {name!r}")
+            f = families.setdefault(
+                name, {"type": None, "help": None, "samples": []})
+            if kind == "TYPE":
+                value = parts[3].strip() if len(parts) > 3 else ""
+                if value not in ("counter", "gauge", "histogram",
+                                 "summary", "untyped"):
+                    raise ValueError(f"{where}: bad TYPE {value!r}")
+                if f["samples"]:
+                    raise ValueError(
+                        f"{where}: TYPE for {name} after its samples")
+                f["type"] = value
+            else:
+                f["help"] = parts[3] if len(parts) > 3 else ""
+            continue
+        # sample line: name[{labels}] value [timestamp]
+        m = re.match(r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(\{(.*)\})?\s+(\S+)"
+                     r"(\s+-?\d+)?$", line)
+        if not m:
+            raise ValueError(f"{where}: malformed sample {line!r}")
+        name, _, rawlabels, rawvalue = m.group(1, 2, 3, 4)
+        labels = _parse_labels(rawlabels, where) if rawlabels else {}
+        value = _parse_value(rawvalue, where)
+        family = name
+        if family not in families:
+            for suf in _SUFFIXES:
+                if name.endswith(suf) and name[:-len(suf)] in families:
+                    family = name[:-len(suf)]
+                    break
+        f = families.get(family)
+        if f is None or f["type"] is None:
+            raise ValueError(
+                f"{where}: sample {name!r} without a preceding # TYPE")
+        if f["type"] != "histogram" and family != name:
+            raise ValueError(
+                f"{where}: suffix sample {name!r} on non-histogram "
+                f"family {family!r}")
+        if f["type"] == "histogram" and family == name:
+            raise ValueError(
+                f"{where}: histogram {name!r} must expose only "
+                "_bucket/_sum/_count samples")
+        if f["type"] == "counter":
+            if not (value >= 0 or math.isnan(value)):
+                raise ValueError(f"{where}: negative counter {name!r}")
+        if "le" in labels and not name.endswith("_bucket"):
+            raise ValueError(f"{where}: 'le' label outside _bucket")
+        f["samples"].append((name, labels, value))
+
+    _validate_histograms(families)
+    return families
+
+
+def _series_key(labels: dict) -> tuple:
+    return tuple(sorted((k, v) for k, v in labels.items() if k != "le"))
+
+
+def _validate_histograms(families: dict) -> None:
+    for fname, f in families.items():
+        if f["type"] != "histogram":
+            continue
+        series: Dict[tuple, dict] = {}
+        for name, labels, value in f["samples"]:
+            s = series.setdefault(_series_key(labels),
+                                  {"buckets": [], "sum": None,
+                                   "count": None})
+            if name.endswith("_bucket"):
+                if "le" not in labels:
+                    raise ValueError(
+                        f"{fname}: _bucket sample without 'le'")
+                s["buckets"].append((_parse_value(labels["le"],
+                                                  fname), value))
+            elif name.endswith("_sum"):
+                s["sum"] = value
+            elif name.endswith("_count"):
+                s["count"] = value
+        for key, s in series.items():
+            if not s["buckets"] or s["count"] is None or s["sum"] is None:
+                raise ValueError(
+                    f"{fname}{dict(key)}: histogram series missing "
+                    "_bucket/_sum/_count")
+            s["buckets"].sort(key=lambda p: p[0])
+            les = [le for le, _ in s["buckets"]]
+            if les[-1] != math.inf:
+                raise ValueError(f"{fname}{dict(key)}: no +Inf bucket")
+            counts = [c for _, c in s["buckets"]]
+            if any(prev > nxt for prev, nxt in zip(counts, counts[1:])):
+                raise ValueError(
+                    f"{fname}{dict(key)}: bucket counts decrease — "
+                    "cumulative histogram broken")
+            if counts[-1] != s["count"]:
+                raise ValueError(
+                    f"{fname}{dict(key)}: +Inf bucket {counts[-1]} != "
+                    f"_count {s['count']}")

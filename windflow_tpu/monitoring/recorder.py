@@ -134,9 +134,19 @@ class LatencyHistogram:
             cum += c
         return self.max
 
+    def bucket_counts(self) -> list:
+        """Nonzero ``[upper_bound_usec, count]`` pairs (bucket ``b`` holds
+        values below ``2^b``): the raw series behind the Prometheus
+        ``_bucket`` exposition (monitoring/openmetrics.py), where the
+        quantile summary below is not enough."""
+        return [[float(1 << b) if b else 1.0, int(c)]
+                for b, c in enumerate(self.counts.tolist()) if c]
+
     def quantiles(self) -> dict:
         """The ``p50/p95/p99`` dict shipped by ``StatsRecord.to_json`` and
-        ``PipeGraph.stats()`` (empty -> all zeros, count 0)."""
+        ``PipeGraph.stats()`` (empty -> all zeros, count 0); ``sum`` and
+        the raw ``buckets`` ride along for the OpenMetrics histogram
+        exposition."""
         return {
             "count": self.count,
             "mean": round(self.mean(), 3),
@@ -144,6 +154,8 @@ class LatencyHistogram:
             "p95": round(self.percentile(0.95), 3),
             "p99": round(self.percentile(0.99), 3),
             "max": round(self.max, 3) if self.count else 0.0,
+            "sum": round(self.total, 3),
+            "buckets": self.bucket_counts(),
         }
 
 
@@ -269,9 +281,14 @@ class FlightRecorder:
         return chrome_trace_from_events(self.events())
 
 
-def chrome_trace_from_events(events: List[dict]) -> dict:
+def chrome_trace_from_events(events: List[dict],
+                             metadata: Optional[dict] = None) -> dict:
     """Render raw span events as Chrome-trace JSON (``traceEvents`` array
     format), loadable in ``chrome://tracing`` and Perfetto.
+    ``metadata`` entries are merged into ``otherData`` — the profiler
+    bridge (graph/pipegraph.py ``profile()``) records the annotation
+    format and capture directory there so this file and a
+    ``jax.profiler`` capture cross-reference in one Perfetto session.
 
     Layout: one *thread* track per ``(op, replica)`` carrying instant
     events for every record, plus one *async* span per traced batch and
@@ -307,15 +324,18 @@ def chrome_trace_from_events(events: List[dict]) -> dict:
                     "name": f"{a['stage']}→{b['stage']}"}
             trace_events.append(dict(span, ph="b", ts=a["t_usec"]))
             trace_events.append(dict(span, ph="e", ts=b["t_usec"]))
+    other = {"source": "windflow_tpu flight recorder", "clock": "wall_usec"}
+    if metadata:
+        other.update(metadata)
     return {
         "traceEvents": trace_events,
         "displayTimeUnit": "ms",
-        "otherData": {"source": "windflow_tpu flight recorder",
-                      "clock": "wall_usec"},
+        "otherData": other,
     }
 
 
-def write_chrome_trace(events: List[dict], path: str) -> str:
+def write_chrome_trace(events: List[dict], path: str,
+                       metadata: Optional[dict] = None) -> str:
     with open(path, "w") as f:
-        json.dump(chrome_trace_from_events(events), f)
+        json.dump(chrome_trace_from_events(events, metadata), f)
     return path

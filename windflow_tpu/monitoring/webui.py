@@ -26,7 +26,8 @@ INDEX_HTML = """<!DOCTYPE html>
   th { background: #f7f7f7; }
   td:first-child, th:first-child { text-align: left; }
   .spark { vertical-align: middle; }
-  #meta { font-size: 12px; color: #555; margin-bottom: 8px; }
+  #meta { font-size: 12px; color: #555; margin-bottom: 8px;
+          white-space: pre-line; }
   pre { background: #f7f7f7; padding: 8px; font-size: 11px;
         overflow-x: auto; }
   details { margin-top: 12px; }
@@ -84,12 +85,29 @@ async function render(id) {
     `#${id} ${app.name} — ${reports.length} reports`;  // textContent: safe
   if (!reports.length) return;
   const last = reports[reports.length - 1];
+  // device/HBM line next to the host-side meta: compile-watcher totals
+  // plus per-device allocator bytes (CPU backends report no memory_stats
+  // — shown as host-only so the gap is explicit, not blank)
+  const dev = last.Device || {};
+  const jt = dev.jit_totals || {};
+  const hbm = (dev.memory || [])
+    .filter(d => d.stats && d.stats.bytes_in_use !== undefined)
+    .map(d => `${d.device}=${(d.stats.bytes_in_use / 1048576).toFixed(1)}MB`)
+    .join(" ");
+  const live = dev.live_buffers || {};
   document.getElementById("meta").textContent =
     `mode=${last.Mode}  operators=${last.Operator_number}  ` +
     `dropped=${last.Dropped_tuples}  rss=${last.rss_size_kb} kB  ` +
-    `throttle_events=${last.Backpressure_throttle_events}`;
-  // per-operator throughput history: delta Outputs_sent between reports
-  const hist = {};
+    `throttle_events=${last.Backpressure_throttle_events}\n` +
+    `device: compiles=${jt.compiles ?? "?"} ` +
+    `recompiles=${jt.recompiles ?? "?"} ` +
+    `compile_ms=${jt.compile_ms_total ?? "?"}  ` +
+    `live_buffers=${live.count ?? "?"} ` +
+    `(${((live.bytes || 0) / 1048576).toFixed(1)}MB)  ` +
+    `hbm: ${hbm || "(no allocator stats — host-only backend)"}`;
+  // per-operator history: throughput (delta Outputs_sent) and
+  // watermark-lag gauge between reports
+  const hist = {}, lagHist = {};
   let prev = null;
   for (const r of reports) {
     const byOp = {};
@@ -97,6 +115,11 @@ async function render(id) {
       let out = 0;
       for (const rep of (op.Replicas || [])) out += rep.Outputs_sent || 0;
       byOp[op.Operator_name || op.Name || "?"] = out;
+    }
+    const gops = (r.Gauges || {}).operators || {};
+    for (const [name, g] of Object.entries(gops)) {
+      if (g.watermark_lag_usec != null)
+        (lagHist[name] = lagHist[name] || []).push(g.watermark_lag_usec);
     }
     if (prev) {
       for (const [name, out] of Object.entries(byOp)) {
@@ -107,9 +130,15 @@ async function render(id) {
     prev = byOp;
   }
   const lastOps = reports[reports.length - 1].Operators || [];
+  const lat = (last.Latency || {}).service_usec_per_operator || {};
+  const gops = (last.Gauges || {}).operators || {};
+  const fmtUs = v => v == null ? "–" :
+    (v >= 1e6 ? `${(v / 1e6).toFixed(1)}s` :
+     v >= 1e3 ? `${(v / 1e3).toFixed(1)}ms` : `${Math.round(v)}µs`);
   document.getElementById("ops").innerHTML =
     `<table><tr><th>operator</th><th>replicas</th><th>outputs</th>` +
-    `<th>ignored</th><th>throughput (tuples/report)</th></tr>` +
+    `<th>ignored</th><th>p50</th><th>p95</th><th>p99</th>` +
+    `<th>wm lag</th><th>throughput (tuples/report)</th></tr>` +
     lastOps.map(op => {
       const name = op.Operator_name || op.Name || "?";
       const reps = (op.Replicas || []);
@@ -117,8 +146,14 @@ async function render(id) {
       const ign = reps.reduce((s, r) => s + (r.Inputs_ignored || 0), 0);
       const h = hist[name] || [];
       const cur = h.length ? h[h.length - 1] : 0;
+      const q = lat[name] || {};
+      const lag = (gops[name] || {}).watermark_lag_usec;
+      const lh = lagHist[name] || [];
       return `<tr><td>${esc(name)}</td><td>${reps.length}</td>` +
              `<td>${outs}</td><td>${ign}</td>` +
+             `<td>${fmtUs(q.p50)}</td><td>${fmtUs(q.p95)}</td>` +
+             `<td>${fmtUs(q.p99)}</td>` +
+             `<td>${spark(lh.slice(-60), 80, 26)} ${fmtUs(lag)}</td>` +
              `<td>${spark(h.slice(-60), 160, 26)} ${cur}</td></tr>`;
     }).join("") + "</table>";
 }

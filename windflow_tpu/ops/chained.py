@@ -21,6 +21,7 @@ import jax
 from windflow_tpu.basic import WindFlowError
 from windflow_tpu.batch import DeviceBatch
 from windflow_tpu.meta import adapt
+from windflow_tpu.monitoring.jit_registry import wf_jit
 from windflow_tpu.ops.base import Operator, Replica
 from windflow_tpu.ops.filter_op import Filter
 from windflow_tpu.ops.flatmap_op import FlatMap
@@ -145,7 +146,6 @@ class ChainedTPU(Operator):
         self.specs = specs
         self._has_filter = any(k == "filter" for k, _ in specs)
 
-        @jax.jit
         def step(payload, valid):
             for kind, fn in specs:
                 if kind == "map":
@@ -156,7 +156,7 @@ class ChainedTPU(Operator):
                     valid = valid & jax.vmap(fn)(payload)
             return payload, valid
 
-        self._jit_step = step
+        self._jit_step = wf_jit(step, op_name=name)
 
     def _step(self, batch: DeviceBatch) -> DeviceBatch:
         payload, valid = self._jit_step(batch.payload, batch.valid)

@@ -35,6 +35,7 @@ import jax.numpy as jnp
 from windflow_tpu.basic import RoutingMode, WindFlowError, current_time_usecs
 from windflow_tpu.batch import DeviceBatch
 from windflow_tpu.monitoring import recorder as flightrec
+from windflow_tpu.monitoring.jit_registry import wf_jit
 from windflow_tpu.ops.base import Operator, Replica
 
 
@@ -51,7 +52,19 @@ class _TPUReplica(Replica):
         return self.op._step(batch)
 
     def process_device_batch(self, batch: DeviceBatch) -> None:
-        out = self._op_step(batch)
+        if batch.trace is not None:
+            # profiler bridge: the sampled (1-in-N trace-lane) batch's
+            # device dispatch is wrapped in a TraceAnnotation carrying the
+            # flight-recorder trace id, so a jax.profiler capture
+            # (PipeGraph.profile) and dump_trace()'s Chrome trace line up
+            # span-for-span in one Perfetto session.  Untraced batches pay
+            # exactly this one attribute check (budget asserted by
+            # tests/test_device_metrics.py).
+            with jax.profiler.TraceAnnotation(
+                    f"op:{self.op.name} trace:{batch.trace[0]}"):
+                out = self._op_step(batch)
+        else:
+            out = self._op_step(batch)
         self.stats.device_programs_launched += 1
         if self.ring is not None and batch.trace is not None:
             # `dispatched` stamps the ASYNC enqueue (the host is already
@@ -103,13 +116,12 @@ class MapTPU(Operator):
         self.fn = fn
         self.batch_fn = batch_fn
 
-        @jax.jit
         def step(payload, valid):
             if self.batch_fn:
                 return self.fn(payload, valid)
             return jax.vmap(self.fn)(payload)
 
-        self._jit_step = step
+        self._jit_step = wf_jit(step, op_name=name)
 
     def _step(self, batch: DeviceBatch) -> DeviceBatch:
         out_payload = self._jit_step(batch.payload, batch.valid)
@@ -142,12 +154,11 @@ class FilterTPU(Operator):
                          key_extractor=key_extractor)
         self.fn = fn
 
-        @jax.jit
         def step(payload, valid):
             keep = jax.vmap(self.fn)(payload)
             return valid & keep
 
-        self._jit_step = step
+        self._jit_step = wf_jit(step, op_name=name)
 
     def _step(self, batch: DeviceBatch) -> DeviceBatch:
         new_valid = self._jit_step(batch.payload, batch.valid)
@@ -289,7 +300,6 @@ class ReduceTPU(Operator):
             comb = self.comb
             key_fn = self.key_extractor
 
-            @jax.jit
             def step(keys, payload, ts, valid):
                 if keys is None:
                     if key_fn is not None:
@@ -300,6 +310,7 @@ class ReduceTPU(Operator):
                 return _segmented_reduce(keys, payload, ts, valid, comb,
                                          capacity)
 
+            step = wf_jit(step, op_name=self.name)
             self._jit_steps[capacity] = step
         return step
 
@@ -326,7 +337,6 @@ class ReduceTPU(Operator):
             monoid = self.monoid
             key_fn = self.key_extractor
 
-            @jax.jit
             def step(keys, payload, ts, valid):
                 if keys is None:
                     keys = jax.vmap(key_fn)(payload).astype(jnp.int32) \
@@ -349,6 +359,7 @@ class ReduceTPU(Operator):
                 has = jnp.zeros(K + 1, bool).at[row].set(True)[:K]
                 return table, ts_t, has, n_drop
 
+            step = wf_jit(step, op_name=f"{self.name}.dense")
             self._jit_steps[("dense", capacity)] = step
         return step
 
@@ -365,11 +376,12 @@ class ReduceTPU(Operator):
                 # reduce_gpu.hpp:227-258 arbitrary-key path).  withMaxKeys
                 # remains the faster dense/psum variant for bounded keys.
                 step = make_sharded_reduce_arbitrary(
-                    self.mesh, capacity, self.comb, self.key_extractor)
+                    self.mesh, capacity, self.comb, self.key_extractor,
+                    op_name=f"{self.name}.mesh")
             else:
                 step = make_sharded_reduce_step(
                     self.mesh, capacity, K, self.comb, self.key_extractor,
-                    monoid=self.monoid)
+                    monoid=self.monoid, op_name=f"{self.name}.mesh")
             self._jit_steps[("mesh", capacity)] = step
         return step
 

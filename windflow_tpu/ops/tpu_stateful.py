@@ -49,6 +49,7 @@ import numpy as np
 
 from windflow_tpu.basic import RoutingMode, WindFlowError
 from windflow_tpu.batch import DeviceBatch
+from windflow_tpu.monitoring.jit_registry import wf_jit
 from windflow_tpu.ops.base import Operator
 from windflow_tpu.ops.tpu import _TPUReplica, _bshape
 from windflow_tpu.parallel.emitters import KeyInterner
@@ -277,7 +278,7 @@ class _StatefulTPUBase(Operator):
             step = make_sharded_stateful_step(
                 self.mesh, capacity, self.num_key_slots,
                 self._body_factory(), self.key_extractor, self.dense_keys,
-                self._is_filter)
+                self._is_filter, op_name=f"{self.name}.mesh")
             # shard the state table along the key axis on first use
             self._state = jax.device_put(self._state,
                                          state_sharding(self.mesh))
@@ -303,7 +304,7 @@ class _StatefulTPUBase(Operator):
                     pos = jnp.clip(jnp.searchsorted(uniq_keys, keys),
                                    0, capacity - 1)
                     return body(state, payload, valid, uniq_slots[pos])
-            step = jax.jit(step, donate_argnums=(0,))
+            step = wf_jit(step, op_name=self.name, donate_argnums=(0,))
             self._steps[capacity] = step
         return step
 
@@ -329,11 +330,11 @@ class _StatefulTPUBase(Operator):
         if self._extract is None:
             key_fn = self.key_extractor
 
-            @jax.jit
             def extract(payload):
                 return jax.vmap(key_fn)(payload).astype(jnp.int32)
 
-            self._extract = extract
+            self._extract = wf_jit(extract,
+                                   op_name=f"{self.name}.key_extract")
         keys_dev = batch.keys if batch.keys is not None \
             else self._extract(batch.payload)
         keys_np = np.asarray(keys_dev)

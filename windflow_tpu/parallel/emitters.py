@@ -733,7 +733,6 @@ class DeviceKeyByEmitter(Emitter):
             n = len(self.dests)
             key_fn = self.key_extractor
 
-            @jax.jit
             def split(payload, ts, valid, keys):
                 if keys is None:
                     keys = jax.vmap(key_fn)(payload).astype(jnp.int32)
@@ -749,6 +748,8 @@ class DeviceKeyByEmitter(Emitter):
                 # O(capacity * num_dests) sorts+copies
                 return keys, [dest == d for d in range(n)]
 
+            from windflow_tpu.monitoring.jit_registry import wf_jit
+            split = wf_jit(split, op_name="emitter.device_keyby_split")
             self._splits[capacity] = split
         return split
 
@@ -931,13 +932,15 @@ class SplittingEmitter(Emitter):
             # per-tuple path", the documented fallback)
             ok = False
         if ok:
-            @jax.jit
             def compiled(payload, ts, valid):
                 idx = jax.vmap(split_fn)(payload).astype(jnp.int32)
                 dest = jnp.where(valid, idx, jnp.int32(n))
                 # mask-only split: every branch shares the same immutable
                 # buffers with its own validity mask (see DeviceKeyByEmitter)
                 return [dest == b for b in range(n)]
+
+            from windflow_tpu.monitoring.jit_registry import wf_jit
+            compiled = wf_jit(compiled, op_name="emitter.device_split")
 
         self._device_splits[capacity] = compiled
         return compiled

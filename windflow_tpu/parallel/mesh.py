@@ -54,6 +54,7 @@ else:
 
 from windflow_tpu.basic import WindFlowError
 from windflow_tpu.batch import DeviceBatch, HostBatch, host_to_device
+from windflow_tpu.monitoring.jit_registry import wf_jit
 from windflow_tpu.windows.ffat_kernels import (_b, _masked_reduce_last,
                                            _monoid_identity, _seg_scan,
                                            make_ffat_flush,
@@ -146,7 +147,8 @@ def _dense_keyed_partial(keys, vals, valid, comb, K):
 def make_sharded_reduce_step(mesh: Mesh, capacity: int, K: int,
                              comb: Callable, key_fn: Optional[Callable],
                              use_psum: bool = False,
-                             monoid: Optional[str] = None):
+                             monoid: Optional[str] = None,
+                             op_name: str = "mesh.reduce_step"):
     """Sharded ReduceTPU step with the operator's batch contract: returns
     ``fn(payload, ts, valid) -> (table, ts_out, has, n_dropped)`` where
     ``table`` is the dense ``[K]`` combined-record table, ``ts_out`` the
@@ -203,11 +205,12 @@ def make_sharded_reduce_step(mesh: Mesh, capacity: int, K: int,
     fn = shard_map(local, mesh=mesh,
                        in_specs=(P(axes), P(axes), P(axes)),
                        out_specs=(P(), P(), P(), P()), check_vma=False)
-    return jax.jit(fn)
+    return wf_jit(fn, op_name=op_name)
 
 
 def make_sharded_reduce_arbitrary(mesh: Mesh, capacity: int, comb: Callable,
-                                  key_fn: Callable):
+                                  key_fn: Callable,
+                                  op_name: str = "mesh.reduce_arbitrary"):
     """Keyed reduce over the mesh for an ARBITRARY int32 key space — no
     ``withMaxKeys`` bound and no dropped keys (VERDICT r2 item 5).
 
@@ -274,13 +277,14 @@ def make_sharded_reduce_arbitrary(mesh: Mesh, capacity: int, comb: Callable,
                        in_specs=(P(axes), P(axes), P(axes)),
                        out_specs=(P(axes), P(axes), P(axes), P()),
                        check_vma=False)
-    return jax.jit(fn)
+    return wf_jit(fn, op_name=op_name)
 
 
 def make_sharded_keyed_reduce(mesh: Mesh, capacity: int, K: int,
                               comb: Callable, key_fn: Callable,
                               use_psum: bool = False,
-                              monoid: Optional[str] = None):
+                              monoid: Optional[str] = None,
+                              op_name: str = "mesh.keyed_reduce"):
     """Compile a keyed reduce over the whole mesh; thin wrapper over
     :func:`make_sharded_reduce_step` (one implementation of the collective
     combine) that drops the timestamp/drop-count outputs.  Returns
@@ -294,7 +298,7 @@ def make_sharded_keyed_reduce(mesh: Mesh, capacity: int, K: int,
         table, _, has, _ = step(payload, ts, valid)
         return table, has
 
-    return jax.jit(fn)
+    return wf_jit(fn, op_name=op_name)
 
 
 # ---------------------------------------------------------------------------
@@ -366,7 +370,8 @@ def make_sharded_ffat_step(mesh: Mesh, capacity: int, K: int, Pn: int, R: int,
                            sum_like: bool = False,
                            grouping: str = "rank_scatter",
                            ingest: str = "data",
-                           monoid: Optional[str] = None):
+                           monoid: Optional[str] = None,
+                           op_name: str = "mesh.ffat_step"):
     """Compile one FFAT window step sharded over the mesh.
 
     State tables are split along ``key`` (chip *i* owns keys
@@ -390,11 +395,12 @@ def make_sharded_ffat_step(mesh: Mesh, capacity: int, K: int, Pn: int, R: int,
         in_specs=(P(KEY_AXIS), bspec, bspec, bspec),
         out_specs=(P(KEY_AXIS), P(KEY_AXIS), P(KEY_AXIS), P(KEY_AXIS)),
         check_vma=False)
-    return jax.jit(fn, donate_argnums=(0,))
+    return wf_jit(fn, op_name=op_name, donate_argnums=(0,))
 
 
 def make_sharded_ffat_flush(mesh: Mesh, K: int, Pn: int, R: int, D: int,
-                            comb: Callable):
+                            comb: Callable,
+                            op_name: str = "mesh.ffat_flush"):
     """EOS flush of the key-sharded CB state as an explicit shard_map:
     each key shard flushes its own rows (keys rebased by the shard's
     base) and the outputs stay key-sharded — so each host's sink reads
@@ -412,7 +418,7 @@ def make_sharded_ffat_flush(mesh: Mesh, K: int, Pn: int, R: int, D: int,
         in_specs=(P(KEY_AXIS),),
         out_specs=(P(KEY_AXIS), P(KEY_AXIS), P(KEY_AXIS)),
         check_vma=False)
-    return jax.jit(fn)
+    return wf_jit(fn, op_name=op_name)
 
 
 def make_sharded_ffat_state(agg_spec, K: int, R: int, mesh: Mesh):
@@ -425,7 +431,8 @@ def make_sharded_ffat_state(agg_spec, K: int, R: int, mesh: Mesh):
 def make_sharded_stateful_step(mesh: Mesh, capacity: int, S: int,
                                body_factory: Callable,
                                key_fn: Callable, dense: bool,
-                               is_filter: bool):
+                               is_filter: bool,
+                               op_name: str = "mesh.stateful_step"):
     """Key-sharded stateful Map/Filter step (reference stateful ``Map_GPU``
     whose keyed state is one shared table, ``map_gpu.hpp:114-115``; here the
     dense ``[num_key_slots, ...]`` table is split along ``key`` so each chip
@@ -504,7 +511,7 @@ def make_sharded_stateful_step(mesh: Mesh, capacity: int, S: int,
         in_specs=(P(KEY_AXIS), P(DATA_AXIS), P(DATA_AXIS), P(), P()),
         out_specs=(P(KEY_AXIS), P(DATA_AXIS), P(DATA_AXIS)),
         check_vma=False)
-    return jax.jit(fn, donate_argnums=(0,))
+    return wf_jit(fn, op_name=op_name, donate_argnums=(0,))
 
 
 # Time-based FFAT on the mesh.  The single-chip TB state keeps scalar pane
@@ -534,7 +541,8 @@ def make_sharded_ffat_tb_step(mesh: Mesh, capacity: int, K: int, P_usec: int,
                               grouping: str = "rank_scatter",
                               ingest: str = "data",
                               sum_like: bool = False,
-                              monoid: Optional[str] = None):
+                              monoid: Optional[str] = None,
+                              op_name: str = "mesh.ffat_tb_step"):
     """Compile one time-based FFAT step sharded over the mesh.
 
     Same layout as the CB variant (:func:`make_sharded_ffat_step`): state
@@ -575,4 +583,4 @@ def make_sharded_ffat_tb_step(mesh: Mesh, capacity: int, K: int, P_usec: int,
         in_specs=(sspec, bspec, bspec, bspec, P()),
         out_specs=(sspec, P(KEY_AXIS), P(KEY_AXIS), P(KEY_AXIS), P()),
         check_vma=False)
-    return jax.jit(fn, donate_argnums=(0,))
+    return wf_jit(fn, op_name=op_name, donate_argnums=(0,))

@@ -195,6 +195,33 @@ class StagingPool:
             self._held_bytes = 0
 
 
+class _DeviceBytes:
+    """Staging-attributed device-byte accounting (monitoring
+    ``stats()["Device"]["staging"]``): cumulative packed bytes shipped
+    host→device and the batch count behind them, noted by
+    ``batch.stage_packed`` at every fused transfer.  Plain int adds —
+    concurrent pool-thread updates may lose a tick, the same telemetry
+    tolerance as the graph's lock-free backpressure reads."""
+
+    __slots__ = ("staged_bytes_total", "staged_batches_total")
+
+    def __init__(self) -> None:
+        self.staged_bytes_total = 0
+        self.staged_batches_total = 0
+
+    def note(self, nbytes: int) -> None:
+        self.staged_bytes_total += nbytes
+        self.staged_batches_total += 1
+
+    def reset(self) -> None:
+        self.staged_bytes_total = 0
+        self.staged_batches_total = 0
+
+
+#: process-wide staged-transfer accounting (shared like the default pool)
+device_bytes = _DeviceBytes()
+
+
 _default_pool: Optional[StagingPool] = None
 _default_lock = threading.Lock()
 

@@ -41,6 +41,7 @@ import numpy as np
 
 from windflow_tpu.basic import RoutingMode, WindFlowError, WinType
 from windflow_tpu.batch import WM_NONE, DeviceBatch
+from windflow_tpu.monitoring.jit_registry import wf_jit
 from windflow_tpu.ops.base import Operator
 from windflow_tpu.ops.tpu import _TPUReplica
 from windflow_tpu.windows.engine import WindowSpec
@@ -235,12 +236,12 @@ class FfatWindowsTPU(Operator):
                     self.key_extractor,
                     drop_tainted=self.overflow_policy == "drop",
                     grouping=self._grouping(), ingest=ingest,
-                    monoid=self.monoid)
+                    monoid=self.monoid, op_name=f"{self.name}.mesh")
             return make_sharded_ffat_step(
                 self.mesh, capacity, self.max_keys, self.P, self.R, self.D,
                 self.lift, self.comb, self.key_extractor,
                 monoid=self.monoid, grouping=self._grouping(),
-                ingest=ingest)
+                ingest=ingest, op_name=f"{self.name}.mesh")
         if self.is_tb:
             step = make_ffat_tb_step(capacity, self.max_keys, self.P,
                                      self.R, self.D, self.NP,
@@ -256,7 +257,7 @@ class FfatWindowsTPU(Operator):
                                   self.key_extractor,
                                   monoid=self.monoid,
                                   grouping=self._grouping())
-        return jax.jit(step, donate_argnums=(0,))
+        return wf_jit(step, op_name=self.name, donate_argnums=(0,))
 
     def _grouping(self) -> str:
         """Batch-grouping algorithm from the graph config (rank_scatter |
@@ -686,6 +687,8 @@ class FfatWindowsTPU(Operator):
             from windflow_tpu.parallel.mesh import make_sharded_ffat_flush
             return make_sharded_ffat_flush(self.mesh, self.max_keys,
                                            self.P, self.R, self.D,
-                                           self.comb)
-        return jax.jit(make_ffat_flush(self.max_keys, self.P, self.R,
-                                       self.D, self.comb))
+                                           self.comb,
+                                           op_name=f"{self.name}.flush")
+        return wf_jit(make_ffat_flush(self.max_keys, self.P, self.R,
+                                      self.D, self.comb),
+                      op_name=f"{self.name}.flush")
