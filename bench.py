@@ -1086,6 +1086,46 @@ def main() -> None:
         # check_bench_keys loudly instead)
         result["preflight_error"] = f"{type(e).__name__}: {e}"[:200]
 
+    # health section (windflow_tpu/monitoring/health, guarded by
+    # tools/check_bench_keys.py): drive a representative pipeline with the
+    # watchdog ON and report stall events (any nonzero is a regression —
+    # the bench pipelines must run healthy) plus the watchdog's measured
+    # self-cost as a share of the run (same <2% stance as the flight
+    # recorder; the plane only runs at cadence, so this stays ~0)
+    try:
+        import windflow_tpu as wf
+        h_src = (wf.Source_Builder(
+            lambda: iter({"key": i % 64, "v0": float(i)}
+                         for i in range(65536)))
+            .withOutputBatchSize(4096).withName("h_src").build())
+        h_map = (wf.MapTPU_Builder(
+            lambda t: {"key": t["key"], "v0": t["v0"] * 1.5})
+            .withName("h_map").build())
+        h_snk = wf.Sink_Builder(lambda r: None).withName("h_snk").build()
+        h_pg = wf.PipeGraph("bench_health")
+        h_pg.add_source(h_src).add(h_map).add_sink(h_snk)
+        t0 = time.perf_counter()
+        h_pg.start()
+        while not h_pg.is_done():
+            if not h_pg.step():
+                break       # wait_end raises the diagnosed stall error
+            h_pg.health_tick()          # every sweep: worst-case cadence
+        h_pg.wait_end()
+        run_usec = (time.perf_counter() - t0) * 1e6
+        h = h_pg.stats()["Health"]
+        result["health"] = {
+            "graph_state": h["graph_state"],
+            "stall_events": h["stall_events"],
+            "watchdog_samples": h["samples_taken"],
+            "watchdog_overhead_pct": round(
+                100.0 * h["watchdog_usec_total"] / run_usec, 3)
+            if run_usec else 0.0,
+        }
+    except Exception as e:  # lint: broad-except-ok (same stance as the
+        # preflight leg: a health-plane regression must fail
+        # check_bench_keys loudly, not kill the bench artifact)
+        result["health_error"] = f"{type(e).__name__}: {e}"[:200]
+
     # device-plane section (windflow_tpu/monitoring/jit_registry, guarded
     # by tools/check_bench_keys.py): the compile watcher's process totals
     # over every leg above — compile wall cost, recompile events (any
@@ -1161,6 +1201,7 @@ def main() -> None:
                  "latency": result.get("latency"),
                  "preflight": result.get("preflight"),
                  "device": result.get("device"),
+                 "health": result.get("health"),
                  "e2e": result.get("e2e"),
                  "e2e_device_source": result.get("e2e_device_source"),
                  "ysb": result.get("ysb"),

@@ -159,9 +159,14 @@ def _run_dag(seed, config_rnd):
 # and before origin-id tie-breaking (HostBatch.ids) the tuples' window
 # assignment depended on which replica relayed them — equal counts,
 # different totals across configurations
-@pytest.mark.parametrize("seed", [101, 202, 303, 404, 505, 606,
-                                  707, 808, 909, 1212,
-                                  2009, 2011, 2018, 2031])
+# the three heaviest generic seeds (~13-16s each) ride the nightly run;
+# the ordering-regression seeds and the remaining generic seeds keep the
+# tier-1 fuzz coverage
+@pytest.mark.parametrize("seed", [
+    101, pytest.param(202, marks=pytest.mark.slow), 303, 404, 505, 606,
+    707, pytest.param(808, marks=pytest.mark.slow),
+    pytest.param(909, marks=pytest.mark.slow), 1212,
+    2009, 2011, 2018, 2031])
 def test_dag_fuzz(seed):
     oracle = _run_dag(seed, random.Random(seed * 13 + 1))
     for run in range(2, 4):

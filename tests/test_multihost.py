@@ -137,6 +137,8 @@ def test_ffat_flat_ingest_layout():
     assert len(exp) > 0 and got == exp
 
 
+@pytest.mark.slow  # ~37s: spawns two OS processes + a TCP coordinator;
+# the in-process multihost mesh tests above keep tier-1 coverage
 def test_two_process_dcn_reduce_and_ffat():
     """REAL multi-process validation (VERDICT r3 item 5): two OS processes
     join one jax.distributed job over a TCP coordinator with Gloo CPU

@@ -68,6 +68,8 @@ def test_soak_rss_bounded():
     assert growth_mb < 256, (early, late, growth_mb)
 
 
+@pytest.mark.slow  # ~60s: the pool-path soak rides the nightly run; the
+# driver-loop soak above plus test_host_pool.py keep tier-1 coverage
 @pytest.mark.skipif(sys.platform != "linux", reason="/proc RSS sampling")
 def test_soak_rss_bounded_host_pool():
     """Host-pipeline soak under the worker pool: half a million tuples

@@ -40,6 +40,13 @@ section from the compile watcher (``compile_ms_total``, ``recompiles``,
 docs/OBSERVABILITY.md "Device plane").  ``recompiles`` doubles as a
 regression tripwire: the bench pipelines pad to fixed capacities, so any
 nonzero value is a shape-drift bug.  Guarded here identically.
+
+Since the health round the bench also publishes a ``health`` section
+(``stall_events``, ``watchdog_overhead_pct`` — docs/OBSERVABILITY.md
+"Health plane") from a watchdog-on pipeline run.  ``stall_events``
+doubles as a tripwire: the bench pipeline must run healthy, so any
+nonzero value (or a non-OK ``graph_state``) is a watchdog
+false-positive or a real runtime regression.  Guarded here identically.
 """
 
 import json
@@ -50,6 +57,7 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 KEYS = ("ratio_vs_kernel", "staging_share_of_staged_run")
 LATENCY_KEYS = ("batch_p99_ms", "e2e_p50_ms", "e2e_p99_ms")
 DEVICE_KEYS = ("compile_ms_total", "recompiles", "flops_per_batch")
+HEALTH_KEYS = ("graph_state", "stall_events", "watchdog_overhead_pct")
 
 
 def fail(msg: str) -> None:
@@ -64,21 +72,21 @@ def check_source() -> None:
     if missing:
         fail(f"bench.py no longer emits {missing} — the e2e "
              "decomposition contract (docs/PERF.md) is broken")
-    missing = [k for k in LATENCY_KEYS if f'"{k}"' not in src] \
-        + ([] if '"latency"' in src else ['latency'])
-    if missing:
-        fail(f"bench.py no longer emits the latency section keys "
-             f"{missing} (docs/OBSERVABILITY.md contract)")
-    if '"preflight"' not in src or '"check_ms"' not in src:
-        fail("bench.py no longer emits the preflight section "
-             "('preflight'/'check_ms' — docs/ANALYSIS.md contract)")
-    missing = [k for k in ("device", "flops_per_batch") if f'"{k}"' not in src]
-    if missing or "compile_ms_total" not in src:
-        fail(f"bench.py no longer emits the device section keys "
-             f"{missing or ['compile_ms_total']} (compile watcher — "
-             "docs/OBSERVABILITY.md device-plane contract)")
+    for section, keys, contract in (
+            ("latency", LATENCY_KEYS, "docs/OBSERVABILITY.md"),
+            ("preflight", ("check_ms",), "docs/ANALYSIS.md"),
+            ("device", DEVICE_KEYS,
+             "compile watcher — docs/OBSERVABILITY.md device-plane"),
+            ("health", HEALTH_KEYS,
+             "watchdog — docs/OBSERVABILITY.md health-plane")):
+        missing = [k for k in keys if f'"{k}"' not in src] \
+            + ([] if f'"{section}"' in src else [section])
+        if missing:
+            fail(f"bench.py no longer emits the {section} section keys "
+                 f"{missing} ({contract} contract)")
     print("check_bench_keys: OK (bench.py source emits "
-          + ", ".join(KEYS + ("latency", "preflight", "device")) + ")")
+          + ", ".join(KEYS + ("latency", "preflight", "device",
+                              "health")) + ")")
 
 
 def last_json_object(path: str):
@@ -147,6 +155,21 @@ def check_output(path: str) -> None:
         # absence IS the observability regression this guard catches
         fail("bench device section absent or errored "
              f"(device_error={result.get('device_error')!r})")
+    health = result.get("health")
+    if isinstance(health, dict):
+        missing = [k for k in HEALTH_KEYS if k not in health]
+        if missing:
+            fail(f"'health' section missing {missing} from bench output")
+        if health.get("stall_events") or health.get("graph_state") != "OK":
+            # the bench pipeline must run healthy: a stall event or a
+            # degraded graph verdict here is either a watchdog
+            # false-positive or a real runtime regression — both block
+            fail(f"bench health run degraded: {health}")
+    else:
+        # like preflight, the watchdog leg is device-free — its absence
+        # IS the observability regression this guard catches
+        fail("bench health section absent or errored "
+             f"(health_error={result.get('health_error')!r})")
     pf = result.get("preflight")
     if isinstance(pf, dict):
         if "check_ms" not in pf:

@@ -1,0 +1,221 @@
+#!/usr/bin/env python
+"""wf_doctor: render a windflow_tpu postmortem bundle into a diagnosis.
+
+A crash or watchdog-confirmed stall writes a black-box bundle
+(``PipeGraph.dump_postmortem`` — flight-recorder rings, the last stats
+report, health verdict timeline + stall attribution, jit/device tables,
+preflight findings).  This tool turns that directory into a human
+diagnosis — or validates it — with **no jax installed** (pure stdlib,
+same scrape-host stance as ``tools/wf_metrics.py``).
+
+Usage::
+
+    python tools/wf_doctor.py log/app_postmortem            # diagnose
+    python tools/wf_doctor.py --check log/app_postmortem    # validate:
+        # manifest schema, every listed file parses, health states and
+        # span stages are legal, stall attribution names a known
+        # operator; exit 1 on any violation
+    python tools/wf_doctor.py --json log/app_postmortem     # machine-
+        # readable diagnosis (the same fields the text render shows)
+
+The CI round trip (tests/test_health.py) seeds a stall, lets the crash
+path write a bundle, and runs ``--check`` on it in a subprocess.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+#: mirrors monitoring/health.py (kept literal: this file must not import
+#: the package — the package __init__ imports jax)
+SCHEMA = "wf-postmortem/1"
+STATES = ("OK", "BACKPRESSURED", "STALLED", "FAILED")
+STAGE_NAMES = ("staged", "emitted", "dispatched", "device_done",
+               "collected", "sunk")
+SECTIONS = ("stats.json", "events.json", "health.json", "device.json",
+            "jit.json", "preflight.json")
+
+
+class BundleError(Exception):
+    pass
+
+
+def load_bundle(path: str) -> dict:
+    """Read manifest + every section it lists.  Raises
+    :class:`BundleError` on structural violations (the --check half);
+    sections recorded under manifest ``errors`` are allowed to be
+    absent — a crash-path bundle degrades per section by design."""
+    if not os.path.isdir(path):
+        raise BundleError(f"{path} is not a bundle directory")
+    mpath = os.path.join(path, "manifest.json")
+    try:
+        with open(mpath) as f:
+            manifest = json.load(f)
+    except OSError as e:
+        raise BundleError(f"no readable manifest.json: {e}") from None
+    except ValueError as e:
+        raise BundleError(f"manifest.json is not valid JSON: {e}") from None
+    if manifest.get("schema") != SCHEMA:
+        raise BundleError(f"unknown bundle schema "
+                          f"{manifest.get('schema')!r} (want {SCHEMA!r})")
+    for key in ("app", "reason", "written_at_usec", "files", "errors"):
+        if key not in manifest:
+            raise BundleError(f"manifest missing {key!r}")
+    sections = {}
+    for name in manifest["files"]:
+        fp = os.path.join(path, name)
+        try:
+            with open(fp) as f:
+                sections[name] = json.load(f)
+        except OSError as e:
+            raise BundleError(f"manifest lists {name} but it is "
+                              f"unreadable: {e}") from None
+        except ValueError as e:
+            raise BundleError(f"{name} is not valid JSON: {e}") from None
+    return {"dir": path, "manifest": manifest, "sections": sections}
+
+
+def validate(bundle: dict) -> None:
+    """The --check contract beyond load_bundle's structural pass."""
+    manifest = bundle["manifest"]
+    sections = bundle["sections"]
+    missing = [s for s in SECTIONS
+               if s not in sections and s not in manifest["errors"]]
+    if missing:
+        raise BundleError(
+            f"sections neither written nor accounted for in "
+            f"manifest errors: {missing}")
+    health = sections.get("health.json") or {}
+    verdicts = health.get("verdicts") or {}
+    for op, v in verdicts.items():
+        if v.get("state") not in STATES:
+            raise BundleError(
+                f"health.json: operator {op!r} has illegal state "
+                f"{v.get('state')!r} (want one of {STATES})")
+    for entry in health.get("timeline") or []:
+        for op, state in (entry.get("changes") or {}).items():
+            if state not in STATES:
+                raise BundleError(
+                    f"health.json timeline: illegal state {state!r} "
+                    f"for {op!r}")
+    stall = health.get("last_stall")
+    if stall and stall.get("root_cause") is not None \
+            and stall["root_cause"] not in verdicts:
+        raise BundleError(
+            f"last_stall attributes {stall['root_cause']!r} but that "
+            "operator has no verdict entry")
+    for e in sections.get("events.json") or []:
+        if e.get("stage") not in STAGE_NAMES:
+            raise BundleError(
+                f"events.json: illegal span stage {e.get('stage')!r}")
+
+
+def diagnose(bundle: dict) -> dict:
+    """Condense the bundle into the fields a responder reads first."""
+    manifest = bundle["manifest"]
+    sections = bundle["sections"]
+    health = sections.get("health.json") or {}
+    verdicts = health.get("verdicts") or {}
+    stats = sections.get("stats.json") or {}
+    gauges = stats.get("Gauges") or {}
+    jit = (sections.get("jit.json") or {}).get("totals") or {}
+    stall = health.get("last_stall") or None
+    bad = {op: v for op, v in verdicts.items() if v.get("state") != "OK"}
+    return {
+        "app": manifest.get("app"),
+        "reason": manifest.get("reason"),
+        "written_at_usec": manifest.get("written_at_usec"),
+        "graph_state": health.get("graph_state"),
+        "stall_events": health.get("stall_events", 0),
+        "root_cause": stall.get("root_cause") if stall else None,
+        "unhealthy_operators": bad,
+        "verdicts": verdicts,
+        "timeline": health.get("timeline") or [],
+        "throughput_1s_tps": gauges.get("throughput_1s_tps"),
+        "dropped_tuples": stats.get("Dropped_tuples"),
+        "recompiles": jit.get("recompiles"),
+        "compile_ms_total": jit.get("compile_ms_total"),
+        "span_events": len(sections.get("events.json") or []),
+        "section_errors": manifest.get("errors") or {},
+    }
+
+
+def _age(usec) -> str:
+    return "?" if usec is None else f"{usec / 1e6:.1f}s"
+
+
+def render_text(d: dict) -> str:
+    lines = [
+        f"wf_doctor: app '{d['app']}' — {d['reason']}",
+        f"  graph state: {d['graph_state'] or '?'}   "
+        f"stall events: {d['stall_events']}   "
+        f"span events retained: {d['span_events']}",
+    ]
+    if d["root_cause"]:
+        v = d["verdicts"].get(d["root_cause"], {})
+        lines.append(
+            f"  ROOT CAUSE: '{d['root_cause']}' stopped draining — "
+            f"queue={v.get('queue_depth')}, "
+            f"frontier={v.get('watermark_frontier_usec')}, "
+            f"last advance {_age(v.get('last_advance_age_usec'))} ago")
+    lines.append("  operators:")
+    for op, v in d["verdicts"].items():
+        extra = " [compile storm]" if v.get("compile_storm") else ""
+        fail = f" — {v['failure']}" if v.get("failure") else ""
+        lines.append(
+            f"    {op:<24} {v.get('state', '?'):<14} "
+            f"queue={v.get('queue_depth', 0):<6} "
+            f"advance_age={_age(v.get('last_advance_age_usec'))}"
+            f"{extra}{fail}")
+    if d["timeline"]:
+        lines.append("  verdict timeline (state changes):")
+        for entry in d["timeline"][-12:]:
+            changes = ", ".join(f"{op}→{s}" for op, s
+                                in (entry.get("changes") or {}).items())
+            lines.append(f"    t={entry.get('t_usec')}: {changes}")
+    lines.append(
+        f"  telemetry: throughput_1s={d['throughput_1s_tps']} tps, "
+        f"dropped={d['dropped_tuples']}, "
+        f"recompiles={d['recompiles']}, "
+        f"compile_ms_total={d['compile_ms_total']}")
+    if d["section_errors"]:
+        lines.append(f"  degraded sections: {d['section_errors']}")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("bundle", help="postmortem bundle directory "
+                                   "(PipeGraph.dump_postmortem output)")
+    ap.add_argument("--check", action="store_true",
+                    help="validate the bundle instead of rendering it")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the diagnosis as JSON")
+    args = ap.parse_args(argv)
+    try:
+        bundle = load_bundle(args.bundle)
+        validate(bundle)
+    except BundleError as e:
+        print(f"wf_doctor: FAIL: {e}", file=sys.stderr)
+        return 1
+    if args.check:
+        m = bundle["manifest"]
+        print(f"wf_doctor: OK ({len(bundle['sections'])} sections, "
+              f"app '{m['app']}', reason {m['reason']!r}"
+              + (f", {len(m['errors'])} degraded" if m["errors"] else "")
+              + ")")
+        return 0
+    d = diagnose(bundle)
+    if args.json:
+        json.dump(d, sys.stdout, indent=1)
+        print()
+    else:
+        print(render_text(d))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -198,6 +198,42 @@ class Config:
     # error-severity diagnostics (warnings are warned), "warn" downgrades
     # everything to warnings, "off" skips the pass entirely.
     preflight: str = os.environ.get("WF_TPU_PREFLIGHT", "error")
+    # Health plane (monitoring/health.py, docs/OBSERVABILITY.md): a
+    # watchdog evaluated at monitor cadence (never per batch) derives a
+    # per-operator OK/BACKPRESSURED/STALLED/FAILED state from the sampled
+    # gauges, attributes stalls to a root-cause operator, and feeds the
+    # postmortem bundle.  Off removes the plane entirely — every call
+    # site keeps one `is not None` check.
+    health_watchdog: bool = bool(int(os.environ.get("WF_TPU_HEALTH", "1")))
+    # An operator with pending input whose progress counters (inputs
+    # received, watermark frontier) have not moved for this long is
+    # STALLED (microseconds).
+    health_stall_grace_usec: int = int(os.environ.get(
+        "WF_TPU_HEALTH_STALL_GRACE", "5000000"))
+    # Summed replica inbox depth at/above which an operator that is still
+    # making progress is BACKPRESSURED.  0 = derive from the in-transit
+    # cap (max_inbox_messages // 2).
+    health_backpressure_depth: int = int(os.environ.get(
+        "WF_TPU_HEALTH_BP_DEPTH", "0"))
+    # Compile-watcher recompiles per op name at/above which the operator
+    # is flagged as in a recompilation storm (BACKPRESSURED verdict).
+    health_recompile_storm: int = int(os.environ.get(
+        "WF_TPU_HEALTH_RECOMPILE_STORM", "4"))
+    # Health state-change timeline entries retained for the postmortem.
+    health_history: int = int(os.environ.get("WF_TPU_HEALTH_HISTORY",
+                                             "256"))
+    # Black-box postmortem bundle directory written by
+    # PipeGraph.dump_postmortem — best-effort on the wait_end crash path
+    # and on watchdog-confirmed stalls ("" = "{log_dir}/{name}_postmortem";
+    # tools/wf_doctor.py renders/validates a bundle offline).
+    health_postmortem_dir: str = os.environ.get(
+        "WF_TPU_HEALTH_POSTMORTEM_DIR", "")
+    # Write the postmortem bundle automatically when wait_end crashes or
+    # the watchdog confirms a stall (the bundle is exactly the telemetry
+    # a crash used to discard).  dump_postmortem() stays callable either
+    # way.
+    health_postmortem_on_crash: bool = bool(int(os.environ.get(
+        "WF_TPU_HEALTH_POSTMORTEM", "1")))
     # Multi-chip execution: a jax.sharding.Mesh with ("data", "key") axes
     # (see windflow_tpu.parallel.mesh.make_mesh).  When set, staging emitters
     # lay batches out data-sharded across the mesh and mesh-aware TPU

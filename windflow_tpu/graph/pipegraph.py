@@ -30,6 +30,7 @@ from __future__ import annotations
 import dataclasses
 import json
 import os
+import threading
 import time
 from collections import deque
 from typing import List, Optional
@@ -89,6 +90,16 @@ class PipeGraph:
         # flight recorder (monitoring/recorder.py): built in _build when
         # Config.flight_recorder is on; None means every hook is inert
         self._recorder = None
+        # health plane (monitoring/health.py): watchdog built in _build
+        # when Config.health_watchdog is on; None means every call site
+        # is one flag check (the documented off-path)
+        self._health = None
+        # last postmortem bundle written (crash path or dump_postmortem);
+        # the lock serializes writers — the monitor thread's watchdog
+        # auto-bundle and the driver's stall/crash path may race into
+        # the same directory
+        self._postmortem_dir = None
+        self._postmortem_lock = threading.Lock()
         # rolling-throughput gauge samples: (wall_s, tuples_sunk_total),
         # appended by sample_gauges() (the monitoring thread calls it once
         # per second; stats() also samples so headless runs get gauges)
@@ -257,6 +268,13 @@ class PipeGraph:
                 rep.emitter.bind_observability(rep.stats, rep.ring,
                                                self._recorder)
 
+        # 3c. health plane (monitoring/health.py): per-operator watchdog
+        # evaluated at monitor cadence — built here so the operator list
+        # is final; off leaves _health None (one flag check per call site)
+        if cfg.health_watchdog:
+            from windflow_tpu.monitoring.health import HealthPlane
+            self._health = HealthPlane(self)
+
         # sanity: every non-sink replica must have an emitter
         for op in self._operators:
             for rep in op.replicas:
@@ -301,17 +319,85 @@ class PipeGraph:
         try:
             while not self.is_done():
                 if not self.step():
-                    raise WindFlowError(
-                        "PipeGraph stalled: no replica made progress but "
-                        "the graph has not terminated (routing bug?)")
-        except BaseException:
-            # release threads but do NOT dump stats: a stats dump touching
-            # a dead backend would raise inside the handler and mask the
-            # root-cause operator error
-            self._finalize(dump=False)
+                    raise self._stall_error()
+        except BaseException as exc:
+            # Crash path: salvage the telemetry FIRST (health attribution
+            # + postmortem bundle — the rings/histograms/jit tables are
+            # most valuable exactly now), then release threads.  Do NOT
+            # dump stats: a stats dump touching a dead backend would raise
+            # inside the handler and mask the root-cause operator error;
+            # the postmortem writer guards every section individually.
+            try:
+                if self._health is not None:
+                    # the synthetic stall error has no replica frame in
+                    # its traceback, so attribution is a no-op for it; a
+                    # genuine replica-raised WindFlowError attributes
+                    # like any crash
+                    self._health.note_failure(exc)
+                self._write_crash_postmortem(exc)
+            except BaseException:  # lint: broad-except-ok (salvage must
+                # never mask the root-cause error re-raised below — a
+                # second Ctrl-C here aborts the salvage, not the teardown)
+                pass
+            finally:
+                self._finalize(dump=False, aborted=True)
             raise
         self._finalize()
         return self
+
+    def _stall_error(self) -> WindFlowError:
+        """Build the stall error with the health plane's root-cause
+        diagnosis (per-op queue depth, frontier, last-advance age) —
+        "routing bug?" told the user nothing.  Also writes the postmortem
+        bundle (watchdog-confirmed stall) so the message can point at it."""
+        head = ("PipeGraph stalled: no replica made progress but the "
+                "graph has not terminated. ")
+        if self._health is None:
+            return WindFlowError(
+                head + "Health watchdog is off (Config.health_watchdog / "
+                "WF_TPU_HEALTH=0) — no diagnosis available; re-run with "
+                "it on for root-cause attribution")
+        try:
+            diag = self._health.diagnose_stall()
+            msg = head + self._health.format_diagnosis(diag)
+        except Exception as e:  # lint: broad-except-ok (same stance as
+            # every other health read: a watchdog bug must not replace
+            # the stall error — an undiagnosed stall beats a KeyError)
+            msg = head + (f"(health diagnosis failed: "
+                          f"{type(e).__name__}: {e}"[:200] + ")")
+        err = WindFlowError(msg)
+        if self.config.health_postmortem_on_crash:
+            # always dump a fresh frame here — a watchdog bundle written
+            # minutes ago (possibly for a recovered transient stall) is
+            # staler than the diagnosis just taken; the write is
+            # serialized by the postmortem lock
+            bundle = self._safe_postmortem("stall")
+            if bundle:
+                # mark THE exception as already bundled: the crash-path
+                # handler keys off this, not graph state, so neither a
+                # manual snapshot nor an old watchdog bundle can suppress
+                # a genuine crash bundle later
+                err._wf_postmortem_bundle = bundle
+                err.args = (msg + f". Postmortem bundle: {bundle}",)
+        return err
+
+    def _write_crash_postmortem(self, exc: BaseException) -> None:
+        """Best-effort bundle on abnormal termination.  Skipped only when
+        THIS exception is the stall error whose bundle _stall_error just
+        wrote — any other failure captures crash-time telemetry no matter
+        what was bundled before."""
+        if self.config.health_postmortem_on_crash \
+                and getattr(exc, "_wf_postmortem_bundle", None) is None:
+            self._safe_postmortem(f"crash: {type(exc).__name__}: "
+                                  f"{exc}"[:300])
+
+    def _safe_postmortem(self, reason: str) -> Optional[str]:
+        try:
+            return self.dump_postmortem(reason=reason)
+        except Exception:  # lint: broad-except-ok (the postmortem writer
+            # runs inside crash handlers; any failure here must never mask
+            # the root-cause operator error being propagated)
+            return None
 
     # -- static analysis (windflow_tpu/analysis) -----------------------------
     def check(self) -> list:
@@ -469,12 +555,15 @@ class PipeGraph:
     def is_done(self) -> bool:
         return all(r.done for r in self._all_replicas)
 
-    def _finalize(self, dump: bool = True) -> None:
+    def _finalize(self, dump: bool = True, aborted: bool = False) -> None:
         if self._pool is not None:
             self._pool.shutdown(wait=True)
             self._pool = None
         if self._monitor is not None:
-            self._monitor.stop()
+            # abnormal termination still ships a final report + END_APP
+            # best-effort (the dashboard used to show crashed apps live
+            # forever); the monitor marks the report Aborted
+            self._monitor.stop(aborted=aborted)
             self._monitor = None
         if dump and self.config.tracing_enabled:
             self.dump_stats()
@@ -504,6 +593,25 @@ class PipeGraph:
                     if op.is_terminal for r in op.replicas)
         self._thr_samples.append((time.monotonic(), total))
 
+    def health_tick(self) -> None:
+        """One watchdog evaluation (monitoring/health.py).  The monitoring
+        thread calls this on its cadence — and, like ``sample_gauges``,
+        headless runs get the same tick from every ``stats()`` read.  With
+        ``Config.health_watchdog`` off this is the whole cost: one check."""
+        if self._health is not None:
+            self._health.sample()
+
+    def _health_section(self) -> dict:
+        if self._health is None:
+            return {"enabled": False}
+        try:
+            return self._health.section()
+        except Exception as e:  # lint: broad-except-ok (same stance as
+            # the device section: a watchdog read must never take the
+            # pipeline or a stats dump down)
+            return {"enabled": True, "error": f"{type(e).__name__}: "
+                                              f"{e}"[:200]}
+
     def _rolling_rate(self, window_s: float) -> float:
         """Sunk-tuples/sec over (at least) the trailing ``window_s``: the
         delta between the newest sample and the youngest sample that is at
@@ -522,27 +630,33 @@ class PipeGraph:
         dt = now_t - base[0]
         return (now_v - base[1]) / dt if dt > 0 else 0.0
 
+    def op_frontier_and_depth(self, op) -> tuple:
+        """``(summed inbox depth, watermark frontier)`` for one operator.
+        Frontier = MIN over replicas (watermark semantics): the lag gauge
+        and the health watchdog must surface a stalled replica, not hide
+        it behind its most-advanced sibling.  Shared by :meth:`gauges`
+        and the health plane's stall detection so the two can never
+        drift."""
+        from windflow_tpu.batch import WM_MAX, WM_NONE
+        depth = 0
+        fronts = []
+        for rep in op.replicas:
+            depth += len(rep.inbox)
+            wm = rep.current_wm
+            if wm != WM_NONE and wm < WM_MAX:
+                fronts.append(wm)
+        return depth, (min(fronts) if fronts else None)
+
     def gauges(self) -> dict:
         """Point-in-time gauges (sampled by the monitoring thread into the
         NEW_REPORT payload): per-operator watermark lag (wall clock minus
         frontier — meaningful under INGRESS/wall-based EVENT time) and
         inbox queue depth, staging-pool occupancy, rolling throughput."""
-        from windflow_tpu.batch import WM_MAX, WM_NONE
         from windflow_tpu import staging
         now = current_time_usecs()
         per_op = {}
         for op in self._operators:
-            depth = 0
-            fronts = []
-            for rep in op.replicas:
-                depth += len(rep.inbox)
-                wm = rep.current_wm
-                if wm != WM_NONE and wm < WM_MAX:
-                    fronts.append(wm)
-            # operator frontier = MIN over replicas (watermark semantics):
-            # the lag gauge must surface a stalled replica, not hide it
-            # behind its most-advanced sibling
-            front = min(fronts) if fronts else None
+            depth, front = self.op_frontier_and_depth(op)
             per_op[op.name] = {
                 "queue_depth": depth,
                 "watermark_frontier_usec": front,
@@ -690,6 +804,9 @@ class PipeGraph:
             },
             "Latency": self._latency_section(),
             "Gauges": self.gauges(),
+            # health plane (monitoring/health.py): per-operator watchdog
+            # verdicts, stall counters + attribution, verdict timeline
+            "Health": self._health_section(),
             # device plane (monitoring/device_metrics.py): compile-watcher
             # per-op table, HBM/live-buffer gauges, staging-attributed
             # device bytes — the ``"Device"`` half of the telemetry story
@@ -715,3 +832,88 @@ class PipeGraph:
         with open(path, "w") as f:
             json.dump(self.stats(), f, indent=2)
         return path
+
+    def dump_postmortem(self, dir: Optional[str] = None,
+                        reason: str = "manual") -> str:
+        """Black-box postmortem bundle: flight-recorder rings, the last
+        ``stats()``, health verdict timeline + stall attribution, jit and
+        device tables, preflight findings — written as one directory of
+        JSON files that ``tools/wf_doctor.py`` renders and validates with
+        no jax installed.  Every section is individually guarded (section
+        failures land in the manifest's ``errors`` map, they never abort
+        the bundle): the crash path calls this exactly when parts of the
+        telemetry may be broken.  Returns the bundle directory."""
+        with self._postmortem_lock:
+            return self._dump_postmortem_locked(dir, reason)
+
+    def _dump_postmortem_locked(self, dir: Optional[str],
+                                reason: str) -> str:
+        # suppress the watchdog auto-bundle on THIS thread for the
+        # duration of the write: the stats section below re-enters
+        # HealthPlane.sample(), and an auto-bundle fired from there
+        # would re-enter this non-reentrant lock and deadlock inside a
+        # crash handler.  Thread-scoped suppression only — a manual
+        # snapshot must not consume the once-per-graph auto-bundle, and
+        # another thread's concurrent auto-bundle just serializes behind
+        # the lock.
+        if self._health is not None:
+            self._health._bundle_thread = threading.get_ident()
+        try:
+            return self._dump_postmortem_impl(dir, reason)
+        finally:
+            if self._health is not None:
+                self._health._bundle_thread = None
+
+    def _dump_postmortem_impl(self, dir: Optional[str],
+                              reason: str) -> str:
+        d = dir or self.config.health_postmortem_dir \
+            or os.path.join(self.config.log_dir, f"{self.name}_postmortem")
+        os.makedirs(d, exist_ok=True)
+        files: List[str] = []
+        errors: dict = {}
+
+        def write(name: str, build) -> None:
+            try:
+                obj = build()
+                with open(os.path.join(d, name), "w") as f:
+                    json.dump(obj, f, indent=1, default=str)
+                files.append(name)
+            except Exception as e:  # lint: broad-except-ok (postmortem
+                # sections must degrade independently — a dead backend
+                # breaking stats() must not lose the rings or verdicts)
+                errors[name] = f"{type(e).__name__}: {e}"[:300]
+
+        write("stats.json", self.stats)
+        write("events.json",
+              lambda: self._recorder.events()
+              if self._recorder is not None else [])
+        write("health.json",
+              lambda: self._health.section(sample_first=False)
+              if self._health is not None else {"enabled": False})
+        write("device.json", self._device_section)
+
+        def jit_tables():
+            from windflow_tpu.monitoring.jit_registry import \
+                default_registry
+            reg = default_registry()
+            return {"jit": reg.snapshot(), "totals": reg.totals()}
+        write("jit.json", jit_tables)
+        write("preflight.json", lambda: {
+            "mode": getattr(self.config, "preflight", "error"),
+            "check_ms": self._preflight_ms,
+            "diagnostics": (None if self._preflight_diags is None
+                            else [str(dg) for dg in self._preflight_diags]),
+        })
+        from windflow_tpu.monitoring.health import POSTMORTEM_SCHEMA
+        manifest = {
+            "schema": POSTMORTEM_SCHEMA,
+            "app": self.name,
+            "reason": reason,
+            "written_at_usec": current_time_usecs(),
+            "files": files,
+            "errors": errors,
+        }
+        with open(os.path.join(d, "manifest.json"), "w") as f:
+            json.dump(manifest, f, indent=1)
+        self._postmortem_dir = d
+        return d

@@ -6,6 +6,7 @@ in docs/OBSERVABILITY.md)."""
 
 from windflow_tpu.monitoring.dashboard import DashboardServer
 from windflow_tpu.monitoring.diagram import to_dot, to_svg
+from windflow_tpu.monitoring.health import HealthPlane
 from windflow_tpu.monitoring.monitor import MonitoringThread
 from windflow_tpu.monitoring.openmetrics import (parse_exposition,
                                                  render_openmetrics)

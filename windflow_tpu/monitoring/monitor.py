@@ -21,12 +21,20 @@ rolling-rate window is fed by ``PipeGraph.sample_gauges``).
 Like the reference (``monitoring.hpp:197-200``), the thread ships no more
 reports once the dashboard is unreachable or any send fails — monitoring
 must never take the pipeline down.  Unlike the reference, SAMPLING is
-decoupled from SHIPPING: the rolling 1s/10s throughput gauges are fed by
-this thread's cadence (``PipeGraph.sample_gauges``), so a headless run —
-no dashboard listening, or a dashboard that died mid-run — keeps sampling
-on the same cadence and only stops sending.  (Before this split the
-gauges starved whenever the TCP connection was down: ``stats()`` read at
-the end of a run saw a throughput window that had never advanced.)
+decoupled from SHIPPING: the rolling 1s/10s throughput gauges and the
+health watchdog (``PipeGraph.sample_gauges`` / ``health_tick``,
+monitoring/health.py) are fed by this thread's cadence, so a headless
+run — no dashboard listening, or a dashboard that died mid-run — keeps
+sampling on the same cadence and only stops sending.  (Before this split
+the gauges starved whenever the TCP connection was down: ``stats()``
+read at the end of a run saw a throughput window that had never
+advanced.)
+
+Termination is best-effort on BOTH paths: normal completion and an
+aborted run (``wait_end`` raised) each ship a final report + ``END_APP``
+(``_send_final``), degrading from full stats to a minimal
+name+``Aborted`` payload when ``stats()`` itself is broken — before
+this, a crashed app stayed "live" on the dashboard forever.
 """
 
 from __future__ import annotations
@@ -65,6 +73,8 @@ class MonitoringThread:
         self._stop = threading.Event()
         self.active = False      # a dashboard connection is up
         self.samples_taken = 0   # gauge samples on cadence (shipped or not)
+        self.aborted = False     # abnormal termination (wait_end raised)
+        self.end_app_sent = False
 
     # -- protocol ------------------------------------------------------------
     def _register_app(self) -> None:
@@ -77,14 +87,41 @@ class MonitoringThread:
             raise ConnectionError(f"dashboard rejected NEW_APP: {status}")
         self.identifier = ident
 
-    def _send_report(self, msg_type: int) -> None:
-        payload = json.dumps(self.graph.stats()).encode() + b"\0"
+    def _send_report(self, msg_type: int,
+                     report: dict | None = None) -> None:
+        payload = json.dumps(report if report is not None
+                             else self.graph.stats()).encode() + b"\0"
         self._sock.sendall(struct.pack(">iii", msg_type, self.identifier,
                                           len(payload)))
         self._sock.sendall(payload)
         status, _ = struct.unpack(">ii", recv_exact(self._sock, 8))
         if status != 0:
             raise ConnectionError(f"dashboard rejected report: {status}")
+
+    def _send_final(self) -> None:
+        """Final report + END_APP, best-effort on BOTH termination paths.
+        Before this existed, a wait_end crash left the dashboard showing
+        the app live forever: stats() on a dead backend raised a
+        non-OSError past the loop's handler and the thread died without
+        END_APP.  Now the final report degrades (full stats → minimal
+        name+Aborted payload) instead of vanishing."""
+        if not self.active:
+            return
+        try:
+            report = self.graph.stats()
+            if self.aborted:
+                report["Aborted"] = True
+        except Exception:  # lint: broad-except-ok (crash-path stats()
+            # may touch a dead backend; END_APP must still reach the
+            # dashboard with whatever payload survives)
+            report = {"PipeGraph_name": self.graph.name, "Aborted": True,
+                      "stats_error": "stats() raised during termination"}
+        try:
+            self._send_report(TYPE_END_APP, report)
+            self.end_app_sent = True
+        except Exception:  # lint: broad-except-ok (monitoring must never
+            # take termination down — a dead socket here is a no-op)
+            pass
 
     # -- thread --------------------------------------------------------------
     def _run(self) -> None:
@@ -111,17 +148,31 @@ class MonitoringThread:
                     last = now
                     self.samples_taken += 1
                     if self.active:
-                        # stats() inside _send_report samples the gauges,
-                        # so the shipped report and the rolling window
-                        # advance on the same tick
+                        # stats() inside _send_report samples the gauges
+                        # AND the health watchdog, so the shipped report,
+                        # the rolling window and the verdicts advance on
+                        # the same tick
                         try:
                             self._send_report(TYPE_NEW_REPORT)
                         except OSError:
-                            self._disconnect()  # keep sampling headless
+                            # socket/protocol dead: keep sampling headless
+                            self._disconnect()
+                        except Exception:  # lint: broad-except-ok (a
+                            # transient stats() failure raises BEFORE any
+                            # bytes hit the wire — the report serializes
+                            # first — so the protocol is still in sync:
+                            # keep the connection, skip this tick, and
+                            # END_APP still goes out at termination)
+                            pass
                     else:
-                        self.graph.sample_gauges()
-            if self.active:
-                self._send_report(TYPE_END_APP)
+                        try:
+                            self.graph.sample_gauges()
+                            self.graph.health_tick()
+                        except Exception:  # lint: broad-except-ok (a
+                            # headless sampling failure must not kill the
+                            # thread — the final report still goes out)
+                            pass
+            self._send_final()
         except OSError:
             pass
         finally:
@@ -141,7 +192,9 @@ class MonitoringThread:
                                         name="wf-monitoring")
         self._thread.start()
 
-    def stop(self, timeout: float = 5.0) -> None:
+    def stop(self, timeout: float = 5.0, aborted: bool = False) -> None:
+        if aborted:
+            self.aborted = True   # final report carries the crash marker
         self._stop.set()
         if self._thread is not None:
             self._thread.join(timeout)

@@ -208,6 +208,32 @@ def _families(stats: dict,
             "Host bytes retained by the staging recycling pool") \
             .add(gauges["staging_pool_held_bytes"], base)
 
+    # -- health plane --------------------------------------------------------
+    health = stats.get("Health") or {}
+    if health.get("enabled"):
+        # enum gauge (the Prometheus enum pattern): one sample per
+        # (operator, state) with 1 on the active state — alertable with
+        # `wf_operator_health{state="stalled"} == 1` and graphable as a
+        # state timeline without label joins
+        f_health = fam("wf_operator_health", "gauge",
+                       "Per-operator watchdog state (enum gauge: 1 on "
+                       "the active state)")
+        for name, v in (health.get("verdicts") or {}).items():
+            active = str(v.get("state", "")).lower()
+            for state in ("ok", "backpressured", "stalled", "failed"):
+                f_health.add(1 if active == state else 0,
+                             dict(base, operator=name, state=state))
+        fam("wf_stall_events_total", "counter",
+            "Watchdog-confirmed stall events (root-cause attributed)") \
+            .add(health.get("stall_events", 0), base)
+        f_age = fam("wf_health_last_advance_age_usec", "gauge",
+                    "Age of the operator's last progress "
+                    "(inputs/frontier) observation")
+        for name, v in (health.get("verdicts") or {}).items():
+            if v.get("last_advance_age_usec") is not None:
+                f_age.add(v["last_advance_age_usec"],
+                          dict(base, operator=name))
+
     # -- latency histograms --------------------------------------------------
     lat = stats.get("Latency") or {}
     f_svc = fam("wf_service_latency_usec", "histogram",

@@ -26,6 +26,9 @@ INDEX_HTML = """<!DOCTYPE html>
   th { background: #f7f7f7; }
   td:first-child, th:first-child { text-align: left; }
   .spark { vertical-align: middle; }
+  .hOK { color: #1a7f37; font-weight: 600; }
+  .hBACKPRESSURED { color: #b8860b; font-weight: 600; }
+  .hSTALLED, .hFAILED { color: #c0392b; font-weight: 600; }
   #meta { font-size: 12px; color: #555; margin-bottom: 8px;
           white-space: pre-line; }
   pre { background: #f7f7f7; padding: 8px; font-size: 11px;
@@ -95,10 +98,17 @@ async function render(id) {
     .map(d => `${d.device}=${(d.stats.bytes_in_use / 1048576).toFixed(1)}MB`)
     .join(" ");
   const live = dev.live_buffers || {};
+  // health plane: graph verdict + stall counter in the meta line, a
+  // per-operator state column in the table below
+  const health = last.Health || {};
+  const hLine = (health.enabled
+    ? `health=${health.graph_state || "?"} ` +
+      `stalls=${health.stall_events ?? 0}`
+    : "health=off") + (last.Aborted ? "  ABORTED" : "");
   document.getElementById("meta").textContent =
     `mode=${last.Mode}  operators=${last.Operator_number}  ` +
     `dropped=${last.Dropped_tuples}  rss=${last.rss_size_kb} kB  ` +
-    `throttle_events=${last.Backpressure_throttle_events}\n` +
+    `throttle_events=${last.Backpressure_throttle_events}  ${hLine}\n` +
     `device: compiles=${jt.compiles ?? "?"} ` +
     `recompiles=${jt.recompiles ?? "?"} ` +
     `compile_ms=${jt.compile_ms_total ?? "?"}  ` +
@@ -135,8 +145,10 @@ async function render(id) {
   const fmtUs = v => v == null ? "–" :
     (v >= 1e6 ? `${(v / 1e6).toFixed(1)}s` :
      v >= 1e3 ? `${(v / 1e3).toFixed(1)}ms` : `${Math.round(v)}µs`);
+  const verdicts = health.verdicts || {};
   document.getElementById("ops").innerHTML =
-    `<table><tr><th>operator</th><th>replicas</th><th>outputs</th>` +
+    `<table><tr><th>operator</th><th>health</th><th>replicas</th>` +
+    `<th>outputs</th>` +
     `<th>ignored</th><th>p50</th><th>p95</th><th>p99</th>` +
     `<th>wm lag</th><th>throughput (tuples/report)</th></tr>` +
     lastOps.map(op => {
@@ -149,7 +161,12 @@ async function render(id) {
       const q = lat[name] || {};
       const lag = (gops[name] || {}).watermark_lag_usec;
       const lh = lagHist[name] || [];
-      return `<tr><td>${esc(name)}</td><td>${reps.length}</td>` +
+      const state = (verdicts[name] || {}).state;
+      const hCell = state
+        ? `<span class="h${esc(state)}">${esc(state)}</span>`
+        : "–";
+      return `<tr><td>${esc(name)}</td><td>${hCell}</td>` +
+             `<td>${reps.length}</td>` +
              `<td>${outs}</td><td>${ign}</td>` +
              `<td>${fmtUs(q.p50)}</td><td>${fmtUs(q.p95)}</td>` +
              `<td>${fmtUs(q.p99)}</td>` +
