@@ -27,7 +27,7 @@ python tools/wf_lint.py
 # explicitly with `pytest -m slow` on the nightly leg.
 python -m pytest tests/test_staging.py tests/test_observability.py \
     tests/test_analysis.py tests/test_device_metrics.py \
-    tests/test_health.py -q -m 'not slow'
+    tests/test_health.py tests/test_sweep_ledger.py -q -m 'not slow'
 python -m pytest tests/ -q -m 'not slow'
 python __graft_entry__.py 8
 BENCH_PLATFORM=cpu BENCH_E2E_TUPLES=131072 python bench.py | tee bench_ci_out.txt
@@ -35,6 +35,11 @@ BENCH_PLATFORM=cpu BENCH_E2E_TUPLES=131072 python bench.py | tee bench_ci_out.tx
 # are the staging plane's evidence trail — fail if a bench refactor drops them
 python tools/check_bench_keys.py bench_ci_out.txt
 rm -f bench_ci_out.txt
+# run-over-run perf tripwire on the guarded bench_history.json scalars:
+# >10% regression vs the previous same-methodology run fails under CI=1
+# (warns locally); the bench leg above just appended the run under
+# judgment
+CI="${CI:-1}" python tools/check_bench_regress.py
 # host worker-pool smoke (reduced size; reports pool overhead on 1 core)
 BENCH_HOST_TUPLES=4000 BENCH_HOST_VEC=2048 BENCH_HOST_REPS=1 python bench_host.py
 # nightly leg (CI_NIGHTLY=1): the slow-marked tail — the host-pool RSS

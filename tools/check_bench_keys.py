@@ -47,6 +47,13 @@ Since the health round the bench also publishes a ``health`` section
 doubles as a tripwire: the bench pipeline must run healthy, so any
 nonzero value (or a non-OK ``graph_state``) is a watchdog
 false-positive or a real runtime regression.  Guarded here identically.
+
+Since the sweep-ledger round the bench also decomposes the roofline:
+``roofline.per_hop`` (bytes/tuple + dispatches/batch per operator hop
+of the staged e2e pipeline) and ``roofline.attributed_fraction`` (hop
+sum over the raw kernel step's measured bytes — docs/OBSERVABILITY.md
+"Sweep ledger").  Guarded here identically; their disappearance would
+orphan the whole-chain-fusion plan (ROADMAP item 1) of its evidence.
 """
 
 import json
@@ -56,6 +63,7 @@ import sys
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 KEYS = ("ratio_vs_kernel", "staging_share_of_staged_run")
 LATENCY_KEYS = ("batch_p99_ms", "e2e_p50_ms", "e2e_p99_ms")
+ROOFLINE_KEYS = ("per_hop", "attributed_fraction")
 DEVICE_KEYS = ("compile_ms_total", "recompiles", "flops_per_batch")
 HEALTH_KEYS = ("graph_state", "stall_events", "watchdog_overhead_pct")
 
@@ -74,6 +82,8 @@ def check_source() -> None:
              "decomposition contract (docs/PERF.md) is broken")
     for section, keys, contract in (
             ("latency", LATENCY_KEYS, "docs/OBSERVABILITY.md"),
+            ("roofline", ROOFLINE_KEYS,
+             "sweep ledger — docs/OBSERVABILITY.md sweep-ledger"),
             ("preflight", ("check_ms",), "docs/ANALYSIS.md"),
             ("device", DEVICE_KEYS,
              "compile watcher — docs/OBSERVABILITY.md device-plane"),
@@ -170,6 +180,22 @@ def check_output(path: str) -> None:
         # IS the observability regression this guard catches
         fail("bench health section absent or errored "
              f"(health_error={result.get('health_error')!r})")
+    roof = result.get("roofline")
+    if not isinstance(roof, dict):
+        fail("'roofline' section missing from bench output")
+    if isinstance(result.get("e2e"), dict):
+        # the staged e2e leg ran: the sweep ledger must have attributed
+        # its hops (docs/OBSERVABILITY.md "Sweep ledger")
+        if not isinstance(roof.get("per_hop"), dict) \
+                or not roof["per_hop"]:
+            fail("'roofline.per_hop' missing or empty — the sweep "
+                 "ledger's per-hop attribution is broken")
+        if roof.get("measured_bytes_per_tuple") \
+                and not isinstance(roof.get("attributed_fraction"),
+                                   (int, float)):
+            fail("'roofline.attributed_fraction' missing although the "
+                 "kernel step's bytes were measured — per-hop bytes "
+                 "did not attribute")
     pf = result.get("preflight")
     if isinstance(pf, dict):
         if "check_ms" not in pf:

@@ -1,0 +1,170 @@
+#!/usr/bin/env python
+"""Run-over-run perf tripwire on bench_history.json.
+
+``tools/check_bench_keys.py`` guards that the bench still EMITS its
+contract keys; nothing guarded their VALUES — a hop that got 30% slower
+sailed through CI as long as the key existed.  This check compares the
+newest ``bench_history.json`` run per platform against the most recent
+earlier run recorded under the SAME methodology (and, for e2e legs, the
+same tuple count — CI runs the bench reduced) and trips on any guarded
+scalar moving more than the threshold in the bad direction.
+
+Under ``CI=1`` a regression fails (exit 1); locally it warns (exit 0),
+because a laptop run racing a browser is not a regression.  Noise is
+respected: a key whose own recorded dispersion (``rel_spread``) exceeds
+the threshold on either side of the comparison is reported but never
+tripped — when the measurement's noise floor is above the tripwire, the
+tripwire would only fire on weather.
+
+Usage::
+
+    python tools/check_bench_regress.py             # newest run, each
+                                                    # platform in history
+    python tools/check_bench_regress.py --platform cpu
+    WF_BENCH_REGRESS_PCT=15 python tools/check_bench_regress.py
+
+Wired into ``ci/run_tests.sh`` directly after the bench leg (which has
+just appended the run under judgment).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+HISTORY = os.path.join(REPO, "bench_history.json")
+
+#: guarded scalars: (dotted path, higher_is_better, dispersion path or
+#: None).  Dispersion gates the tripwire on that key's own noise floor.
+GUARDED = (
+    ("value", True, "dispersion.rel_spread"),
+    ("dispatch_value", True, "dispatch_dispersion.rel_spread"),
+    # sum_decl records no dispersion of its own; the chained kernel's
+    # spread is the same program on the same machine minutes apart —
+    # the honest noise proxy.  Same for the latency tails below: a p99
+    # measured while the kernel windows spread 2x is weather.
+    ("sum_decl_value", True, "dispersion.rel_spread"),
+    ("e2e.tuples_per_sec", True, "e2e.dispersion.rel_spread"),
+    ("e2e_device_source.tuples_per_sec", True,
+     "e2e_device_source.dispersion.rel_spread"),
+    ("reduce.sorted_tps", True, "reduce.sorted_dispersion.rel_spread"),
+    ("reduce.dense_decl_tps", True,
+     "reduce.dense_decl_dispersion.rel_spread"),
+    ("latency.batch_p99_ms", False, "dispersion.rel_spread"),
+    ("latency.e2e_p99_ms", False, "e2e.dispersion.rel_spread"),
+)
+
+
+def dig(obj, path: str):
+    for part in path.split("."):
+        if not isinstance(obj, dict):
+            return None
+        obj = obj.get(part)
+    return obj
+
+
+def comparable(cur: dict, prev: dict, path: str) -> bool:
+    """Apples-to-apples guard: e2e legs only compare runs that pushed
+    the same tuple count (CI runs the bench reduced via
+    BENCH_E2E_TUPLES; comparing a 131k-tuple run against a 4M-tuple
+    round would trip on configuration, not performance)."""
+    if path.startswith(("e2e.", "e2e_device_source.", "latency.e2e")):
+        leg = "e2e_device_source" if path.startswith("e2e_device_source") \
+            else "e2e"
+        return dig(cur, f"{leg}.tuples") == dig(prev, f"{leg}.tuples")
+    return True
+
+
+def pick_baseline(runs: list, cur: dict):
+    """Most recent run BEFORE the newest one with the same methodology
+    (a methodology switch re-baselines, exactly like bench.py's
+    vs_baseline); None when the newest run is the first of its kind."""
+    prior = runs[:-1]
+    same = [r for r in prior
+            if r.get("methodology") == cur.get("methodology")]
+    return same[-1] if same else None
+
+
+def check_platform(platform: str, runs: list, threshold: float) -> list:
+    """[(path, change_pct, kind)] where kind is "regression" | "noisy"."""
+    if len(runs) < 2:
+        return []
+    cur = runs[-1]
+    prev = pick_baseline(runs, cur)
+    if prev is None:
+        return []
+    findings = []
+    for path, higher_better, disp_path in GUARDED:
+        a, b = dig(prev, path), dig(cur, path)
+        if not isinstance(a, (int, float)) \
+                or not isinstance(b, (int, float)) or not a:
+            continue
+        if not comparable(cur, prev, path):
+            continue
+        change = (b - a) / a
+        worse = -change if higher_better else change
+        if worse <= threshold:
+            continue
+        noisy = False
+        if disp_path is not None:
+            for side in (cur, prev):
+                spread = dig(side, disp_path)
+                if isinstance(spread, (int, float)) \
+                        and spread > threshold:
+                    noisy = True
+        findings.append((path, round(100 * change, 1),
+                         "noisy" if noisy else "regression"))
+    return findings
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--platform", help="judge one platform only "
+                                       "(default: every platform with "
+                                       ">= 2 recorded runs)")
+    ap.add_argument("--history", default=HISTORY,
+                    help="bench_history.json path")
+    args = ap.parse_args(argv)
+    threshold = float(os.environ.get("WF_BENCH_REGRESS_PCT", "10")) / 100.0
+    strict = os.environ.get("CI") not in (None, "", "0")
+    try:
+        with open(args.history) as f:
+            hist = json.load(f)
+    except (OSError, ValueError) as e:
+        print(f"check_bench_regress: FAIL: cannot read {args.history}: "
+              f"{e}", file=sys.stderr)
+        return 1
+    platforms = [args.platform] if args.platform else sorted(hist)
+    tripped = False
+    for platform in platforms:
+        runs = hist.get(platform)
+        if not isinstance(runs, list):
+            continue
+        findings = check_platform(platform, runs, threshold)
+        for path, pct, kind in findings:
+            if kind == "noisy":
+                print(f"check_bench_regress: note [{platform}] {path} "
+                      f"moved {pct:+}% but its recorded dispersion "
+                      f"exceeds the {threshold:.0%} threshold — noise "
+                      "floor, not tripped")
+            else:
+                tripped = True
+                print(f"check_bench_regress: "
+                      f"{'FAIL' if strict else 'WARN'} [{platform}] "
+                      f"{path} regressed {pct:+}% vs the previous "
+                      f"same-methodology run (threshold "
+                      f"{threshold:.0%})",
+                      file=sys.stderr if strict else sys.stdout)
+        if not findings:
+            print(f"check_bench_regress: OK [{platform}] — no guarded "
+                  f"key moved more than {threshold:.0%} the wrong way")
+    if tripped and strict:
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

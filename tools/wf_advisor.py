@@ -1,0 +1,156 @@
+#!/usr/bin/env python
+"""wf_advisor: rank the fusible operator chains of an application.
+
+CLI face of the fusion advisor (windflow_tpu/analysis/fusion.py),
+mirroring ``tools/wf_check.py``: point it at the module that builds your
+PipeGraph and get the concrete whole-chain-fusion plan — maximal runs of
+adjacent TPU operators one XLA program could replace, ranked by
+projected HBM bytes-saved and jitted-dispatches-saved per staged batch.
+The plan is what the whole-chain-fusion refactor (ROADMAP item 1)
+implements and is judged against.
+
+Usage::
+
+    python tools/wf_advisor.py APP_MODULE          # e.g. myapp.pipeline
+    python tools/wf_advisor.py APP_MODULE:ATTR     # a PipeGraph attribute
+                                                   # or zero-arg factory
+    python tools/wf_advisor.py ... --json          # machine-readable plan
+    python tools/wf_advisor.py ... --stats DUMP    # rank by MEASURED
+        # per-hop numbers: DUMP is a stats JSON (dump_stats output, a
+        # postmortem stats.json, or any dict with a "Sweep" section)
+    python tools/wf_advisor.py ... --top N         # best N chains only
+
+Without ``--stats`` the ranking uses spec-based projections (pre-flight
+record specs x batch capacity); with it, the sweep ledger's measured
+dispatches-per-batch and boundary bytes.  Exit status: 0 when at least
+one fusion candidate was found, 1 when the graph has none, 2 on
+usage/load failures.
+"""
+
+from __future__ import annotations
+
+import argparse
+import importlib
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+#: module-level names probed (in order) when no :ATTR is given —
+#: identical to tools/wf_check.py so one app module serves both CLIs
+FACTORY_NAMES = ("make_graph", "build_graph", "graph", "make_app", "app")
+
+
+def fail(msg: str) -> None:
+    print(f"wf_advisor: FAIL: {msg}", file=sys.stderr)
+    sys.exit(2)
+
+
+def _as_graph(obj):
+    from windflow_tpu.graph.pipegraph import PipeGraph
+    if isinstance(obj, PipeGraph):
+        return obj
+    if callable(obj):
+        out = obj()
+        if isinstance(out, PipeGraph):
+            return out
+    return None
+
+
+def load_graph(spec: str):
+    """``module`` or ``module:attr`` -> a composed PipeGraph (the
+    wf_check loading contract)."""
+    mod_name, _, attr = spec.partition(":")
+    try:
+        mod = importlib.import_module(mod_name)
+    except ImportError as e:
+        fail(f"cannot import '{mod_name}': {e}")
+    if attr:
+        if not hasattr(mod, attr):
+            fail(f"module '{mod_name}' has no attribute '{attr}'")
+        g = _as_graph(getattr(mod, attr))
+        if g is None:
+            fail(f"'{mod_name}:{attr}' is neither a PipeGraph nor a "
+                 "zero-arg factory returning one")
+        return g
+    from windflow_tpu.graph.pipegraph import PipeGraph
+    for name in FACTORY_NAMES:
+        if hasattr(mod, name):
+            g = _as_graph(getattr(mod, name))
+            if g is not None:
+                return g
+    for name in dir(mod):
+        if isinstance(getattr(mod, name), PipeGraph):
+            return getattr(mod, name)
+    fail(f"no PipeGraph found in '{mod_name}' — expose one (or a factory "
+         f"named one of {FACTORY_NAMES}), or pass 'module:attr'")
+
+
+def load_sweep(path: str):
+    """The ``Sweep`` section out of a stats dump / postmortem stats.json
+    / bare sweep section file."""
+    try:
+        with open(path) as f:
+            obj = json.load(f)
+    except (OSError, ValueError) as e:
+        fail(f"cannot read stats dump '{path}': {e}")
+    if isinstance(obj, dict) and "per_hop" in obj:
+        return obj
+    sweep = (obj or {}).get("Sweep")
+    if not isinstance(sweep, dict) or not sweep.get("enabled"):
+        fail(f"'{path}' carries no enabled 'Sweep' section — run the "
+             "graph with Config.sweep_ledger on and dump_stats first")
+    return sweep
+
+
+def render_text(p: dict) -> str:
+    lines = [f"wf_advisor: graph '{p['graph']}' — "
+             f"{len(p['chains'])} fusion candidate(s)"]
+    for i, c in enumerate(p["chains"], 1):
+        arrows = " -> ".join(c["ops"])
+        status = "chainable today (MultiPipe.chain)" if c["provable_now"] \
+            else "needs whole-chain fusion"
+        lines.append(f"  #{i} {arrows}")
+        lines.append(
+            f"      saves {c['dispatches_saved_per_batch']} dispatch(es) "
+            f"and ~{c['projected_bytes_saved_per_batch']:.0f} boundary "
+            f"bytes per batch ({c['basis']}); {status}")
+        if c["donation_miss_bytes_per_batch"]:
+            lines.append(
+                f"      + {c['donation_miss_bytes_per_batch']:.0f} "
+                "bytes/batch of donation-miss copies inside the chain")
+        if c["tail_boundary"]:
+            lines.append(f"      chain ends here: {c['tail_boundary']}")
+    if not p["chains"]:
+        lines.append("  (no adjacent TPU hops with compatible "
+                     "routing/batch contracts)")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("app", help="APP_MODULE or APP_MODULE:ATTR building "
+                                "the PipeGraph")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the ranked plan as JSON")
+    ap.add_argument("--stats", metavar="DUMP",
+                    help="stats JSON with a Sweep section: rank by "
+                         "measured per-hop numbers")
+    ap.add_argument("--top", type=int, default=0,
+                    help="emit only the best N chains")
+    args = ap.parse_args(argv)
+
+    g = load_graph(args.app)
+    sweep = load_sweep(args.stats) if args.stats else None
+    from windflow_tpu.analysis.fusion import plan
+    p = plan(g, sweep=sweep, top=args.top)
+    if args.json:
+        print(json.dumps(p, indent=2))
+    else:
+        print(render_text(p))
+    return 0 if p["chains"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

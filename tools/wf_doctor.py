@@ -4,7 +4,8 @@
 A crash or watchdog-confirmed stall writes a black-box bundle
 (``PipeGraph.dump_postmortem`` — flight-recorder rings, the last stats
 report, health verdict timeline + stall attribution, jit/device tables,
-preflight findings).  This tool turns that directory into a human
+the sweep ledger's per-hop dispatch/HBM attribution, preflight
+findings).  This tool turns that directory into a human
 diagnosis — or validates it — with **no jax installed** (pure stdlib,
 same scrape-host stance as ``tools/wf_metrics.py``).
 
@@ -37,6 +38,10 @@ STAGE_NAMES = ("staged", "emitted", "dispatched", "device_done",
                "collected", "sunk")
 SECTIONS = ("stats.json", "events.json", "health.json", "device.json",
             "jit.json", "preflight.json")
+#: sections newer writers add; validated when present, but their absence
+#: must not reject a bundle written before they existed (same schema) —
+#: this tool's job is exactly the historical crash bundle
+OPTIONAL_SECTIONS = ("sweep.json",)
 
 
 class BundleError(Exception):
@@ -111,6 +116,24 @@ def validate(bundle: dict) -> None:
         if e.get("stage") not in STAGE_NAMES:
             raise BundleError(
                 f"events.json: illegal span stage {e.get('stage')!r}")
+    sweep = sections.get("sweep.json") or {}
+    if sweep.get("enabled"):
+        for op, hop in (sweep.get("per_hop") or {}).items():
+            if not isinstance(hop, dict):
+                raise BundleError(
+                    f"sweep.json: hop {op!r} is not an object")
+            for key in ("dispatches", "batches"):
+                v = hop.get(key)
+                if v is not None and not isinstance(v, int):
+                    raise BundleError(
+                        f"sweep.json: hop {op!r} field {key!r} must be "
+                        f"an integer, got {v!r}")
+            bpt = hop.get("bytes_per_tuple")
+            if bpt is not None and (not isinstance(bpt, (int, float))
+                                    or bpt < 0):
+                raise BundleError(
+                    f"sweep.json: hop {op!r} bytes_per_tuple {bpt!r} is "
+                    "not a non-negative number")
 
 
 def diagnose(bundle: dict) -> dict:
@@ -124,6 +147,20 @@ def diagnose(bundle: dict) -> dict:
     jit = (sections.get("jit.json") or {}).get("totals") or {}
     stall = health.get("last_stall") or None
     bad = {op: v for op, v in verdicts.items() if v.get("state") != "OK"}
+    sweep = sections.get("sweep.json") or {}
+    hops = sweep.get("per_hop") or {}
+    top_hop = None
+    if hops:
+        ranked = sorted(hops.items(),
+                        key=lambda kv: kv[1].get("bytes_per_tuple") or 0,
+                        reverse=True)
+        name, h = ranked[0]
+        top_hop = {"op": name,
+                   "bytes_per_tuple": h.get("bytes_per_tuple"),
+                   "dispatches_per_batch": h.get("dispatches_per_batch"),
+                   "excess_vs_model": h.get("excess_vs_model")}
+    donation_misses = {op: h["donation_miss"] for op, h in hops.items()
+                       if h.get("donation_miss")}
     return {
         "app": manifest.get("app"),
         "reason": manifest.get("reason"),
@@ -139,6 +176,9 @@ def diagnose(bundle: dict) -> dict:
         "recompiles": jit.get("recompiles"),
         "compile_ms_total": jit.get("compile_ms_total"),
         "span_events": len(sections.get("events.json") or []),
+        "sweep_top_hop": top_hop,
+        "sweep_totals": sweep.get("totals") or None,
+        "donation_misses": donation_misses,
         "section_errors": manifest.get("errors") or {},
     }
 
@@ -181,6 +221,23 @@ def render_text(d: dict) -> str:
         f"dropped={d['dropped_tuples']}, "
         f"recompiles={d['recompiles']}, "
         f"compile_ms_total={d['compile_ms_total']}")
+    if d.get("sweep_top_hop"):
+        t = d["sweep_top_hop"]
+        tot = d.get("sweep_totals") or {}
+        n = lambda v: "?" if v is None else v  # cost tables may be absent
+        lines.append(
+            f"  sweep: hottest hop '{t['op']}' at "
+            f"{n(t['bytes_per_tuple'])} B/tuple "
+            f"({n(t['dispatches_per_batch'])} dispatch(es)/batch, "
+            f"{n(t['excess_vs_model'])}x the record model); "
+            f"graph total {n(tot.get('bytes_per_tuple'))} B/tuple over "
+            f"{n(tot.get('dispatches_per_batch'))} dispatches/batch")
+    for op, miss in (d.get("donation_misses") or {}).items():
+        lines.append(
+            f"  donation miss: '{op}' re-copies "
+            f"{miss.get('bytes_per_batch')} B/batch "
+            f"({miss.get('candidate_leaves')} donatable leaf/leaves "
+            "not donated)")
     if d["section_errors"]:
         lines.append(f"  degraded sections: {d['section_errors']}")
     return "\n".join(lines)

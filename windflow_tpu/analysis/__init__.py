@@ -12,6 +12,11 @@ Three coordinated passes share one :class:`Diagnostic` record type
 * ``analysis.debug_concurrency`` — ``WF_TPU_DEBUG_CONCURRENCY=1`` runtime
   race detection on the shared mutable structures.
 
+``analysis.fusion`` builds on the pre-flight graph walk: maximal
+fusible operator chains + projected savings, the planning layer behind
+``tools/wf_advisor.py`` (docs/OBSERVABILITY.md "Sweep ledger & fusion
+advisor").
+
 See docs/ANALYSIS.md for the diagnostic code table and contracts.
 """
 

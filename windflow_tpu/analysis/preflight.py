@@ -486,9 +486,48 @@ def _check_comb(op, one, code, what, diags) -> bool:
     return True
 
 
+def record_nbytes(spec) -> Optional[int]:
+    """Payload bytes of ONE record under an abstract spec (summed leaf
+    ``shape x itemsize``) — the declared-record byte model the sweep
+    ledger (monitoring/sweep_ledger.py) splits measured HBM traffic
+    against.  ``None`` when the spec is unknown."""
+    if spec is _UNKNOWN:
+        return None
+    import jax
+    total = 0
+    for leaf in jax.tree.leaves(spec):
+        n = 1
+        for d in getattr(leaf, "shape", ()):
+            n *= int(d)
+        total += n * np.dtype(leaf.dtype).itemsize
+    return total
+
+
 def _kernel_pass(graph, ops, edges, upstreams, diags) -> None:
+    """Diagnostic face of :func:`propagate_specs` (the WF1xx codes)."""
+    propagate_specs(graph, ops=ops, edges=edges, upstreams=upstreams,
+                    diags=diags)
+
+
+def propagate_specs(graph, ops=None, edges=None, upstreams=None,
+                    diags=None) -> Tuple[Dict[int, Any], Dict[int, Any]]:
     """Propagate abstract record specs from the sources through every
-    chain, eval-shaping each user kernel where a spec is known."""
+    chain, eval-shaping each user kernel where a spec is known.  Returns
+    ``(in_specs, out_specs)``, both keyed by ``id(op)`` with ``None``
+    marking "unknown at this point of the chain".
+
+    This is THE shared graph walk: the pre-flight kernel pass appends
+    its WF1xx diagnostics through ``diags``; the sweep ledger and the
+    fusion advisor (analysis/fusion.py) call it with ``diags`` defaulted
+    to a throwaway list just for the per-op record specs."""
+    if diags is None:
+        diags = []
+    if edges is None:
+        edges = graph._edges()
+    if ops is None:
+        ops = _all_ops(graph)
+    if upstreams is None:
+        upstreams = _upstream_map(edges)
     import jax
     from windflow_tpu.io.device_source import DeviceSource
     from windflow_tpu.ops.chained import ChainedTPU
@@ -742,6 +781,8 @@ def _kernel_pass(graph, ops, edges, upstreams, diags) -> None:
 
     for op in ops:
         out_of(op)      # force every operator's kernel checks
+        in_of(op)       # ... and materialize every input spec
+    return in_spec, out_cache
 
 
 def _check_ffat_comb(op, agg, diags) -> None:

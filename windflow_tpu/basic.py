@@ -234,6 +234,16 @@ class Config:
     # way.
     health_postmortem_on_crash: bool = bool(int(os.environ.get(
         "WF_TPU_HEALTH_POSTMORTEM", "1")))
+    # Sweep ledger (monitoring/sweep_ledger.py, docs/OBSERVABILITY.md):
+    # per-operator-hop attribution of jitted dispatches and XLA
+    # cost-analysis HBM bytes per staged batch, donation-miss tripwires,
+    # and hop-boundary residency (fusion fuel for tools/wf_advisor.py).
+    # Evaluated only at stats/postmortem cadence from counters the
+    # compile watcher already maintains — the per-batch cost is the
+    # watcher's one integer add per dispatch, and switching the ledger
+    # off leaves one `is not None` check at each read site.
+    sweep_ledger: bool = bool(int(os.environ.get("WF_TPU_SWEEP_LEDGER",
+                                                 "1")))
     # Multi-chip execution: a jax.sharding.Mesh with ("data", "key") axes
     # (see windflow_tpu.parallel.mesh.make_mesh).  When set, staging emitters
     # lay batches out data-sharded across the mesh and mesh-aware TPU

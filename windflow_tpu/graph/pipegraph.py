@@ -94,6 +94,11 @@ class PipeGraph:
         # when Config.health_watchdog is on; None means every call site
         # is one flag check (the documented off-path)
         self._health = None
+        # sweep ledger (monitoring/sweep_ledger.py): per-hop dispatch/HBM
+        # attribution built in _build when Config.sweep_ledger is on;
+        # None leaves one `is not None` check at each read site (stats,
+        # trace metadata, postmortem) — nothing on the per-batch path
+        self._ledger = None
         # last postmortem bundle written (crash path or dump_postmortem);
         # the lock serializes writers — the monitor thread's watchdog
         # auto-bundle and the driver's stall/crash path may race into
@@ -274,6 +279,14 @@ class PipeGraph:
         if cfg.health_watchdog:
             from windflow_tpu.monitoring.health import HealthPlane
             self._health = HealthPlane(self)
+
+        # 3d. sweep ledger (monitoring/sweep_ledger.py): built AFTER the
+        # operator list is final and BEFORE any batch runs, so its
+        # registry baseline excludes every earlier graph's dispatches in
+        # this process while capturing all of this one's
+        if cfg.sweep_ledger:
+            from windflow_tpu.monitoring.sweep_ledger import SweepLedger
+            self._ledger = SweepLedger(self)
 
         # sanity: every non-sink replica must have an emitter
         for op in self._operators:
@@ -612,6 +625,20 @@ class PipeGraph:
             return {"enabled": True, "error": f"{type(e).__name__}: "
                                               f"{e}"[:200]}
 
+    def _sweep_section(self) -> dict:
+        """Guarded like the health/device sections: a ledger read must
+        never take the pipeline or a stats dump down.  With
+        ``Config.sweep_ledger`` off this is the whole cost: one check."""
+        if self._ledger is None:
+            return {"enabled": False}
+        try:
+            return self._ledger.section()
+        except Exception as e:  # lint: broad-except-ok (the ledger walks
+            # registry snapshots and abstract specs at stats cadence —
+            # telemetry degrades, the report still ships)
+            return {"enabled": True, "error": f"{type(e).__name__}: "
+                                              f"{e}"[:200]}
+
     def _rolling_rate(self, window_s: float) -> float:
         """Sunk-tuples/sec over (at least) the trailing ``window_s``: the
         delta between the newest sample and the youngest sample that is at
@@ -743,6 +770,9 @@ class PipeGraph:
             "profiler_dir": self._last_profile_dir
             or self.config.profiler_dir
             or os.path.join(self.config.log_dir, f"{self.name}_xprof"),
+            # sweep-ledger cross-reference: per-hop dispatch counts and
+            # attributed HBM bytes for the spans in this trace
+            "sweep": self._sweep_section(),
         })
         root, ext = os.path.splitext(path)
         base = root[:-len("_trace")] if root.endswith("_trace") else root
@@ -811,6 +841,11 @@ class PipeGraph:
             # per-op table, HBM/live-buffer gauges, staging-attributed
             # device bytes — the ``"Device"`` half of the telemetry story
             "Device": self._device_section(),
+            # sweep ledger (monitoring/sweep_ledger.py): per-hop jitted
+            # dispatches + XLA-cost HBM bytes per staged batch, donation
+            # misses, hop-boundary residency — the attribution layer the
+            # fusion advisor (tools/wf_advisor.py) plans against
+            "Sweep": self._sweep_section(),
             "Operators": [op.dump_stats() for op in self._operators],
         }
 
@@ -837,7 +872,8 @@ class PipeGraph:
                         reason: str = "manual") -> str:
         """Black-box postmortem bundle: flight-recorder rings, the last
         ``stats()``, health verdict timeline + stall attribution, jit and
-        device tables, preflight findings — written as one directory of
+        device tables, the sweep ledger's per-hop dispatch/HBM
+        attribution, preflight findings — written as one directory of
         JSON files that ``tools/wf_doctor.py`` renders and validates with
         no jax installed.  Every section is individually guarded (section
         failures land in the manifest's ``errors`` map, they never abort
@@ -898,6 +934,7 @@ class PipeGraph:
             reg = default_registry()
             return {"jit": reg.snapshot(), "totals": reg.totals()}
         write("jit.json", jit_tables)
+        write("sweep.json", self._sweep_section)
         write("preflight.json", lambda: {
             "mode": getattr(self.config, "preflight", "error"),
             "check_ms": self._preflight_ms,

@@ -15,9 +15,10 @@ from windflow_tpu.monitoring.recorder import (FlightRecorder,
                                               chrome_trace_from_events)
 from windflow_tpu.monitoring.stats import StatsRecord
 
-# The compile watcher (jit_registry.wf_jit) and device gauges
-# (device_metrics) are intentionally NOT re-exported here: both import
-# jax at module scope — import them by full path from code that already
-# owns a backend.  openmetrics stays pure stdlib so tools/wf_metrics.py
-# can load it file-direct without importing the package (no jax on a
-# scrape host).
+# The compile watcher (jit_registry.wf_jit), device gauges
+# (device_metrics) and sweep ledger (sweep_ledger.SweepLedger) are
+# intentionally NOT re-exported here: the first two import jax at
+# module scope and the ledger pulls them in lazily — import them by
+# full path from code that already owns a backend.  openmetrics stays
+# pure stdlib so tools/wf_metrics.py can load it file-direct without
+# importing the package (no jax on a scrape host).

@@ -19,6 +19,13 @@ process-scope stance as ``staging.default_pool``):
 * **recompile events** — a NEW signature after the wrapper's first
   compile increments the per-op recompile counter and, once per op name,
   raises a ``RuntimeWarning`` naming the op and both signatures.
+* **dispatch count + donation audit** — every call bumps the op's
+  dispatch counter (one lock-free integer add — the per-hop numerator of
+  the sweep ledger, monitoring/sweep_ledger.py), and the first compile
+  records which positional args were donated plus how many non-donated
+  input leaves match an output leaf shape/dtype — each such leaf is a
+  whole-buffer copy donation would elide (the ledger's donation-miss
+  tripwire).
 * **cost table** — on the first compile of an op name the watcher
   captures XLA cost analysis (FLOPs, bytes accessed) and, in ``compiled``
   mode, the executable's memory footprint.  ``WF_TPU_COST_ANALYSIS``
@@ -100,8 +107,9 @@ class OpCompileEntry:
     may feed the same entry)."""
 
     __slots__ = ("op_name", "compiles", "recompiles", "compile_ms_total",
-                 "last_compile_ms", "cost", "cost_attempted", "memory",
-                 "warned", "lock")
+                 "last_compile_ms", "cost", "cost_by_sig", "memory",
+                 "warned", "lock", "dispatches", "donation",
+                 "donation_attempted")
 
     def __init__(self, op_name: str) -> None:
         self.op_name = op_name
@@ -110,11 +118,32 @@ class OpCompileEntry:
         self.compile_ms_total = 0.0
         self.last_compile_ms = 0.0
         self.cost: Optional[dict] = None     # captured on first compile
-        self.cost_attempted = False          # one attempt per op name,
-        #                                      even when the backend fails it
+        #: cost tables per input signature: one op name may compile
+        #: genuinely different programs (another graph's operator reusing
+        #: the name, a different record structure) — the sweep ledger
+        #: attributes each wrapper's dispatches with ITS program's bytes,
+        #: not whichever program happened to compile first in the process
+        self.cost_by_sig: Dict[object, Optional[dict]] = {}
+        #                                      membership doubles as the
+        #                                      one-attempt-per-signature
+        #                                      claim (None = attempt
+        #                                      failed, stays failed)
         self.memory: Optional[dict] = None   # "compiled" mode only
         self.warned = False                  # one-time recompile warning
         self.lock = threading.Lock()
+        #: total jitted dispatches through every wrapper feeding this
+        #: entry — the per-hop denominator of the sweep ledger
+        #: (monitoring/sweep_ledger.py).  Bumped lock-free on the hot path
+        #: (a torn concurrent add may undercount by a call; the ledger
+        #: reads it at stats cadence, never as an exact invariant).
+        self.dispatches = 0
+        #: buffer-donation audit captured once, on the first compile:
+        #: which positional args were donated, and how many non-donated
+        #: input leaves match an output leaf shape/dtype (each one is a
+        #: whole-buffer copy XLA could elide with donation — the sweep
+        #: ledger's donation-miss tripwire).
+        self.donation: Optional[dict] = None
+        self.donation_attempted = False
 
     def to_json(self) -> dict:
         return {
@@ -122,8 +151,10 @@ class OpCompileEntry:
             "recompiles": self.recompiles,
             "compile_ms_total": round(self.compile_ms_total, 3),
             "last_compile_ms": round(self.last_compile_ms, 3),
+            "dispatches": self.dispatches,
             "cost": self.cost,
             "memory": self.memory,
+            "donation": self.donation,
         }
 
 
@@ -161,9 +192,20 @@ class JitRegistry:
                                           for e in entries), 3),
         }
 
+    def dispatch_counts(self) -> Dict[str, int]:
+        """op name -> cumulative jitted dispatches.  The sweep ledger
+        snapshots this at graph build and diffs at stats time, so one
+        graph's per-hop dispatch counts exclude every earlier graph that
+        reused the same op names in this process."""
+        with self._lock:
+            entries = dict(self._entries)
+        return {name: e.dispatches for name, e in entries.items()}
+
     def reset(self) -> None:
         """Drop every entry (tests).  Live wrappers re-create their entry
-        lazily on the next compile."""
+        lazily on the next compile; until then their cached dispatch
+        counter feeds the detached entry, so dispatch-count tests must
+        build fresh operators (fresh wrappers) after a reset."""
         with self._lock:
             self._entries.clear()
 
@@ -183,13 +225,27 @@ class WfJit:
     compile, not a recompile); counters aggregate per op name in the
     process-wide registry."""
 
-    __slots__ = ("op_name", "_jit", "_seen", "_last_sig", "_lock")
+    __slots__ = ("op_name", "_jit", "_seen", "_last_sig", "_lock",
+                 "_entry", "_donate", "dispatches", "cost")
 
     def __init__(self, fn: Callable, op_name: str, jit_kwargs: dict) -> None:
         self.op_name = op_name
         self._jit = jax.jit(fn, **jit_kwargs)
         self._seen = set()
         self._last_sig = None
+        #: per-WRAPPER dispatch count next to the entry's per-NAME total:
+        #: the sweep ledger attributes by wrapper so two graphs reusing
+        #: one op name never pollute each other's per-hop numbers
+        self.dispatches = 0
+        #: cost table of THIS wrapper's compiled program (bound from the
+        #: entry's per-signature table at compile time — same reason)
+        self.cost: Optional[dict] = None
+        # cached so the hot path's dispatch count is one attribute add —
+        # no registry lookup per call; refreshed on every compile so a
+        # registry reset() re-binds at the next compile
+        self._entry = default_registry().entry(op_name)
+        da = jit_kwargs.get("donate_argnums", ())
+        self._donate = frozenset((da,) if isinstance(da, int) else da)
         # serializes the cold compile path only: replicas of one operator
         # share one wrapper and may first-call concurrently from the host
         # worker pool — without this, both would count a compile and the
@@ -209,6 +265,12 @@ class WfJit:
 
     @hot_path
     def __call__(self, *args, **kwargs):
+        # sweep-ledger hook: TWO lock-free integer adds per dispatch —
+        # the wrapper's own count (per-hop attribution) and the entry's
+        # per-name process total; everything else the ledger reads comes
+        # from counters that already exist
+        self.dispatches += 1
+        self._entry.dispatches += 1
         sig = self._signature(args, kwargs)
         if sig in self._seen:       # hash-compare only: steady state
             return self._jit(*args, **kwargs)
@@ -222,23 +284,41 @@ class WfJit:
     def _compile_call_locked(self, sig, args, kwargs):
         if sig in self._seen:
             # lost the race: another replica thread compiled this
-            # signature while we waited — plain cached dispatch
+            # signature while we waited — plain cached dispatch (but
+            # adopt the winner's cost table for the sweep ledger)
+            entry = default_registry().entry(self.op_name)
+            with entry.lock:
+                self.cost = entry.cost_by_sig.get(sig)
             return self._jit(*args, **kwargs)
         entry = default_registry().entry(self.op_name)
+        self._entry = entry     # re-bind after a registry reset()
         is_recompile = bool(self._seen)
         prev_sig = self._last_sig
         with entry.lock:
-            capture_cost = not entry.cost_attempted and COST_MODE != "off"
-            entry.cost_attempted = True     # one attempt per op name,
-            #                                 even if the backend fails it
+            capture_cost = sig not in entry.cost_by_sig \
+                and COST_MODE != "off"
+            if capture_cost:
+                entry.cost_by_sig[sig] = None   # claimed: one attempt
+                #                                 per (op name, signature),
+                #                                 even if the backend
+                #                                 fails it
         if capture_cost:
             # BEFORE the dispatch: donated buffers are dead afterwards
-            self._capture_cost(entry, args, kwargs)
+            self._capture_cost(entry, sig, args, kwargs)
+        with entry.lock:
+            # the cost table of THIS wrapper's program (may come from an
+            # earlier wrapper that compiled the same signature)
+            self.cost = entry.cost_by_sig.get(sig)
         t0 = time.perf_counter()
         out = self._jit(*args, **kwargs)
         dt_ms = (time.perf_counter() - t0) * 1e3
         self._seen.add(sig)
         self._last_sig = sig
+        with entry.lock:
+            capture_donation = not entry.donation_attempted
+            entry.donation_attempted = True
+        if capture_donation:
+            self._capture_donation(entry, args, kwargs, out)
         warn = False
         with entry.lock:
             entry.compiles += 1
@@ -261,10 +341,11 @@ class WfJit:
                 RuntimeWarning, stacklevel=3)
         return out
 
-    def _capture_cost(self, entry: OpCompileEntry, args, kwargs) -> None:
-        """Best-effort XLA cost capture on the op name's first compile
-        (module docstring: 'lowered' estimate vs 'compiled' optimized-HLO
-        numbers + memory footprint)."""
+    def _capture_cost(self, entry: OpCompileEntry, sig, args,
+                      kwargs) -> None:
+        """Best-effort XLA cost capture, once per (op name, input
+        signature) (module docstring: 'lowered' estimate vs 'compiled'
+        optimized-HLO numbers + memory footprint)."""
         cost_src = None
         memory = None
         try:
@@ -305,13 +386,85 @@ class WfJit:
                 if isinstance(v, (int, float)):
                     cost[out_key] = float(v)
         with entry.lock:
+            entry.cost_by_sig[sig] = cost
             if entry.cost is None and cost is not None:
+                # the entry-level table (snapshot/bench back-compat)
+                # stays first-come; per-program consumers read the
+                # signature-keyed table through their wrapper
                 entry.cost = cost
                 entry.memory = memory
-            # a failed capture stays failed: cost_attempted (set by the
-            # caller) stops every later compile of this op name from
-            # re-paying the probe — in "compiled" mode that would be a
-            # whole extra backend compile per compile
+            # a failed capture stays failed: the signature's claim in
+            # cost_by_sig stops every later compile of this (op name,
+            # signature) from re-paying the probe — in "compiled" mode
+            # that would be a whole extra backend compile per compile
+
+    def current_cost(self) -> Optional[dict]:
+        """Cost table of this wrapper's compiled program (sweep-ledger
+        read path, stats cadence).  Re-reads the entry's per-signature
+        table when the bound value is still ``None``: a concurrent first
+        compile of the same signature may have claimed the slot before
+        its capture finished, leaving this wrapper's compile-time read
+        empty."""
+        if self.cost is None and self._last_sig is not None:
+            with self._entry.lock:
+                self.cost = self._entry.cost_by_sig.get(self._last_sig)
+        return self.cost
+
+    def _capture_donation(self, entry: OpCompileEntry, args, kwargs,
+                          out) -> None:
+        """Buffer-donation audit, once per op name on the first compile
+        (cold path): count non-donated input leaves whose shape/dtype
+        matches an output leaf — each one is a whole-buffer copy XLA
+        could elide with ``donate_argnums``/aliasing.  Shape/dtype
+        metadata survives donation, so reading it off already-donated
+        inputs is safe; everything degrades to ``None`` on failure."""
+        try:
+            out_pool: dict = {}
+            out_bytes = 0
+            for leaf in jax.tree_util.tree_leaves(out):
+                nb = getattr(leaf, "nbytes", None)
+                if nb is None:
+                    continue
+                out_bytes += int(nb)
+                sig = (tuple(getattr(leaf, "shape", ())),
+                       str(getattr(leaf, "dtype", None)))
+                out_pool[sig] = out_pool.get(sig, 0) + 1
+            cand_leaves = 0
+            cand_bytes = 0
+            arg_bytes = 0
+            # kwargs leaves are donation candidates too: jax.jit cannot
+            # donate keyword arguments at all
+            operands = [(i in self._donate, a) for i, a in enumerate(args)]
+            operands += [(False, v) for v in kwargs.values()]
+            for donated, a in operands:
+                for leaf in jax.tree_util.tree_leaves(a):
+                    nb = getattr(leaf, "nbytes", None)
+                    if nb is None:
+                        continue
+                    arg_bytes += int(nb)
+                    if donated:
+                        continue
+                    sig = (tuple(getattr(leaf, "shape", ())),
+                           str(getattr(leaf, "dtype", None)))
+                    if out_pool.get(sig, 0) > 0:
+                        out_pool[sig] -= 1
+                        cand_leaves += 1
+                        cand_bytes += int(nb)
+            donation = {
+                "donated_argnums": sorted(self._donate),
+                "candidate_leaves": cand_leaves,
+                "candidate_bytes": cand_bytes,
+                "arg_bytes": arg_bytes,
+                "out_bytes": out_bytes,
+            }
+        except Exception:  # lint: broad-except-ok (the audit walks
+            # arbitrary user pytrees right after a compile — any failure
+            # must degrade to "no donation table", never break dispatch)
+            donation = None
+        if donation is not None:
+            with entry.lock:
+                if entry.donation is None:
+                    entry.donation = donation
 
     # -- AOT passthroughs (parity with jax.jit's stages API) -----------------
     def lower(self, *args, **kwargs):

@@ -234,6 +234,38 @@ def _families(stats: dict,
                 f_age.add(v["last_advance_age_usec"],
                           dict(base, operator=name))
 
+    # -- sweep ledger --------------------------------------------------------
+    sweep = stats.get("Sweep") or {}
+    if sweep.get("enabled"):
+        f_sd = fam("wf_sweep_dispatches_per_batch", "gauge",
+                   "Jitted dispatches per staged batch per operator hop "
+                   "(sweep ledger)")
+        f_sb = fam("wf_sweep_bytes_per_tuple", "gauge",
+                   "XLA cost-analysis HBM bytes per tuple attributed to "
+                   "the hop")
+        f_sx = fam("wf_sweep_excess_vs_model", "gauge",
+                   "Attributed bytes over the declared record-spec "
+                   "payload model")
+        f_dm = fam("wf_sweep_donation_miss_bytes_per_batch", "gauge",
+                   "Bytes copied per batch because donatable inputs are "
+                   "not donated")
+        for name, h in (sweep.get("per_hop") or {}).items():
+            lab = dict(base, operator=name)
+            if isinstance(h.get("dispatches_per_batch"), (int, float)):
+                f_sd.add(h["dispatches_per_batch"], lab)
+            if isinstance(h.get("bytes_per_tuple"), (int, float)):
+                f_sb.add(h["bytes_per_tuple"], lab)
+            if isinstance(h.get("excess_vs_model"), (int, float)):
+                f_sx.add(h["excess_vs_model"], lab)
+            miss = (h.get("donation_miss") or {}).get("bytes_per_batch")
+            if isinstance(miss, (int, float)):
+                f_dm.add(miss, lab)
+        totals = sweep.get("totals") or {}
+        if isinstance(totals.get("bytes_per_tuple"), (int, float)):
+            fam("wf_sweep_bytes_per_tuple_total", "gauge",
+                "Summed attributed HBM bytes per tuple across all hops") \
+                .add(totals["bytes_per_tuple"], base)
+
     # -- latency histograms --------------------------------------------------
     lat = stats.get("Latency") or {}
     f_svc = fam("wf_service_latency_usec", "histogram",

@@ -146,10 +146,16 @@ async function render(id) {
     (v >= 1e6 ? `${(v / 1e6).toFixed(1)}s` :
      v >= 1e3 ? `${(v / 1e3).toFixed(1)}ms` : `${Math.round(v)}µs`);
   const verdicts = health.verdicts || {};
+  // sweep ledger (monitoring/sweep_ledger.py): per-hop dispatch + HBM
+  // attribution columns — "B/tuple" is XLA cost-analysis bytes accessed
+  // per tuple for the hop, "disp/batch" its jitted dispatches per
+  // staged batch; a flagged hop ("!don") has donation-miss copies
+  const sweepHops = (last.Sweep || {}).per_hop || {};
   document.getElementById("ops").innerHTML =
     `<table><tr><th>operator</th><th>health</th><th>replicas</th>` +
     `<th>outputs</th>` +
     `<th>ignored</th><th>p50</th><th>p95</th><th>p99</th>` +
+    `<th>disp/batch</th><th>B/tuple</th>` +
     `<th>wm lag</th><th>throughput (tuples/report)</th></tr>` +
     lastOps.map(op => {
       const name = op.Operator_name || op.Name || "?";
@@ -165,11 +171,18 @@ async function render(id) {
       const hCell = state
         ? `<span class="h${esc(state)}">${esc(state)}</span>`
         : "–";
+      const hop = sweepHops[name] || {};
+      const don = hop.donation_miss ? " <b>!don</b>" : "";
+      const bpt = hop.bytes_per_tuple == null ? "–"
+        : `${hop.bytes_per_tuple}${don}`;
+      const dpb = hop.dispatches_per_batch == null ? "–"
+        : hop.dispatches_per_batch;
       return `<tr><td>${esc(name)}</td><td>${hCell}</td>` +
              `<td>${reps.length}</td>` +
              `<td>${outs}</td><td>${ign}</td>` +
              `<td>${fmtUs(q.p50)}</td><td>${fmtUs(q.p95)}</td>` +
              `<td>${fmtUs(q.p99)}</td>` +
+             `<td>${dpb}</td><td>${bpt}</td>` +
              `<td>${spark(lh.slice(-60), 80, 26)} ${fmtUs(lag)}</td>` +
              `<td>${spark(h.slice(-60), 160, 26)} ${cur}</td></tr>`;
     }).join("") + "</table>";

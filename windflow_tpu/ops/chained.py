@@ -168,6 +168,17 @@ class ChainedTPU(Operator):
                            ts_min=batch.ts_min)
 
 
+def tpu_chainable(op: Operator) -> bool:
+    """True when :func:`fuse` can provably fold ``op`` into a single-XLA-
+    program :class:`ChainedTPU` stage TODAY (the pairwise fusion
+    ``MultiPipe.chain`` applies).  The fusion advisor
+    (windflow_tpu/analysis/fusion.py) generalizes from this predicate:
+    chains of ``tpu_chainable`` ops are "provable now", while window /
+    reduce / stateful tails need the whole-chain-fusion refactor the
+    advisor's plan is sized for."""
+    return isinstance(op, (MapTPU, FilterTPU, ChainedTPU))
+
+
 def fuse(a: Operator, b: Operator) -> Operator:
     """Fuse two chainable operators into one stage."""
     name = f"{a.name}|{b.name}"
