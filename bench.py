@@ -1094,6 +1094,22 @@ def main() -> None:
         roof["attributed_fraction"] = (
             round(attributed / mbpt, 4) if mbpt and attributed else None)
 
+    # whole-chain fusion (windflow_tpu/fusion, guarded by
+    # tools/check_bench_keys.py): the staged e2e run's realized fusion
+    # savings — fused chain names, dispatches the sweep no longer pays
+    # (N member hops -> one jitted dispatch per batch), and the interior
+    # boundary bytes the fused program never materializes in HBM.
+    # Recorded into bench_history.json so round-over-round comparisons
+    # see fusion on/off regressions; with WF_TPU_FUSE=0 the section
+    # still ships (zeros) so the keys guard holds on both paths.
+    fus = (e2e_sweep or {}).get("fusion") or {}
+    result["fusion"] = {
+        "enabled": bool(fus.get("enabled")),
+        "fused_chains": fus.get("fused_chains", []),
+        "dispatches_saved": fus.get("dispatches_saved_per_batch", 0.0),
+        "bytes_saved_per_batch": fus.get("bytes_saved_per_batch", 0.0),
+    }
+
     # latency section (guarded by tools/check_bench_keys.py): the p50/p99
     # distribution numbers the flight-recorder observability layer makes
     # first-class — recorded into bench_history.json so round-over-round
@@ -1250,6 +1266,7 @@ def main() -> None:
                  "sum_decl_methodology": result.get("sum_decl_methodology"),
                  "p99_batch_latency_ms": result["p99_batch_latency_ms"],
                  "roofline": result.get("roofline"),
+                 "fusion": result.get("fusion"),
                  "latency": result.get("latency"),
                  "preflight": result.get("preflight"),
                  "device": result.get("device"),

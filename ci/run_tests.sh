@@ -27,7 +27,8 @@ python tools/wf_lint.py
 # explicitly with `pytest -m slow` on the nightly leg.
 python -m pytest tests/test_staging.py tests/test_observability.py \
     tests/test_analysis.py tests/test_device_metrics.py \
-    tests/test_health.py tests/test_sweep_ledger.py -q -m 'not slow'
+    tests/test_health.py tests/test_sweep_ledger.py \
+    tests/test_fusion.py -q -m 'not slow'
 python -m pytest tests/ -q -m 'not slow'
 python __graft_entry__.py 8
 BENCH_PLATFORM=cpu BENCH_E2E_TUPLES=131072 python bench.py | tee bench_ci_out.txt

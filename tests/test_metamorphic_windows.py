@@ -77,7 +77,11 @@ def _run(family, wt, mode, rnd):
     op = _win_builder(family, wt, rnd).build()
     snk = (wf.Sink_Builder(on_result)
            .withParallelism(rnd.randint(1, 3)).build())
-    g = wf.PipeGraph(f"meta_{family}_{wt}", mode, wf.TimePolicy.EVENT)
+    # whole-chain fusion is a CONFIG dimension (windflow_tpu/fusion):
+    # fused and unfused sweeps must reproduce the oracle exactly
+    cfg = wf.Config(whole_chain_fusion=rnd.random() < 0.7)
+    g = wf.PipeGraph(f"meta_{family}_{wt}", mode, wf.TimePolicy.EVENT,
+                     config=cfg)
     g.add_source(src).add(op).add_sink(snk)
     g.run()
     return acc["count"], acc["total"]
@@ -133,7 +137,9 @@ def test_merge_and_split_with_tpu_window_stage():
               .withTimestampExtractor(lambda t: t["ts"])
               .withOutputBatchSize(b2).build())
         g = wf.PipeGraph("merge_split_tpuwin", wf.ExecutionMode.DEFAULT,
-                         wf.TimePolicy.EVENT)
+                         wf.TimePolicy.EVENT,
+                         config=wf.Config(
+                             whole_chain_fusion=rnd.random() < 0.7))
         p1 = g.add_source(s1)
         p2 = g.add_source(s2)
         merged = p1.merge(p2)

@@ -29,6 +29,10 @@ CAP = 256
 def _cfg(tmp_path=None, **kw):
     if tmp_path is not None:
         kw.setdefault("log_dir", str(tmp_path))
+    # these tests pin the UNFUSED sweep: they assert the pre-fusion
+    # per-hop dispatch/byte contracts (one jitted dispatch per operator
+    # hop); the fused-sweep contracts live in tests/test_fusion.py
+    kw.setdefault("whole_chain_fusion", False)
     return dataclasses.replace(default_config, **kw)
 
 

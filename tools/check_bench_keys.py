@@ -54,6 +54,14 @@ of the staged e2e pipeline) and ``roofline.attributed_fraction`` (hop
 sum over the raw kernel step's measured bytes — docs/OBSERVABILITY.md
 "Sweep ledger").  Guarded here identically; their disappearance would
 orphan the whole-chain-fusion plan (ROADMAP item 1) of its evidence.
+
+Since the fusion round the bench also publishes a ``fusion`` section
+(``fused_chains``, ``dispatches_saved``, ``bytes_saved_per_batch`` —
+docs/PERF.md round 10) from the staged e2e run's sweep ledger: the
+realized savings of the whole-chain fusion executor
+(windflow_tpu/fusion).  Guarded here identically — the section ships
+(zeroed) even under the WF_TPU_FUSE=0 kill switch, so its absence is a
+bench regression, not a configuration.
 """
 
 import json
@@ -64,6 +72,7 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 KEYS = ("ratio_vs_kernel", "staging_share_of_staged_run")
 LATENCY_KEYS = ("batch_p99_ms", "e2e_p50_ms", "e2e_p99_ms")
 ROOFLINE_KEYS = ("per_hop", "attributed_fraction")
+FUSION_KEYS = ("fused_chains", "dispatches_saved", "bytes_saved_per_batch")
 DEVICE_KEYS = ("compile_ms_total", "recompiles", "flops_per_batch")
 HEALTH_KEYS = ("graph_state", "stall_events", "watchdog_overhead_pct")
 
@@ -84,6 +93,8 @@ def check_source() -> None:
             ("latency", LATENCY_KEYS, "docs/OBSERVABILITY.md"),
             ("roofline", ROOFLINE_KEYS,
              "sweep ledger — docs/OBSERVABILITY.md sweep-ledger"),
+            ("fusion", FUSION_KEYS,
+             "whole-chain fusion — docs/PERF.md round 10"),
             ("preflight", ("check_ms",), "docs/ANALYSIS.md"),
             ("device", DEVICE_KEYS,
              "compile watcher — docs/OBSERVABILITY.md device-plane"),
@@ -96,7 +107,7 @@ def check_source() -> None:
                  f"{missing} ({contract} contract)")
     print("check_bench_keys: OK (bench.py source emits "
           + ", ".join(KEYS + ("latency", "preflight", "device",
-                              "health")) + ")")
+                              "health", "fusion")) + ")")
 
 
 def last_json_object(path: str):
@@ -196,6 +207,16 @@ def check_output(path: str) -> None:
             fail("'roofline.attributed_fraction' missing although the "
                  "kernel step's bytes were measured — per-hop bytes "
                  "did not attribute")
+    fus = result.get("fusion")
+    if isinstance(fus, dict):
+        missing = [k for k in FUSION_KEYS if k not in fus]
+        if missing:
+            fail(f"'fusion' section missing {missing} from bench output")
+    else:
+        # the fusion section derives from the e2e sweep ledger with no
+        # environmental failure mode (it ships zeroed under the
+        # WF_TPU_FUSE kill switch) — its absence IS the regression
+        fail("bench fusion section absent from bench output")
     pf = result.get("preflight")
     if isinstance(pf, dict):
         if "check_ms" not in pf:

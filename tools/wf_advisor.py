@@ -19,12 +19,20 @@ Usage::
         # per-hop numbers: DUMP is a stats JSON (dump_stats output, a
         # postmortem stats.json, or any dict with a "Sweep" section)
     python tools/wf_advisor.py ... --top N         # best N chains only
+    python tools/wf_advisor.py ... --verify DUMP   # projected vs REALIZED:
+        # DUMP is a stats JSON from a fusion-ON run; each plan chain is
+        # matched against the sweep ledger's fusion section and the
+        # projected savings are compared with what the fusion executor
+        # (windflow_tpu/fusion) actually delivered
 
 Without ``--stats`` the ranking uses spec-based projections (pre-flight
 record specs x batch capacity); with it, the sweep ledger's measured
 dispatches-per-batch and boundary bytes.  Exit status: 0 when at least
 one fusion candidate was found, 1 when the graph has none, 2 on
-usage/load failures.
+usage/load failures.  With ``--verify``: 0 when every fused chain
+realized its single dispatch per batch, 1 when a fused chain regressed
+(more than one dispatch/batch through the fused hop) or nothing fused
+although the plan had executable chains.
 """
 
 from __future__ import annotations
@@ -128,6 +136,88 @@ def render_text(p: dict) -> str:
     return "\n".join(lines)
 
 
+def verify(graph, sweep: dict, as_json: bool) -> int:
+    """Projected-vs-realized comparison: each plan chain whose member
+    prefix the fusion executor fused (the executor trims unsupported
+    tails — fusion/executor.plan_segments) is judged by the fused hop's
+    realized dispatches/batch; savings are reported side by side."""
+    from windflow_tpu.analysis.fusion import plan
+    p = plan(graph)
+    fus = sweep.get("fusion") or {}
+    realized = {tuple(c["members"]): c for c in fus.get("chains", [])}
+    rows = []
+    regressed = False
+    matched = 0
+    for c in p["chains"]:
+        ops = tuple(c["ops"])
+        hit = None
+        for members, rc in realized.items():
+            # the executor may fuse a PREFIX of the advisor chain (an
+            # unsupported tail dropped) — match the longest prefix
+            if members == ops[:len(members)]:
+                if hit is None or len(members) > len(hit["members"]):
+                    hit = rc
+        row = {"plan": list(ops),
+               "projected_dispatches_saved":
+                   c["dispatches_saved_per_batch"],
+               "projected_bytes_saved_per_batch":
+                   c["projected_bytes_saved_per_batch"]}
+        if hit is None:
+            row["realized"] = None
+        else:
+            matched += 1
+            dpb = hit.get("dispatches_per_batch")
+            row["realized"] = {
+                "fused": hit["name"],
+                "dispatches_per_batch": dpb,
+                "dispatches_saved_per_batch":
+                    hit.get("dispatches_saved_per_batch"),
+                "bytes_saved_per_batch": hit.get("bytes_saved_per_batch"),
+                "donated_inputs": hit.get("donated_inputs"),
+            }
+            if dpb is not None and dpb > 1.05:
+                # >1 dispatch/batch through the fused hop (small slack
+                # for EOS-flush passes amortized over short runs)
+                row["regressed"] = True
+                regressed = True
+        rows.append(row)
+    out = {"graph": p["graph"], "chains": rows,
+           "realized_total": {
+               "dispatches_saved_per_batch":
+                   fus.get("dispatches_saved_per_batch"),
+               "bytes_saved_per_batch": fus.get("bytes_saved_per_batch")}}
+    if as_json:
+        print(json.dumps(out, indent=2))
+    else:
+        print(f"wf_advisor --verify: graph '{p['graph']}' — "
+              f"{matched}/{len(rows)} plan chain(s) realized")
+        for row in rows:
+            arrows = " -> ".join(row["plan"])
+            r = row["realized"]
+            if r is None:
+                print(f"  {arrows}\n      NOT fused (projected "
+                      f"{row['projected_dispatches_saved']} dispatch(es) "
+                      "saved)")
+                continue
+            flag = "  REGRESSED" if row.get("regressed") else ""
+            print(f"  {arrows}\n      fused as {r['fused']}: "
+                  f"{r['dispatches_per_batch']} dispatch/batch "
+                  f"(projected saving {row['projected_dispatches_saved']}"
+                  f", realized {r['dispatches_saved_per_batch']}); "
+                  f"~{r['bytes_saved_per_batch'] or 0:.0f} boundary "
+                  f"bytes/batch elided{flag}")
+    if regressed:
+        return 1
+    # "nothing fused" is only a failure when the EXECUTOR itself deems
+    # chains executable (fusion/executor.plan_segments trims chains the
+    # advisor lists but the executor cannot run — host-interning
+    # stateful tails, 1-member runs): an inexecutable plan realizing
+    # nothing is correct behavior, not a regression
+    from windflow_tpu.fusion.executor import plan_segments
+    executable = plan_segments(graph)
+    return 1 if (executable and not matched) else 0
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("app", help="APP_MODULE or APP_MODULE:ATTR building "
@@ -137,11 +227,17 @@ def main(argv=None) -> int:
     ap.add_argument("--stats", metavar="DUMP",
                     help="stats JSON with a Sweep section: rank by "
                          "measured per-hop numbers")
+    ap.add_argument("--verify", metavar="DUMP",
+                    help="stats JSON from a fusion-ON run: compare the "
+                         "plan's projected savings with the fusion "
+                         "executor's realized ones")
     ap.add_argument("--top", type=int, default=0,
                     help="emit only the best N chains")
     args = ap.parse_args(argv)
 
     g = load_graph(args.app)
+    if args.verify:
+        return verify(g, load_sweep(args.verify), args.json)
     sweep = load_sweep(args.stats) if args.stats else None
     from windflow_tpu.analysis.fusion import plan
     p = plan(g, sweep=sweep, top=args.top)

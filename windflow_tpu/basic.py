@@ -244,6 +244,21 @@ class Config:
     # off leaves one `is not None` check at each read site.
     sweep_ledger: bool = bool(int(os.environ.get("WF_TPU_SWEEP_LEDGER",
                                                  "1")))
+    # Whole-chain fusion (windflow_tpu/fusion, docs/PERF.md round 10):
+    # at graph build, maximal fusible runs of adjacent TPU operators
+    # (the fusion advisor's plan — analysis/fusion.py) lower into ONE
+    # wf_jit program per batch sweep: the stateless members' record
+    # transforms are inlined ahead of the tail's program (map/filter
+    # prelude before a window lift/combine, keyed reduce, or dense-key
+    # stateful step), so the interior hop boundaries never materialize
+    # in HBM and the chain pays one dispatch where it paid N.  Member
+    # operators stay in the graph (stats/health/preflight contracts
+    # unchanged; their numbers are attributed from the fused hop).
+    # Fusion is skipped on a mesh (sharded program factories compose
+    # differently) and for stateful tails that intern keys on the host.
+    # Kill switch: WF_TPU_FUSE=0 restores one-dispatch-per-hop sweeps.
+    whole_chain_fusion: bool = bool(int(os.environ.get("WF_TPU_FUSE",
+                                                       "1")))
     # Multi-chip execution: a jax.sharding.Mesh with ("data", "key") axes
     # (see windflow_tpu.parallel.mesh.make_mesh).  When set, staging emitters
     # lay batches out data-sharded across the mesh and mesh-aware TPU

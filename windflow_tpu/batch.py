@@ -233,7 +233,11 @@ def _get_unpack(treedef, dtypes, capacity: int):
     """Cached device program re-typing one packed uint32 staging buffer
     into payload columns + ts lane + validity mask (derived on device from
     the trailing fill-count word — never transferred separately, and cached
-    per capacity, not per fill level)."""
+    per capacity, not per fill level).  The extra scalar output is the
+    pool's recycling GATE: it depends on the transferred buffer like every
+    other output, but it is never handed to a consumer, so no downstream
+    ``donate_argnums`` (ops/chained.py, windflow_tpu/fusion) can delete it
+    out from under ``StagingPool.acquire``'s readiness sync."""
     key = (treedef, dtypes, capacity)
     unpack = _UNPACK_CACHE.get(key)
     if unpack is None:
@@ -253,7 +257,7 @@ def _get_unpack(treedef, dtypes, capacity: int):
                     off += capacity
             n_valid = b[-1].astype(jnp.int32)
             return cols[:-1], cols[-1], \
-                jnp.arange(capacity, dtype=jnp.int32) < n_valid
+                jnp.arange(capacity, dtype=jnp.int32) < n_valid, n_valid
         unpack = wf_jit(unpack_fn, op_name="staging.unpack")
         _UNPACK_CACHE[key] = unpack
     return unpack
@@ -276,9 +280,13 @@ def stage_packed(buf: np.ndarray, treedef, dtypes, capacity: int, n: int,
     # device-plane accounting (monitoring/device_metrics): every fused
     # staging transfer credits the process-wide staged-byte gauge
     staging.device_bytes.note(buf.nbytes)
-    cols, ts, valid = unpack(dbuf)
+    cols, ts, valid, gate = unpack(dbuf)
     if pool is not None:
-        pool.release(buf, gate=valid)
+        # gate on the unpack's private scalar output, NOT a lane the
+        # consumer sees: a donated lane's deletion happens at the host's
+        # (async) dispatch enqueue, which proves nothing about the H2D
+        # DMA that is still reading `buf`
+        pool.release(buf, gate=gate)
     return DeviceBatch(jax.tree.unflatten(treedef, cols), ts, valid,
                        watermark=watermark, size=n, frontier=frontier,
                        ts_max=ts_max, ts_min=ts_min, trace=trace)

@@ -35,9 +35,9 @@ import time
 from collections import deque
 from typing import List, Optional
 
-from windflow_tpu.basic import (Config, ExecutionMode, TimePolicy,
-                                WindFlowError, current_time_usecs,
-                                default_config)
+from windflow_tpu.basic import (Config, ExecutionMode, RoutingMode,
+                                TimePolicy, WindFlowError,
+                                current_time_usecs, default_config)
 from windflow_tpu.graph.multipipe import MultiPipe
 from windflow_tpu.ops.base import Operator
 from windflow_tpu.ops.source import Source, SourceReplica
@@ -99,6 +99,13 @@ class PipeGraph:
         # None leaves one `is not None` check at each read site (stats,
         # trace metadata, postmortem) — nothing on the per-batch path
         self._ledger = None
+        # whole-chain fusion (windflow_tpu/fusion): the executable fused
+        # segments installed by _build when Config.whole_chain_fusion is
+        # on — each routes a whole operator chain as ONE jitted dispatch
+        # per batch.  Read by the wiring redirect below, the sweep
+        # ledger's fusion section, and stats attribution; empty means
+        # every hop dispatches its own program (the pre-fusion sweep).
+        self._fused_segments = []
         # last postmortem bundle written (crash path or dump_postmortem);
         # the lock serializes writers — the monitor thread's watchdog
         # auto-bundle and the driver's stall/crash path may race into
@@ -214,25 +221,80 @@ class PipeGraph:
             # only an "off" run needs the original hard build-time check
             self._check_fixed_capacity_ops()
 
+        # 1b. whole-chain fusion (windflow_tpu/fusion): executable fused
+        # segments lower into ONE program per batch — installed BEFORE
+        # wiring so the redirect below can route each segment as one hop.
+        # Preflight already ran (start() order), so the chains were
+        # type-checked as their constituent specs.  Skipped on a mesh:
+        # the sharded program factories compose differently.
+        from windflow_tpu.fusion import executor as _fusion
+        if getattr(self.config, "whole_chain_fusion", True) \
+                and self.config.mesh is None:
+            self._fused_segments = _fusion.apply_fusion(self)
+        fused_host = {}         # id(segment head/member) -> host op
+        fused_edge_skip = set()  # interior (src, dst) id pairs
+        for seg in self._fused_segments:
+            members = seg["members"]
+            for m in members[:-1]:
+                fused_host[id(m)] = members[-1]
+            for fa, fb in zip(members, members[1:]):
+                fused_edge_skip.add((id(fa), id(fb)))
+
         # 2. wire edges: emitters on sources of the edge, collectors +
-        #    channels on destinations
-        def wire_edge(src_op: Operator, dst_op: Operator):
+        #    channels on destinations.  ``route_op`` carries the edge's
+        #    routing contract; ``dst_op`` owns the consuming replicas —
+        #    they differ exactly when a fused segment's head hands its
+        #    edge to the segment host.
+        def wire_edge(src_op: Operator, route_op: Operator,
+                      dst_op: Operator):
             emitters = []
             for src_rep in src_op.replicas:
                 dests = [(dst_rep, dst_rep.add_channel())
                          for dst_rep in dst_op.replicas]
                 em = create_emitter(
-                    dst_op.routing, dests, src_op.output_batch_size,
+                    route_op.routing, dests, src_op.output_batch_size,
                     src_is_tpu=src_op.is_tpu, dst_is_tpu=dst_op.is_tpu,
-                    key_extractor=dst_op.key_extractor,
+                    key_extractor=route_op.key_extractor,
                     mesh=self.config.mesh)
                 emitters.append(em)
             return emitters
 
+        # downstream-keyby key forwarding (fusion satellite): a chain op
+        # feeding exactly one KEYBY device consumer extracts that
+        # consumer's keys INSIDE its own program and ships them on the
+        # batch's keys lane, so the consumer (or its keyby emitter)
+        # never re-extracts — collected while wiring, applied after
+        fanout = {}
+        key_forward = {}
+        for edge in self._edges():
+            if edge[0] == "op":
+                fanout[id(edge[1])] = fanout.get(id(edge[1]), 0) + 1
+            else:
+                src = edge[1].operators[-1]
+                fanout[id(src)] = fanout.get(id(src), 0) \
+                    + len(edge[1].split_children)
+
+        def note_key_forward(a, route_op):
+            # skipped when the CONSUMER is a fused-segment head too: the
+            # segment host re-extracts in-program (its prelude forces
+            # keys=None), so a forwarded lane would be computed per
+            # batch and provably discarded
+            if route_op.routing == RoutingMode.KEYBY \
+                    and route_op.is_tpu \
+                    and route_op.key_extractor is not None \
+                    and fanout.get(id(a)) == 1 \
+                    and id(a) not in fused_host \
+                    and id(route_op) not in fused_host:
+                key_forward[id(a)] = (a, route_op.key_extractor)
+
         for edge in self._edges():
             if edge[0] == "op":
                 _, a, b = edge
-                for rep, em in zip(a.replicas, wire_edge(a, b)):
+                if (id(a), id(b)) in fused_edge_skip:
+                    continue    # interior to a fused segment: no hop
+                tgt = fused_host.get(id(b), b)
+                note_key_forward(a, b)
+                for rep, em in zip(a.replicas, wire_edge(a, b, tgt)):
                     rep.emitter = em
             else:  # split point
                 _, mp = edge
@@ -240,12 +302,43 @@ class PipeGraph:
                 branch_heads = [child.operators[0]
                                 for child in mp.split_children]
                 per_src_branch_emitters = [
-                    wire_edge(src_op, head) for head in branch_heads]
+                    wire_edge(src_op, head,
+                              fused_host.get(id(head), head))
+                    for head in branch_heads]
                 # transpose: one SplittingEmitter per source replica
                 for i, rep in enumerate(src_op.replicas):
                     branches = [per_src_branch_emitters[b_idx][i]
                                 for b_idx in range(len(branch_heads))]
                     rep.emitter = SplittingEmitter(mp.split_fn, branches)
+
+        # 2b. apply the collected key forwards + safe input donation on
+        # chain programs (see ops/chained.py; fusion hosts donate through
+        # their own program build).  Donation is independent of the
+        # fusion flag: the chained-pair step's donation misses exist on
+        # un-fused sweeps too (sweep-ledger tripwire).
+        from windflow_tpu.ops.chained import ChainedTPU
+        upstreams = _fusion._upstream_edges(self)
+        for a, kx in key_forward.values():
+            if a._fusion_exec is not None:
+                a._fusion_exec.set_downstream_key_extractor(kx)
+            elif isinstance(a, ChainedTPU):
+                a.set_downstream_key_extractor(kx)
+        for op in self._operators:
+            if isinstance(op, ChainedTPU) and id(op) not in fused_host \
+                    and op._fusion_exec is None \
+                    and _fusion.input_donation_safe(op, upstreams):
+                op.enable_input_donation()
+
+        # 2c. fused-segment members are inert: their replicas receive no
+        # channels (interior edges skipped above) and never terminate
+        # through the EOS cascade — mark them done so is_done() and the
+        # watchdog read them as cleanly terminated; their stats are
+        # attributed from the fused hop at read time (stats()).
+        for seg in self._fused_segments:
+            for m in seg["members"][:-1]:
+                for rep in m.replicas:
+                    rep.done = True
+                    rep.stats.is_terminated = True
 
         # 3. collectors: one per replica with input channels
         for rep in self._all_replicas:
@@ -288,8 +381,11 @@ class PipeGraph:
             from windflow_tpu.monitoring.sweep_ledger import SweepLedger
             self._ledger = SweepLedger(self)
 
-        # sanity: every non-sink replica must have an emitter
+        # sanity: every non-sink replica must have an emitter (fused
+        # members are inert by design — the segment host emits for them)
         for op in self._operators:
+            if op._fused_into is not None:
+                continue
             for rep in op.replicas:
                 if rep.emitter is None and not op.is_terminal:
                     raise WindFlowError(
@@ -785,6 +881,11 @@ class PipeGraph:
         (``pipegraph.hpp:468-526``).  The fixed reference fields describe the
         FastFlow runtime; here they describe the host driver equivalents."""
         self.sample_gauges()
+        if self._fused_segments:
+            # per-op stats for fused members are attributed from the
+            # fused hop at read cadence (never on the batch path)
+            from windflow_tpu.fusion import attribute_member_stats
+            attribute_member_stats(self)
         return {
             "PipeGraph_name": self.name,
             "Mode": self.mode.value,

@@ -265,6 +265,13 @@ def _families(stats: dict,
             fam("wf_sweep_bytes_per_tuple_total", "gauge",
                 "Summed attributed HBM bytes per tuple across all hops") \
                 .add(totals["bytes_per_tuple"], base)
+        fusion = sweep.get("fusion") or {}
+        if fusion.get("enabled") and isinstance(
+                fusion.get("dispatches_saved_per_batch"), (int, float)):
+            fam("wf_fusion_dispatches_saved_per_batch", "gauge",
+                "Jitted dispatches per batch elided by whole-chain "
+                "fusion (windflow_tpu/fusion)") \
+                .add(fusion["dispatches_saved_per_batch"], base)
 
     # -- latency histograms --------------------------------------------------
     lat = stats.get("Latency") or {}

@@ -69,6 +69,11 @@ def _op_wrappers(op):
                 for w in list(v.values()):
                     if isinstance(w, WfJit):
                         yield w
+            elif isinstance(getattr(v, "_jit", None), WfJit):
+                # a fused stateless segment's chain program lives on the
+                # host op's FusedStatelessExec (windflow_tpu/fusion) —
+                # the fused hop's dispatches attribute here
+                yield v._jit
 
 
 class SweepLedger:
@@ -149,6 +154,15 @@ class SweepLedger:
         groups: Dict[str, list] = {}
         for op in g._operators:
             groups.setdefault(op.name, []).append(op)
+        # whole-chain fusion (windflow_tpu/fusion): member hops are
+        # marked fused_into and host hops carry the member list — the
+        # "how fused hops appear" contract docs/OBSERVABILITY.md pins
+        fused_member_of: Dict[str, str] = {}
+        fused_hosts: Dict[str, dict] = {}
+        for seg in getattr(g, "_fused_segments", ()):
+            for n in seg["member_names"][:-1]:
+                fused_member_of[n] = seg["name"]
+            fused_hosts[seg["host_name"]] = seg
         per_hop: Dict[str, dict] = {}
         claimed = set()
         tot_bpt = 0.0
@@ -217,6 +231,19 @@ class SweepLedger:
                 "capacity": cap,
                 "resident_output": st.get("resident_output", False),
             }
+            if key in fused_member_of and all(
+                    sib._fused_into is not None for sib in siblings):
+                # inert member of a fused segment: its execution (and
+                # its dispatches/bytes) live in the fused hop below.
+                # Guarded sibling-wise: hops aggregate per NAME, so an
+                # unfused op sharing the name must keep its real
+                # dispatch numbers unmasked (the per-wrapper attribution
+                # stance — never cross-credit name collisions).
+                hop["fused_into"] = fused_member_of[key]
+            elif key in fused_hosts:
+                seg = fused_hosts[key]
+                hop["fused_program"] = seg["name"]
+                hop["fused_members"] = seg["member_names"]
             if batches and attr_disp:
                 bpb = bytes_total / batches
                 hop["bytes_per_batch"] = round(bpb, 1)
@@ -277,10 +304,50 @@ class SweepLedger:
                 slot["bytes_per_dispatch"] = float(ba)
             non_hop[name] = slot
             tot_disp += d
+        # fusion summary: realized dispatch savings (N member hops now
+        # pay the host hop's single program) plus the projected interior
+        # boundary bytes a fused chain never materializes — write + re-
+        # read per boundary, the advisor's formula (analysis/fusion.plan)
+        # evaluated over the segments that actually fused
+        fusion_chains = []
+        fusion_dsaved = 0.0
+        fusion_bsaved = 0.0
+        for seg in getattr(g, "_fused_segments", ()):
+            n_members = len(seg["member_names"])
+            host_hop = per_hop.get(seg["host_name"]) or {}
+            dpb = host_hop.get("dispatches_per_batch")
+            bsum = 0.0
+            for mn in seg["member_names"][:-1]:
+                fuel = (per_hop.get(mn) or {}) \
+                    .get("fusion_fuel_bytes_per_batch")
+                if fuel:
+                    bsum += 2 * fuel
+            entry = {
+                "name": seg["name"],
+                "members": seg["member_names"],
+                "host": seg["host_name"],
+                "donated_inputs": bool(seg.get("donate_inputs")),
+                "dispatches_per_batch": dpb,
+                "unfused_dispatches_per_batch": float(n_members),
+                "bytes_saved_per_batch": round(bsum, 1),
+            }
+            if dpb is not None:
+                entry["dispatches_saved_per_batch"] = \
+                    round(n_members - dpb, 3)
+                fusion_dsaved += n_members - dpb
+            fusion_bsaved += bsum
+            fusion_chains.append(entry)
         return {
             "enabled": True,
             "per_hop": per_hop,
             "non_hop": non_hop,
+            "fusion": {
+                "enabled": bool(fusion_chains),
+                "fused_chains": [c["name"] for c in fusion_chains],
+                "chains": fusion_chains,
+                "dispatches_saved_per_batch": round(fusion_dsaved, 3),
+                "bytes_saved_per_batch": round(fusion_bsaved, 1),
+            },
             "totals": {
                 "bytes_per_tuple": round(tot_bpt, 2),
                 "dispatches_per_batch": round(tot_dpb, 3),

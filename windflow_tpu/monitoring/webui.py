@@ -175,8 +175,12 @@ async function render(id) {
       const don = hop.donation_miss ? " <b>!don</b>" : "";
       const bpt = hop.bytes_per_tuple == null ? "–"
         : `${hop.bytes_per_tuple}${don}`;
-      const dpb = hop.dispatches_per_batch == null ? "–"
-        : hop.dispatches_per_batch;
+      // whole-chain fusion: a member hop dispatches nothing — its
+      // program folded into the fused host hop it names here
+      const dpb = hop.fused_into
+        ? `⇒ ${esc(hop.fused_into)}`
+        : (hop.dispatches_per_batch == null ? "–"
+           : hop.dispatches_per_batch);
       return `<tr><td>${esc(name)}</td><td>${hCell}</td>` +
              `<td>${reps.length}</td>` +
              `<td>${outs}</td><td>${ign}</td>` +

@@ -267,6 +267,26 @@ class Operator:
     #: ``multipipe.hpp:441-444`` rejects bad GPU predecessors at build).
     #: The value is the label used in the error message.
     fixed_capacity_label = None
+    #: whole-chain fusion (windflow_tpu/fusion): non-None on the MEMBER
+    #: operators of a fused segment — the name of the fused hop their
+    #: execution folded into.  Member replicas are inert (wired with no
+    #: channels, marked done at build); stats are attributed from the
+    #: fused hop (fusion/executor.attribute_member_stats).
+    _fused_into = None
+    #: fused-segment HOST hooks: the stateless members' combined record
+    #: transform, inlined at program-build time by stateful tails
+    #: (ffat_tpu._build_step, ReduceTPU._get_step/_get_dense_step,
+    #: tpu_stateful._get_step); the fused program's registry name; and
+    #: whether the graph proved the input batch buffers unshared so the
+    #: program may take them with donate_argnums
+    #: (fusion/executor.input_donation_safe).
+    _fused_prelude = None
+    _fused_name = None
+    _fused_donate_inputs = False
+    #: all-stateless fused segments have no tail program to extend: the
+    #: host op carries a FusedStatelessExec instead, dispatched through
+    #: _TPUReplica._op_step (one attribute check per batch).
+    _fusion_exec = None
 
     def __init__(self, name: str, parallelism: int,
                  routing: RoutingMode = RoutingMode.FORWARD,
@@ -313,9 +333,15 @@ class Operator:
         return 0
 
     def dump_stats(self) -> dict:
-        return {
+        st = {
             "Operator_name": self.name,
             "Operator_type": type(self).__name__,
             "Parallelism": self.parallelism,
             "Replicas": [r.stats.to_json() for r in self.replicas],
         }
+        if self._fused_into is not None:
+            # whole-chain fusion: this operator's execution folded into
+            # one fused program (the replica counters above are
+            # attributed from that hop, not dispatched here)
+            st["Fused_into"] = self._fused_into
+        return st

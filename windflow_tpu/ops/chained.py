@@ -16,12 +16,9 @@ from __future__ import annotations
 
 from typing import Callable, List, Tuple
 
-import jax
-
 from windflow_tpu.basic import WindFlowError
 from windflow_tpu.batch import DeviceBatch
 from windflow_tpu.meta import adapt
-from windflow_tpu.monitoring.jit_registry import wf_jit
 from windflow_tpu.ops.base import Operator, Replica
 from windflow_tpu.ops.filter_op import Filter
 from windflow_tpu.ops.flatmap_op import FlatMap
@@ -144,28 +141,40 @@ class ChainedTPU(Operator):
         super().__init__(name, parallelism, routing=routing, is_tpu=True,
                          key_extractor=key_extractor)
         self.specs = specs
-        self._has_filter = any(k == "filter" for k, _ in specs)
+        # The step machinery IS the fusion executor's chain program
+        # (windflow_tpu/fusion FusedStatelessExec): a ChainedTPU is the
+        # one-op fused segment, so pairwise chain() and whole-chain
+        # fusion share a single implementation of the spec loop,
+        # downstream key extraction (the keys lane the old step silently
+        # dropped), and two-phase input donation.  Lazy import: the
+        # executor reads specs back through _tpu_specs below.
+        from windflow_tpu.fusion.executor import FusedStatelessExec
+        self._chain = FusedStatelessExec(name, [self])
 
-        def step(payload, valid):
-            for kind, fn in specs:
-                if kind == "map":
-                    payload = jax.vmap(fn)(payload)
-                elif kind == "batch_map":
-                    payload = fn(payload, valid)
-                else:
-                    valid = valid & jax.vmap(fn)(payload)
-            return payload, valid
+    def set_downstream_key_extractor(self, key_fn) -> None:
+        """Forward the keys lane through the chain: the downstream KEYBY
+        consumer's extractor runs inside this program on the chain's
+        OUTPUT records — exactly what the consumer's own in-program
+        extraction would compute — and rides the output batch's keys
+        lane, so neither the keyby emitter nor a stateful consumer's
+        ``.key_extract`` program pays a second dispatch.  Called by
+        ``PipeGraph._build`` when this op feeds exactly one device KEYBY
+        consumer."""
+        self._chain.set_downstream_key_extractor(key_fn)
 
-        self._jit_step = wf_jit(step, op_name=name)
+    def enable_input_donation(self) -> None:
+        """Donate the payload/valid input buffers to the chain program
+        (the sweep-ledger donation-miss fix): every staged batch's lanes
+        are fresh, unshared arrays, so XLA may write outputs in place
+        instead of copying whole buffers.  Only ``PipeGraph._build``
+        calls this, after proving the inputs unshared — device keyby /
+        broadcast / split edges alias one payload across destinations
+        and stay copy-on-write.  The aliasing half is checked against
+        the first batch's concrete specs (donation_aliases_cleanly)."""
+        self._chain.enable_input_donation()
 
     def _step(self, batch: DeviceBatch) -> DeviceBatch:
-        payload, valid = self._jit_step(batch.payload, batch.valid)
-        size = None if self._has_filter else batch.known_size
-        # keys lane not forwarded: edge-scoped metadata (see ops/tpu.py)
-        return DeviceBatch(payload, batch.ts, valid,
-                           watermark=batch.watermark, size=size,
-                           frontier=batch.frontier, ts_max=batch.ts_max,
-                           ts_min=batch.ts_min)
+        return self._chain.step(batch)
 
 
 def tpu_chainable(op: Operator) -> bool:

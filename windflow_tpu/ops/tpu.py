@@ -48,7 +48,13 @@ class _TPUReplica(Replica):
 
     def _op_step(self, batch: DeviceBatch):
         """Hook for replicas whose operator step needs the replica index
-        (per-replica state); default ops take the batch alone."""
+        (per-replica state); default ops take the batch alone.  A fused
+        all-stateless segment installs its chain program here
+        (windflow_tpu/fusion FusedStatelessExec) — the unfused path pays
+        exactly this one attribute check."""
+        fx = self.op._fusion_exec
+        if fx is not None:
+            return fx.step(batch)
         return self.op._step(batch)
 
     def process_device_batch(self, batch: DeviceBatch) -> None:
@@ -294,13 +300,22 @@ class ReduceTPU(Operator):
         self._drop_steps = 0
         self._pending_drop = None
 
-    def _get_step(self, capacity: int):
+    def _get_step(self, capacity: int, probe_batch=None):
         step = self._jit_steps.get(capacity)
         if step is None:
             comb = self.comb
             key_fn = self.key_extractor
+            prelude = self._fused_prelude
 
             def step(keys, payload, ts, valid):
+                if prelude is not None:
+                    # whole-chain fusion (windflow_tpu/fusion): the
+                    # stateless members run inside this same program.
+                    # Any edge-attached keys describe the PRE-chain
+                    # records — extraction must rerun on the chain's
+                    # output, below, in-program.
+                    payload, valid = prelude(payload, valid)
+                    keys = None
                 if keys is None:
                     if key_fn is not None:
                         keys = jax.vmap(key_fn)(payload).astype(jnp.int32)
@@ -310,7 +325,24 @@ class ReduceTPU(Operator):
                 return _segmented_reduce(keys, payload, ts, valid, comb,
                                          capacity)
 
-            step = wf_jit(step, op_name=self.name)
+            # staged-fed fused chain: the sorted reduce's outputs are
+            # capacity-shaped like its inputs, so donating the (provably
+            # unshared — fusion/executor.input_donation_safe) batch
+            # lanes lets XLA write them in place — provided the prelude
+            # preserves each lane's spec (donation_aliases_cleanly on
+            # the first batch's shapes); the dense path's [K] tables
+            # alias nothing and stay non-donated
+            donate = ()
+            if self._fused_donate_inputs and probe_batch is not None:
+                from windflow_tpu.fusion.executor import \
+                    donation_aliases_cleanly
+                if donation_aliases_cleanly(
+                        lambda p, t, v: step(None, p, t, v),
+                        probe_batch.payload, probe_batch.ts,
+                        probe_batch.valid):
+                    donate = (1, 2, 3)
+            step = wf_jit(step, op_name=self._fused_name or self.name,
+                          donate_argnums=donate)
             self._jit_steps[capacity] = step
         return step
 
@@ -336,8 +368,14 @@ class ReduceTPU(Operator):
             K = self.max_keys if self.key_extractor is not None else 1
             monoid = self.monoid
             key_fn = self.key_extractor
+            prelude = self._fused_prelude
 
             def step(keys, payload, ts, valid):
+                if prelude is not None:
+                    # fused chain: see _get_step — the prelude runs here
+                    # and keys re-extract from its output
+                    payload, valid = prelude(payload, valid)
+                    keys = None
                 if keys is None:
                     keys = jax.vmap(key_fn)(payload).astype(jnp.int32) \
                         if key_fn is not None \
@@ -359,7 +397,8 @@ class ReduceTPU(Operator):
                 has = jnp.zeros(K + 1, bool).at[row].set(True)[:K]
                 return table, ts_t, has, n_drop
 
-            step = wf_jit(step, op_name=f"{self.name}.dense")
+            step = wf_jit(step,
+                          op_name=f"{self._fused_name or self.name}.dense")
             self._jit_steps[("dense", capacity)] = step
         return step
 
@@ -459,7 +498,15 @@ class ReduceTPU(Operator):
 
     def _step(self, batch: DeviceBatch) -> DeviceBatch:
         if not self._jit_steps:
-            self._check_comb_contract(batch.payload)
+            payload = batch.payload
+            if self._fused_prelude is not None:
+                # fused chain: the combiner folds the chain's OUTPUT
+                # records — contract-check against the post-prelude spec
+                # (abstract eval, zero device work)
+                from windflow_tpu.fusion.executor import prelude_out_spec
+                payload = prelude_out_spec(self._fused_prelude,
+                                           batch.payload, batch.valid)
+            self._check_comb_contract(payload)
         if self.mesh is not None:
             # Sharded variant: dense per-chip partials combined over ICI;
             # output is a capacity-max_keys batch of distinct-key records.
@@ -492,8 +539,9 @@ class ReduceTPU(Operator):
                                watermark=batch.watermark, size=None,
                                frontier=batch.frontier)
         out_keys, out_payload, out_ts, out_valid = \
-            self._get_step(batch.capacity)(batch.keys, batch.payload,
-                                           batch.ts, batch.valid)
+            self._get_step(batch.capacity, batch)(batch.keys,
+                                                  batch.payload,
+                                                  batch.ts, batch.valid)
         return DeviceBatch(out_payload, out_ts, out_valid,
                            watermark=batch.watermark, size=None,
                            frontier=batch.frontier)

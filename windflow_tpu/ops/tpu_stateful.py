@@ -291,10 +291,25 @@ class _StatefulTPUBase(Operator):
             body = self._body(capacity)
             key_fn = self.key_extractor
             S = self.num_key_slots
+            prelude = self._fused_prelude
+            if prelude is not None and not self.dense_keys:
+                # the fusion planner only selects dense-key tails
+                # (fusion/executor._tail_supported): interning reads
+                # distinct keys to host BEFORE the step, which a fused
+                # program cannot serve mid-chain
+                raise WindFlowError(
+                    f"stateful operator '{self.name}': whole-chain "
+                    "fusion requires withDenseKeys")
             if self.dense_keys:
                 # slot = key, resolved inside the one compiled program: the
                 # whole step is async device work, no host round-trip
                 def step(state, payload, valid, keys):
+                    if prelude is not None:
+                        # fused chain: the stateless members run inside
+                        # this program; edge-attached keys describe the
+                        # PRE-chain records — re-extract from its output
+                        payload, valid = prelude(payload, valid)
+                        keys = None
                     if keys is None:
                         keys = jax.vmap(key_fn)(payload).astype(jnp.int32)
                     ok = valid & (keys >= 0) & (keys < S)
@@ -304,7 +319,8 @@ class _StatefulTPUBase(Operator):
                     pos = jnp.clip(jnp.searchsorted(uniq_keys, keys),
                                    0, capacity - 1)
                     return body(state, payload, valid, uniq_slots[pos])
-            step = wf_jit(step, op_name=self.name, donate_argnums=(0,))
+            step = wf_jit(step, op_name=self._fused_name or self.name,
+                          donate_argnums=(0,))
             self._steps[capacity] = step
         return step
 
@@ -388,8 +404,11 @@ class StatefulMapTPU(_StatefulTPUBase):
 
     def _step(self, batch: DeviceBatch) -> DeviceBatch:
         self._state, out_payload, valid = self._stateful_step(batch)
+        # fused chains may filter inside the program: the input count no
+        # longer bounds the survivors, so the size is observed lazily
+        size = None if self._fused_prelude is not None else batch._size
         return DeviceBatch(out_payload, batch.ts, valid,
-                           watermark=batch.watermark, size=batch._size,
+                           watermark=batch.watermark, size=size,
                            frontier=batch.frontier)
 
 

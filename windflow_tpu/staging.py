@@ -138,16 +138,34 @@ class StagingPool:
         if gate is not None:
             ready = True
             try:
-                ready = bool(gate.is_ready())
+                # A DELETED gate cannot be synced on (is_ready/
+                # block_until_ready raise) — by construction it never
+                # happens for the pool's own gates: batch.stage_packed
+                # gates on the unpack program's PRIVATE scalar output,
+                # which no consumer can reach with donate_argnums
+                # (deletion at a donating consumer's async dispatch
+                # enqueue would prove nothing about the H2D DMA still
+                # reading `buf`).  Foreign gates that do arrive deleted
+                # fall through as "ready" — there is nothing left to
+                # wait on.
+                if getattr(gate, "is_deleted", lambda: False)():
+                    ready = True
+                else:
+                    ready = bool(gate.is_ready())
             except (AttributeError, RuntimeError, TypeError):
-                # gate arrays are backend-supplied: deleted buffers raise
-                # RuntimeError, non-jax gates lack is_ready — treat any of
-                # these as "not provably ready" and sync below
+                # gate arrays are backend-supplied: non-jax gates lack
+                # is_ready/is_deleted — treat as "not provably ready"
+                # and sync below
                 ready = False
             if not ready:
                 self.gate_waits += 1
                 import jax
-                jax.block_until_ready(gate)
+                try:
+                    jax.block_until_ready(gate)
+                except RuntimeError:
+                    # deleted between the check and the sync: nothing
+                    # left to wait on
+                    pass
         return buf
 
     def release(self, buf: np.ndarray, gate=None) -> None:

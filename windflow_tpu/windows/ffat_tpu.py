@@ -257,7 +257,25 @@ class FfatWindowsTPU(Operator):
                                   self.key_extractor,
                                   monoid=self.monoid,
                                   grouping=self._grouping())
-        return wf_jit(step, op_name=self.name, donate_argnums=(0,))
+        prelude = self._fused_prelude
+        if prelude is not None:
+            # Whole-chain fusion (windflow_tpu/fusion): the fused
+            # segment's stateless members run INSIDE this program, so
+            # the map/filter hop boundaries the sweep ledger priced
+            # never materialize in HBM and the chain pays this single
+            # dispatch.  Ring regrowth rebuilds the step through this
+            # same path, so a regrown program keeps its prelude.
+            inner = step
+
+            def step(state, payload, ts, valid, *rest):
+                payload, valid = prelude(payload, valid)
+                return inner(state, payload, ts, valid, *rest)
+        # State-only donation, fused or not: the ring is the program's
+        # one input whose buffers an output aliases (window results have
+        # their own shapes — batch-lane donation would elide nothing and
+        # XLA warns about unusable donations).
+        return wf_jit(step, op_name=self._fused_name or self.name,
+                      donate_argnums=(0,))
 
     def _grouping(self) -> str:
         """Batch-grouping algorithm from the graph config (rank_scatter |
@@ -330,8 +348,16 @@ class FfatWindowsTPU(Operator):
                 "FfatWindowsTPU requires a fixed upstream batch capacity "
                 f"({self._capacity}), got {batch.capacity}")
         if sidx not in self._states:
+            payload = batch.payload
+            if self._fused_prelude is not None:
+                # fused chain: the lift sees the chain's OUTPUT records —
+                # size the aggregate state from the post-prelude spec
+                # (abstract eval, zero device work)
+                from windflow_tpu.fusion.executor import prelude_out_spec
+                payload = prelude_out_spec(self._fused_prelude,
+                                           batch.payload, batch.valid)
             self._states[sidx] = self._init_state(
-                agg_spec_for(self.lift, batch.payload))
+                agg_spec_for(self.lift, payload))
 
     def _wm_pane(self, wm: int) -> int:
         """Lateness-adjusted watermark in pane units (the host-side firing
