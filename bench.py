@@ -1193,6 +1193,59 @@ def main() -> None:
         # check_bench_keys loudly, not kill the bench artifact)
         result["health_error"] = f"{type(e).__name__}: {e}"[:200]
 
+    # durability section (windflow_tpu/durability, guarded by
+    # tools/check_bench_keys.py + check_bench_regress.py): drive the
+    # representative kafka->map->window->sink graph with checkpointing
+    # OFF then ON (same data, same cadence contract the chaos harness
+    # uses), report the checkpoint wall cost/bytes and the e2e overhead
+    # of enabling durability (acceptance bound: <5%), then time a real
+    # PipeGraph.restore() from the committed store — the restored run
+    # replays the tail through the sink fence, so this leg doubles as an
+    # exactly-once smoke (nonzero lost/duplicated output would change
+    # the topic, caught by the chaos suite's record diff in CI).
+    _dwork = None
+    try:
+        import tempfile as _tf
+        from windflow_tpu.durability import chaos as _chaos
+        _dn = int(os.environ.get("BENCH_DURABILITY_TUPLES", "32768"))
+        _dwork = _tf.mkdtemp(prefix="bench_durability_")
+        _chaos.make_cell("window_cb", "", n=_dn)["factory"]().run()  # warm
+        t0 = time.perf_counter()
+        _chaos.make_cell("window_cb", "", n=_dn)["factory"]().run()
+        _t_off = time.perf_counter() - t0
+        _dck = os.path.join(_dwork, "ckpt")
+        _cell = _chaos.make_cell("window_cb", _dck, n=_dn,
+                                 epoch_sweeps=16)
+        t0 = time.perf_counter()
+        _gd = _cell["factory"]().run()
+        _t_on = time.perf_counter() - t0
+        _dsec = _gd.stats()["Durability"]
+        _gr = _cell["factory"]()
+        _gr.restore(_dck)
+        _gr.wait_end()
+        result["durability"] = {
+            "epochs_committed": _dsec["epochs_committed"],
+            # mean over the run's epochs, not the last sample: each
+            # checkpoint includes an fsync, so a single shot carries
+            # I/O jitter the trend guards would trip on
+            "checkpoint_ms": round(
+                _dsec["checkpoint_ms_total"]
+                / max(1, _dsec["epochs_committed"]), 3),
+            "checkpoint_bytes": _dsec["last_checkpoint_bytes"],
+            "restore_ms": _gr.stats()["Durability"]["restore_ms"],
+            "overhead_pct": round(100.0 * (_t_on - _t_off)
+                                  / max(_t_off, 1e-9), 2),
+            "tuples": _dn,
+        }
+    except Exception as e:  # lint: broad-except-ok (same stance as the
+        # preflight/health legs: a durability regression must fail
+        # check_bench_keys loudly, not kill the bench artifact)
+        result["durability_error"] = f"{type(e).__name__}: {e}"[:200]
+    finally:
+        if _dwork is not None:
+            import shutil as _sh
+            _sh.rmtree(_dwork, ignore_errors=True)
+
     # device-plane section (windflow_tpu/monitoring/jit_registry, guarded
     # by tools/check_bench_keys.py): the compile watcher's process totals
     # over every leg above — compile wall cost, recompile events (any
@@ -1271,6 +1324,7 @@ def main() -> None:
                  "preflight": result.get("preflight"),
                  "device": result.get("device"),
                  "health": result.get("health"),
+                 "durability": result.get("durability"),
                  "e2e": result.get("e2e"),
                  "e2e_device_source": result.get("e2e_device_source"),
                  "ysb": result.get("ysb"),

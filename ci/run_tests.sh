@@ -17,8 +17,12 @@ python tools/wf_lint.py
 # the <2% overhead budget), the analysis contracts (preflight diagnostic
 # codes, wf_lint fixtures, debug-mode race detector), the device-plane
 # contracts (compile watcher, OpenMetrics exposition, HBM-gauge CPU
-# guard), and the health-plane contracts (watchdog state machine, stall
-# attribution, postmortem/wf_doctor round trip, crash-path END_APP) fail
+# guard), the health-plane contracts (watchdog state machine, stall
+# attribution, postmortem/wf_doctor round trip, crash-path END_APP), and
+# the durability contracts (one chaos kill->restore->record-diff cell
+# per mechanism, checkpoint store layout/GC, WF602 restore validation,
+# sink EOS fence, off-path budget — the full family x kill point x
+# fusion soak matrix is slow-marked for the nightly leg) fail
 # in seconds, before the full suite spends minutes.  The full-suite run
 # below repeats them — accepted: the gate's job is fast failure.  The
 # full suite deselects `slow` like the tier-1 gate does (same filter =
@@ -28,7 +32,7 @@ python tools/wf_lint.py
 python -m pytest tests/test_staging.py tests/test_observability.py \
     tests/test_analysis.py tests/test_device_metrics.py \
     tests/test_health.py tests/test_sweep_ledger.py \
-    tests/test_fusion.py -q -m 'not slow'
+    tests/test_fusion.py tests/test_durability.py -q -m 'not slow'
 python -m pytest tests/ -q -m 'not slow'
 python __graft_entry__.py 8
 BENCH_PLATFORM=cpu BENCH_E2E_TUPLES=131072 python bench.py | tee bench_ci_out.txt

@@ -1,0 +1,453 @@
+"""Durable-state suite (windflow_tpu/durability, docs/DURABILITY.md):
+watermark-aligned checkpoint/restore, exactly-once sinks, and the
+failure-injection (chaos) A/B family — kill a replica mid-window /
+mid-epoch / mid-sink-flush under seeded schedules, restore, and diff
+the sunk output record-for-record against the uninterrupted run.
+
+The fast gate runs one chaos cell per mechanism (aligned barrier,
+fenced Kafka dedupe, stateful-table restore, atomic-rename file sink)
+plus the protocol/observability unit tests; the full family x kill
+point x fusion matrix is the ``slow``-marked soak (CI_NIGHTLY leg)."""
+
+import dataclasses
+import json
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+import windflow_tpu as wf
+from windflow_tpu.basic import WindFlowError
+from windflow_tpu.durability import chaos
+from windflow_tpu.durability.checkpoint import (load_checkpoint,
+                                                topology_signature)
+from windflow_tpu.durability.sinks import EpochFileSink
+from windflow_tpu.kafka.client import InMemoryBroker
+from windflow_tpu.kafka.kafka_sink import KafkaSink, KafkaSinkMessage
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _cell_pair(tmp_path, family, *, fusion=True, n=4096):
+    base = chaos.make_cell(family, str(tmp_path / "ck_a"), fusion=fusion,
+                           out_dir=str(tmp_path / "out_a"), n=n)
+    chal = chaos.make_cell(family, str(tmp_path / "ck_b"), fusion=fusion,
+                           out_dir=str(tmp_path / "out_b"), n=n)
+    return base, chal
+
+
+def _run_cell(tmp_path, family, point, *, fusion=True, n=4096,
+              spec=None):
+    base, chal = _cell_pair(tmp_path, family, fusion=fusion, n=n)
+    v = chaos.run_ab(base["factory"], chal["factory"],
+                     spec or chaos.default_kill(family, point),
+                     base["read"], chal["read"])
+    assert v["diff"] is None, \
+        f"{family}/{point}/fusion={fusion}: {v['diff']}"
+    assert v["restored_epoch"] is not None
+    assert v["records"] > 0
+    return v
+
+
+# ---------------------------------------------------------------------------
+# chaos A/B: one fast cell per mechanism
+# ---------------------------------------------------------------------------
+
+def test_chaos_window_mid_epoch_fused(tmp_path):
+    """Kill between checkpoints on the fused map->CB-window chain: the
+    FFAT ring + frontier restore mid-stream, the Kafka source seeks
+    back, and the resumed output matches record for record."""
+    _run_cell(tmp_path, "window_cb", "mid_epoch", fusion=True)
+
+
+def test_chaos_window_mid_sink_flush_dedupes(tmp_path):
+    """Kill in the torn two-phase window (sink epoch committed, manifest
+    never written): the replay re-commits the epoch and the broker-side
+    fence dedupes every already-published message — the exactly-once
+    case plain flush cannot survive.  Fusion OFF covers the unfused
+    sweep in the fast gate (the slow matrix crosses both)."""
+    v = _run_cell(tmp_path, "window_cb", "mid_sink_flush", fusion=False)
+    assert v["dedupe_hits"] > 0
+
+
+def test_chaos_stateful_mid_window(tmp_path):
+    """Kill the dense-key stateful operator mid-batch: the slot table +
+    per-key running sums restore to the barrier and replay continues
+    them without double counting."""
+    _run_cell(tmp_path, "stateful", "mid_window")
+
+
+def test_chaos_reduce_mid_epoch(tmp_path):
+    """Host keyed Reduce: per-replica rolling state dicts restore."""
+    _run_cell(tmp_path, "reduce", "mid_epoch")
+
+
+def test_chaos_file_sink_mid_sink_flush(tmp_path):
+    """EpochFileSink stage-then-rename: kill after the rename but before
+    the manifest — the replayed epoch overwrites the file idempotently
+    and the committed concatenation stays the exact record sequence."""
+    _run_cell(tmp_path, "stateless_chain", "mid_sink_flush")
+    out = EpochFileSink.read_committed(str(tmp_path / "out_b"))
+    assert out and not os.path.exists(
+        str(tmp_path / "out_b" / ".staging" / "open.jsonl"))
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("fusion", [True, False])
+@pytest.mark.parametrize("point", chaos.KILL_POINTS)
+@pytest.mark.parametrize("family", chaos.FAMILIES)
+def test_chaos_matrix_soak(tmp_path, family, point, fusion):
+    """The full acceptance matrix: every seeded kill point across every
+    graph family, fusion ON and OFF — 30 cells of kill -> restore ->
+    record-for-record diff (nightly leg; tools/wf_chaos.py runs the
+    same cells standalone)."""
+    n = 4096 if family != "window_tb" else 6558
+    v = _run_cell(tmp_path, family, point, fusion=fusion, n=n)
+    if point == "mid_sink_flush" and family != "stateless_chain":
+        assert v["dedupe_hits"] > 0
+
+
+# ---------------------------------------------------------------------------
+# checkpoint protocol units
+# ---------------------------------------------------------------------------
+
+def test_checkpoint_store_layout_and_gc(tmp_path):
+    """Epoch-versioned entries land in the LogKV, the manifest is the
+    commit marker, and GC tombstones epochs beyond durability_keep."""
+    cell = chaos.make_cell("window_cb", str(tmp_path / "ck"), n=4096,
+                           epoch_sweeps=2)
+    g = cell["factory"]()
+    g.run()
+    sec = g.stats()["Durability"]
+    assert sec["enabled"] and sec["epochs_committed"] >= 3
+    assert sec["last_checkpoint_bytes"] > 0
+    assert sec["checkpoint_ms_total"] >= sec["last_checkpoint_ms"]
+    pending = load_checkpoint(str(tmp_path / "ck"))
+    last = sec["epochs_committed"] - 1
+    assert pending["epoch"] == last
+    assert pending["manifest"]["topology"] == topology_signature(
+        g._operators)
+    # retention: with durability_keep=2, epoch 0's records are gone
+    from windflow_tpu.persistent.kv import LogKV
+    kv = LogKV(str(tmp_path / "ck" / "checkpoint.kv"))
+    try:
+        eps = {int(k.split(b"/", 2)[1]) for k in kv.keys()
+               if k.startswith(b"ep/")}
+        assert 0 not in eps and last in eps
+        assert len(eps) <= g.config.durability_keep
+    finally:
+        kv.close()
+
+
+def test_restore_into_mismatched_graph_errors_named_diff(tmp_path):
+    """WF602: restoring into a graph whose topology/record specs differ
+    from the manifest fails with a diff naming the operator and field —
+    never a silent wrong-state restore."""
+    cell = chaos.make_cell("window_cb", str(tmp_path / "ck"), n=2048)
+    cell["factory"]().run()
+
+    cfg = dataclasses.replace(wf.default_config)
+    cfg.durability = str(tmp_path / "ck")
+    wrong = wf.PipeGraph("chaos", config=cfg)
+    src = (wf.Source_Builder(lambda: iter(()))
+           .withName("ksrc").withOutputBatchSize(256).build())
+    pipe = wrong.add_source(src)
+    pipe.add(wf.MapTPU_Builder(lambda t: t).withName("m").build())
+    pipe.add_sink(wf.Sink_Builder(lambda r: None).withName("snk").build())
+    with pytest.raises(WindFlowError) as ei:
+        wrong.restore()
+    msg = str(ei.value)
+    assert "WF602" in msg and "checkpoint has" in msg
+    assert not wrong._started
+
+    # same shape, different operator type: the diff names the field
+    wrong2 = cell["factory"]()
+    wrong2._topo_operators()[1].name = "renamed"
+    with pytest.raises(WindFlowError) as ei2:
+        wrong2.restore(str(tmp_path / "ck"))
+    assert "WF602" in str(ei2.value) and "renamed" in str(ei2.value)
+
+
+def test_restore_does_not_mutate_shared_config(tmp_path):
+    """restore(dir) must not write the checkpoint directory through a
+    shared Config instance (PipeGraph holds passed configs by
+    reference): a sibling graph built from the same Config would
+    silently open the same store and collide on sink fences."""
+    cell = chaos.make_cell("window_cb", str(tmp_path / "ck"), n=4096)
+    cell["factory"]().run()
+    shared = dataclasses.replace(wf.default_config)
+    assert shared.durability == ""
+    g = cell["factory"]()
+    g.config = shared                  # composed graph on a shared config
+    g.restore(str(tmp_path / "ck"))
+    g.wait_end()
+    assert shared.durability == ""     # untouched
+    assert g.config.durability == str(tmp_path / "ck")
+
+
+def test_restore_needs_a_complete_epoch(tmp_path):
+    cell = chaos.make_cell("window_cb", str(tmp_path / "empty"), n=2048)
+    g = cell["factory"]()
+    with pytest.raises(WindFlowError, match="nothing to restore"):
+        g.restore()
+
+
+def test_epoch_file_sink_rejects_parallelism(tmp_path):
+    """A shared EpochFileSink object under sink parallelism > 1 would
+    race its staging handle across pooled replicas — the plane rejects
+    the composition loudly at build."""
+    import windflow_tpu as wf
+    cfg = dataclasses.replace(wf.default_config)
+    cfg.durability = str(tmp_path / "ck")
+    cfg.preflight = "off"
+    g = wf.PipeGraph("par", config=cfg)
+    src = (wf.Source_Builder(lambda: iter([{"v": 1}]))
+           .withOutputBatchSize(8).build())
+    g.add_source(src).add_sink(
+        wf.Sink_Builder(EpochFileSink(str(tmp_path / "out")))
+        .withParallelism(2).build())
+    with pytest.raises(WindFlowError, match="parallelism == 1"):
+        g.start()
+    g._finalize(dump=False)
+
+
+def test_epoch_file_sink_cold_restart_discards_stale_staging(tmp_path):
+    """A cold restart (no restore — e.g. the crash predated the first
+    checkpoint) constructs a fresh EpochFileSink over the same dir: the
+    dead run's staged-but-uncommitted records must not leak into the new
+    run's first committed epoch."""
+    d = str(tmp_path / "out")
+    dead = EpochFileSink(d)
+    dead({"ghost": 1})
+    dead._f.flush()                       # crashed before any commit
+    fresh = EpochFileSink(d)
+    fresh({"real": 1})
+    fresh.commit_epoch(0)
+    assert EpochFileSink.read_committed(d) == [{"real": 1}]
+
+
+def test_unpicklable_state_errors_name_the_operator(tmp_path):
+    """An unpicklable user state object fails the checkpoint with an
+    error naming the operator, not a raw PicklingError out of step()."""
+    cell = chaos.make_cell("window_cb", str(tmp_path / "ck"), n=4096)
+    g = cell["factory"]()
+    g.start()
+    g._operators[0].snapshot_state = lambda: {"bad": lambda: None}
+    with pytest.raises(WindFlowError, match="not.*picklable"):
+        g._durability.checkpoint()
+    assert "ksrc" in str(
+        pytest.raises(WindFlowError, g._durability.checkpoint).value)
+    g._finalize(dump=False)
+
+
+# ---------------------------------------------------------------------------
+# exactly-once Kafka sink mechanics
+# ---------------------------------------------------------------------------
+
+def test_kafka_sink_eos_flush_and_fence():
+    """Satellite fix: on_eos flushes AND fences — a straggler tuple
+    after the EOS flush raises loudly instead of racing the producer
+    teardown into a silent drop."""
+    broker = InMemoryBroker()
+    broker.create_topic("out", 1)
+    snk = KafkaSink(lambda r: KafkaSinkMessage("out", r), broker,
+                    name="ks")
+    snk.build_replicas(wf.ExecutionMode.DEFAULT, wf.TimePolicy.INGRESS)
+    rep = snk.replicas[0]
+    rep.process_single({"v": 1}, 10, 10)
+    rep.on_eos()
+    assert rep._fenced
+    assert broker.topic_size("out") == 1     # flushed, not dropped
+    with pytest.raises(WindFlowError, match="flush-and-fence"):
+        rep.process_single({"v": 2}, 11, 11)
+
+
+def test_kafka_part_max_restores_group_level(tmp_path):
+    """Per-partition event-time frontiers are group-level state: after a
+    restore, EVERY source replica seeds the merged _part_max map (the
+    rebalance may hand a partition to a different replica index than the
+    one that checkpointed it); the first poll prunes foreign entries."""
+    from windflow_tpu.kafka.kafka_source import KafkaSource
+    broker = InMemoryBroker()
+    broker.create_topic("in", 2)
+    p = broker.producer()
+    for i in range(3000):
+        p.produce("in", {"key": i % 4, "value": float(i)},
+                  partition=i % 2, timestamp_usec=1_000 + i)
+
+    def deser(msg, shipper):
+        if msg is None:
+            return True
+        shipper.pushWithTimestamp(dict(msg.value), msg.timestamp_usec)
+        return True
+
+    def factory():
+        cfg = dataclasses.replace(wf.default_config)
+        cfg.durability = str(tmp_path / "ck")
+        cfg.durability_epoch_sweeps = 2
+        cfg.punctuation_interval_usec = 10 ** 12
+        cfg.health_postmortem_on_crash = False
+        src = KafkaSource(deser, broker, ["in"], group_id="gp",
+                          name="ksrc", parallelism=2,
+                          output_batch_size=128)
+        g = wf.PipeGraph("pmax", config=cfg)
+        g.add_source(src).add_sink(
+            wf.Sink_Builder(lambda r: None).build())
+        return g
+
+    g = factory()
+    g.start()
+    arm_spec = chaos.KillSpec("mid_epoch", after=5)
+    chaos.arm(g, arm_spec)
+    with pytest.raises(chaos.ChaosKill):
+        g.wait_end()
+    chaos.abandon(g)
+    # both partitions were heard pre-kill, by whichever replica owned
+    # them — the merged checkpoint map must cover both
+    g2 = factory()
+    g2.restore()
+    src_op = g2._topo_operators()[0]
+    merged = src_op._restore_part_max
+    assert set(merged) == {("in", 0), ("in", 1)}
+    for rep in src_op.replicas:
+        # every replica seeded the full map; pruning to its own
+        # assignment happens at its first poll
+        for tp, ts in merged.items():
+            assert rep._part_max.get(tp) == ts
+    g2._finalize(dump=False)
+    chaos.abandon(g2)
+
+
+def test_broker_fence_dedupes_on_lifetime_seq():
+    """fenced_commit is atomic + idempotent: replayed seqs skip, new
+    seqs append, the fence tracks the frontier."""
+    broker = InMemoryBroker()
+    broker.create_topic("t", 1)
+    msgs = [(s, "t", f"m{s}", None, None, 1000 + s) for s in (1, 2, 3)]
+    appended, deduped = broker.fenced_commit("f", 0, msgs)
+    assert (appended, deduped) == (3, 0)
+    # replay epoch 0's tail + epoch 1's fresh messages in one commit
+    replay = msgs[1:] + [(4, "t", "m4", None, None, 1004)]
+    appended, deduped = broker.fenced_commit("f", 1, replay)
+    assert (appended, deduped) == (1, 2)
+    assert broker.fence("f") == (1, 4)
+    assert broker.topic_size("t") == 4
+
+
+# ---------------------------------------------------------------------------
+# preflight WF6xx
+# ---------------------------------------------------------------------------
+
+def _durable_cfg(tmp_path):
+    cfg = dataclasses.replace(wf.default_config)
+    cfg.durability = str(tmp_path / "ck")
+    return cfg
+
+
+def test_preflight_wf601_non_replayable_source(tmp_path):
+    g = wf.PipeGraph("p", config=_durable_cfg(tmp_path))
+    src = (wf.Source_Builder(lambda: iter([{"v": 1}]))
+           .withOutputBatchSize(8).build())
+    g.add_source(src).add(
+        wf.MapTPU_Builder(lambda t: t).build()).add_sink(
+        wf.Sink_Builder(lambda r: None).build())
+    codes = [d.code for d in g.check()]
+    assert "WF601" in codes
+    # same graph without durability: silent
+    g2 = wf.PipeGraph("p2")
+    src2 = (wf.Source_Builder(lambda: iter([{"v": 1}]))
+            .withOutputBatchSize(8).build())
+    g2.add_source(src2).add(
+        wf.MapTPU_Builder(lambda t: t).build()).add_sink(
+        wf.Sink_Builder(lambda r: None).build())
+    assert "WF601" not in [d.code for d in g2.check()]
+
+
+def test_preflight_wf603_opaque_state_operator(tmp_path):
+    g = wf.PipeGraph("p", config=_durable_cfg(tmp_path))
+    src = (wf.Source_Builder(lambda: iter([{"k": 0, "v": 1}]))
+           .withTimestampExtractor(lambda t: t["v"]).build())
+    win = (wf.Keyed_Windows_Builder(lambda items: len(items))
+           .withTBWindows(10, 10).withKeyBy(lambda t: t["k"]).build())
+    g.add_source(src).add(win).add_sink(
+        wf.Sink_Builder(lambda r: None).build())
+    diags = [d for d in g.check() if d.code == "WF603"]
+    assert diags and diags[0].severity == "warning"
+
+
+# ---------------------------------------------------------------------------
+# kill switch / off-path budget + observability surfaces
+# ---------------------------------------------------------------------------
+
+def test_durability_off_path_budget():
+    """Config.durability unset: no plane, stats section {enabled: False},
+    and the sweep hook is ONE `is None` check (mirrors the health/ledger
+    off-path micro-asserts)."""
+    src = (wf.Source_Builder(lambda: iter(
+        {"k": i, "v": float(i)} for i in range(64)))
+        .withOutputBatchSize(32).build())
+    g = wf.PipeGraph("off")
+    g.add_source(src).add_sink(wf.Sink_Builder(lambda r: None).build())
+    g.run()
+    assert g._durability is None
+    assert g.stats()["Durability"] == {"enabled": False}
+    t0 = time.perf_counter()
+    for _ in range(10_000):
+        if g._durability is not None:    # the sweep hook's whole cost
+            g._durability.on_sweep()
+    per_call = (time.perf_counter() - t0) / 10_000
+    assert per_call < 5e-6, \
+        f"disabled durability check costs {per_call * 1e6:.2f}us/call"
+
+
+def test_stats_openmetrics_and_postmortem_doctor(tmp_path):
+    """The plane's read surfaces: stats()["Durability"], wf_durability_*
+    OpenMetrics families (strict-parser clean), postmortem
+    durability.json rendered + validated by wf_doctor jax-free, and a
+    corrupted section rejected."""
+    from windflow_tpu.monitoring.openmetrics import (parse_exposition,
+                                                     render_openmetrics)
+    cell = chaos.make_cell("window_cb", str(tmp_path / "ck"), n=2048,
+                           epoch_sweeps=2)
+    g = cell["factory"]()
+    g.run()
+    stats = g.stats()
+    sec = stats["Durability"]
+    assert sec["epochs_committed"] >= 1 and sec["restored_epoch"] is None
+    text = render_openmetrics(stats)
+    assert "wf_durability_epochs_committed_total" in text
+    assert "wf_durability_checkpoint_bytes" in text
+    parse_exposition(text)       # strict: raises on format violations
+
+    d = g.dump_postmortem(str(tmp_path / "pm"), reason="test")
+    dur = json.load(open(os.path.join(d, "durability.json")))
+    assert dur["enabled"] and dur["epochs_committed"] >= 1
+    doctor = os.path.join(REPO, "tools", "wf_doctor.py")
+    r = subprocess.run([sys.executable, doctor, "--check", d],
+                       capture_output=True, text=True)
+    assert r.returncode == 0, r.stderr
+    out = subprocess.run([sys.executable, doctor, d],
+                         capture_output=True, text=True)
+    assert "durability:" in out.stdout and "epoch(s) committed" \
+        in out.stdout
+    # corrupt the section: --check must reject
+    dur["epochs_committed"] = -3
+    json.dump(dur, open(os.path.join(d, "durability.json"), "w"))
+    r2 = subprocess.run([sys.executable, doctor, "--check", d],
+                        capture_output=True, text=True)
+    assert r2.returncode == 1 and "epochs_committed" in r2.stderr
+
+
+def test_restored_graph_reports_restore_in_stats(tmp_path):
+    """After a kill+restore, stats()["Durability"] carries the restored
+    epoch and restore_ms, and the OpenMetrics restored gauge flips."""
+    from windflow_tpu.monitoring.openmetrics import render_openmetrics
+    cell = chaos.make_cell("window_cb", str(tmp_path / "ck"), n=4096)
+    g2 = chaos.run_killed_and_restored(
+        cell["factory"], chaos.default_kill("window_cb", "mid_epoch"))
+    sec = g2.stats()["Durability"]
+    assert sec["restored_epoch"] is not None
+    assert sec["restore_ms"] is not None and sec["restore_ms"] >= 0
+    assert 'wf_durability_restored' in render_openmetrics(g2.stats())

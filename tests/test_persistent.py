@@ -82,6 +82,115 @@ def test_kv_python_fallback_reads_native_format(tmp_path):
     back.close(delete_db=True)
 
 
+def _kv_live_map(kv):
+    return {k: kv.get(k) for k in kv.keys()}
+
+
+def _require_native():
+    """Backend parity needs BOTH backends — a self-comparison would pass
+    green without testing the claim, hiding the coverage hole."""
+    from windflow_tpu import native
+    if not native.is_available():
+        pytest.skip("native wf_kv unavailable: backend-parity fuzz "
+                    "needs both KV backends")
+
+
+def _kv_open_both(tmp_path, raw, i):
+    """Open the same byte image under BOTH backends (each gets its own
+    copy: open-time recovery truncates the file in place) and return the
+    two live maps plus the recovered log lengths."""
+    from windflow_tpu.persistent.kv import _NativeKV, _PyKV
+    p_py = str(tmp_path / f"fz_py_{i}")
+    with open(p_py, "wb") as f:
+        f.write(raw)
+    py = _PyKV(p_py)
+    py_map, py_end = _kv_live_map(py), py.log_bytes()
+    py.close(delete_db=True)
+    p_nat = str(tmp_path / f"fz_nat_{i}")
+    with open(p_nat, "wb") as f:
+        f.write(raw)
+    nat = _NativeKV(p_nat)
+    nat_map, nat_end = _kv_live_map(nat), nat.log_bytes()
+    nat.close(delete_db=True)
+    return py_map, py_end, nat_map, nat_end
+
+
+def test_kv_crash_consistency_fuzz_backend_parity(tmp_path):
+    """Crash-consistency fuzz (durability satellite): truncate a written
+    DB at EVERY byte offset — the torn-tail image any mid-append crash
+    can leave — and assert ``_PyKV`` and ``_NativeKV`` recover the SAME
+    live prefix (the backend-parity claim in persistent/kv.py's
+    docstring, previously never cross-tested under torn tails).  The
+    durability plane's manifest-commit protocol rests on exactly this
+    equivalence: an epoch exists iff its manifest record survives
+    recovery, under either backend."""
+    _require_native()
+    from windflow_tpu.persistent.kv import _PyKV
+    path = str(tmp_path / "ref")
+    kv = _PyKV(path)   # deterministic byte image: pure-Python writer
+    kv.put(b"a", b"1")
+    kv.put(b"bb", b"x" * 37)
+    kv.put(b"a", b"2")               # overwrite
+    kv.delete(b"bb")                 # tombstone
+    kv.put(b"ccc", bytes(range(64)))
+    kv.put(b"d" * 9, b"")            # empty value
+    kv.flush()
+    raw = open(path, "rb").read()
+    kv.close(delete_db=True)
+    assert len(raw) < 400            # keeps the every-offset sweep cheap
+    prev_py = None
+    for cut in range(len(raw) + 1):
+        py_map, py_end, nat_map, nat_end = _kv_open_both(
+            tmp_path, raw[:cut], cut)
+        assert py_map == nat_map, (
+            f"backends recover different live sets at cut={cut}: "
+            f"py={sorted(py_map)} native={sorted(nat_map)}")
+        assert py_end == nat_end, (
+            f"backends truncate to different recovery points at "
+            f"cut={cut}: py={py_end} native={nat_end}")
+        assert py_end <= cut          # recovery never invents bytes
+        if prev_py is not None:
+            # live entries only ever grow as more log survives — a
+            # shorter prefix can't know MORE than a longer one, except
+            # where the extra record was an overwrite or tombstone
+            assert len(py_map) >= len(prev_py) - 1
+        prev_py = py_map
+    # full image recovers the reference content under both backends
+    py_map, _, nat_map, _ = _kv_open_both(tmp_path, raw, "full")
+    assert py_map == nat_map == {b"a": b"2",
+                                 b"ccc": bytes(range(64)),
+                                 b"d" * 9: b""}
+
+
+def test_kv_corruption_fuzz_backend_parity(tmp_path):
+    """Flip one byte at every offset of a written DB and assert both
+    backends stop (or survive) at the SAME recovery point with the same
+    live entries — corruption anywhere must never make the two stores
+    diverge about what exists."""
+    _require_native()
+    from windflow_tpu.persistent.kv import _PyKV
+    path = str(tmp_path / "ref")
+    kv = _PyKV(path)
+    kv.put(b"k1", b"alpha")
+    kv.put(b"k2", b"beta" * 8)
+    kv.delete(b"k1")
+    kv.put(b"k3", b"gamma")
+    kv.flush()
+    raw = bytearray(open(path, "rb").read())
+    kv.close(delete_db=True)
+    for off in range(len(raw)):
+        corrupt = bytes(raw[:off]) + bytes([raw[off] ^ 0xFF]) \
+            + bytes(raw[off + 1:])
+        py_map, py_end, nat_map, nat_end = _kv_open_both(
+            tmp_path, corrupt, f"c{off}")
+        assert py_map == nat_map, (
+            f"backends diverge on corruption at offset {off}: "
+            f"py={sorted(py_map)} native={sorted(nat_map)}")
+        assert py_end == nat_end, (
+            f"recovery points diverge on corruption at offset {off}: "
+            f"py={py_end} native={nat_end}")
+
+
 def test_db_handle_typed_keys_and_initial_state(tmp_path):
     db = DBHandle(str(tmp_path / "db"), initial_state=lambda: {"n": 0},
                   delete_db=False)

@@ -55,6 +55,15 @@ GUARDED = (
      "reduce.dense_decl_dispersion.rel_spread"),
     ("latency.batch_p99_ms", False, "dispersion.rel_spread"),
     ("latency.e2e_p99_ms", False, "e2e.dispersion.rel_spread"),
+    # durability plane: snapshot size is deterministic for a fixed
+    # graph/cadence, so a >10% jump is a real regression (a new state
+    # blob grew), not weather.  checkpoint_ms and overhead_pct are
+    # deliberately NOT value-guarded here: both are short wall
+    # measurements (checkpoint_ms includes an fsync; overhead_pct is the
+    # ratio of two single-shot runs) whose infra jitter exceeds the
+    # threshold, and no recorded dispersion describes them — the
+    # overhead's hard budget lives in check_bench_keys instead.
+    ("durability.checkpoint_bytes", False, None),
 )
 
 
@@ -75,6 +84,11 @@ def comparable(cur: dict, prev: dict, path: str) -> bool:
         leg = "e2e_device_source" if path.startswith("e2e_device_source") \
             else "e2e"
         return dig(cur, f"{leg}.tuples") == dig(prev, f"{leg}.tuples")
+    if path.startswith("durability."):
+        # the durability leg sizes via BENCH_DURABILITY_TUPLES: different
+        # stream lengths checkpoint different state — not comparable
+        return dig(cur, "durability.tuples") == dig(prev,
+                                                    "durability.tuples")
     return True
 
 

@@ -41,7 +41,7 @@ SECTIONS = ("stats.json", "events.json", "health.json", "device.json",
 #: sections newer writers add; validated when present, but their absence
 #: must not reject a bundle written before they existed (same schema) —
 #: this tool's job is exactly the historical crash bundle
-OPTIONAL_SECTIONS = ("sweep.json",)
+OPTIONAL_SECTIONS = ("sweep.json", "durability.json")
 
 
 class BundleError(Exception):
@@ -134,6 +134,26 @@ def validate(bundle: dict) -> None:
                 raise BundleError(
                     f"sweep.json: hop {op!r} bytes_per_tuple {bpt!r} is "
                     "not a non-negative number")
+    dur = sections.get("durability.json") or {}
+    if dur.get("enabled") and "error" not in dur:
+        for key in ("epochs_committed", "dedupe_hits", "sink_commits"):
+            v = dur.get(key)
+            if not isinstance(v, int) or v < 0:
+                raise BundleError(
+                    f"durability.json: {key!r} must be a non-negative "
+                    f"integer, got {v!r}")
+        for key in ("last_checkpoint_ms", "restore_ms"):
+            v = dur.get(key)
+            if v is not None and (not isinstance(v, (int, float))
+                                  or v < 0):
+                raise BundleError(
+                    f"durability.json: {key!r} must be a non-negative "
+                    f"number or null, got {v!r}")
+        ep = dur.get("restored_epoch")
+        if ep is not None and not isinstance(ep, int):
+            raise BundleError(
+                f"durability.json: restored_epoch must be an integer "
+                f"or null, got {ep!r}")
 
 
 def diagnose(bundle: dict) -> dict:
@@ -161,9 +181,21 @@ def diagnose(bundle: dict) -> dict:
                    "excess_vs_model": h.get("excess_vs_model")}
     donation_misses = {op: h["donation_miss"] for op, h in hops.items()
                        if h.get("donation_miss")}
+    dur = sections.get("durability.json") or {}
+    durability = None
+    if dur.get("enabled") and "error" not in dur:
+        durability = {
+            "epochs_committed": dur.get("epochs_committed"),
+            "last_checkpoint_ms": dur.get("last_checkpoint_ms"),
+            "checkpoint_bytes_total": dur.get("checkpoint_bytes_total"),
+            "restored_epoch": dur.get("restored_epoch"),
+            "dedupe_hits": dur.get("dedupe_hits"),
+            "dir": dur.get("dir"),
+        }
     return {
         "app": manifest.get("app"),
         "reason": manifest.get("reason"),
+        "durability": durability,
         "written_at_usec": manifest.get("written_at_usec"),
         "graph_state": health.get("graph_state"),
         "stall_events": health.get("stall_events", 0),
@@ -238,6 +270,33 @@ def render_text(d: dict) -> str:
             f"{miss.get('bytes_per_batch')} B/batch "
             f"({miss.get('candidate_leaves')} donatable leaf/leaves "
             "not donated)")
+    if d.get("durability"):
+        du = d["durability"]
+        if not du.get("epochs_committed") \
+                and du.get("restored_epoch") is None:
+            # a crash before the first barrier leaves nothing to rebuild
+            # from — saying "restartable" here would misdirect the
+            # responder straight into restore()'s no-complete-epoch
+            # error.  A restored graph that re-crashed before its first
+            # NEW commit also reports epochs_committed 0, but its
+            # restored_epoch proves the store holds complete epochs —
+            # that case takes the restartable branch below.
+            lines.append(
+                "  durability: enabled but NO complete epoch committed "
+                f"to {du['dir']!r} yet — PipeGraph.restore() has nothing "
+                "to rebuild from; restart the app cold")
+        else:
+            lines.append(
+                f"  durability: {du['epochs_committed']} epoch(s) "
+                f"committed to {du['dir']!r} (last checkpoint "
+                f"{du['last_checkpoint_ms']} ms, "
+                f"{du['checkpoint_bytes_total']} snapshot bytes total); "
+                + (f"restored from epoch {du['restored_epoch']}, "
+                   f"{du['dedupe_hits']} replayed sink message(s) deduped "
+                   "— restart the app with PipeGraph.restore() on this "
+                   "store"
+                   if du["restored_epoch"] is not None else
+                   "restartable with PipeGraph.restore() on this store"))
     if d["section_errors"]:
         lines.append(f"  degraded sections: {d['section_errors']}")
     return "\n".join(lines)

@@ -67,6 +67,7 @@ from windflow_tpu.analysis import (ConcurrencyViolation, Diagnostic,
                                    hot_path)
 from windflow_tpu.analysis.diagnostics import (PreflightError,
                                                PreflightWarning)
+from windflow_tpu.durability import EpochFileSink
 
 __version__ = "0.3.0"  # keep in sync with pyproject.toml
 
@@ -94,4 +95,5 @@ __all__ = [
     "staging", "StagingPool",
     "ConcurrencyViolation", "Diagnostic", "PreflightError",
     "PreflightWarning", "hot_path",
+    "EpochFileSink",
 ]

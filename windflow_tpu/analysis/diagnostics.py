@@ -63,6 +63,13 @@ CODES = {
     "WF502": ("error", "merge joins branches with mixed watermark modes"),
     "WF503": ("warning", "time-based windows fed by a watermark-less "
                          "source fire only at end-of-stream"),
+    # -- durability / checkpoint-restore (WF6xx) -----------------------------
+    "WF601": ("warning", "checkpointing enabled with a source that "
+                         "cannot replay deterministically"),
+    "WF602": ("error", "restore target graph mismatches the checkpoint "
+                       "manifest topology"),
+    "WF603": ("warning", "operator holds cross-batch state the "
+                         "checkpoint cannot capture"),
     # -- hot-path lint (WF7xx, emitted by tools/wf_lint.py) ------------------
     "WF701": ("error", "allocation inside a @hot_path function"),
     "WF702": ("error", "host synchronization inside a @hot_path function"),

@@ -189,7 +189,85 @@ def check_graph(graph) -> List[Diagnostic]:
     _capacity_pass(graph, upstreams, diags)
     _mesh_pass(graph, ops, edges, diags)
     _watermark_pass(graph, ops, upstreams, diags)
+    _durability_pass(graph, ops, diags)
     _kernel_pass(graph, ops, edges, upstreams, diags)
+    return diags
+
+
+def _durability_pass(graph, ops, diags) -> None:
+    """WF6xx: with checkpointing enabled (Config.durability names a
+    directory), warn about graph elements that undermine the restore
+    contract — sources whose replay is not deterministic (WF601: a
+    generator restarts from scratch; an INGRESS device source re-stamps
+    wall-clock time) and operators whose cross-batch state the plane
+    cannot snapshot yet (WF603: host window engines, persistent-DB
+    suites).  docs/DURABILITY.md spells out the contract each warning
+    points at."""
+    if not getattr(graph.config, "durability", ""):
+        return
+    from windflow_tpu.io.device_source import DeviceSource
+    from windflow_tpu.kafka.kafka_source import KafkaSource
+    from windflow_tpu.ops.source import Source
+    for op in ops:
+        if isinstance(op, Source):
+            if isinstance(op, KafkaSource):
+                continue    # offset-addressed: the replayable case
+            if isinstance(op, DeviceSource) and op.ts_fn is not None:
+                continue    # EVENT-time device source: pure fn of the
+                #             batch index, replays bit-identically
+            diags.append(Diagnostic(
+                "WF601",
+                f"source '{op.name}' cannot replay deterministically "
+                "after a restore (no offsets to seek, "
+                "wall-clock/ingress timestamps re-stamp on replay) — "
+                "restored runs will diverge from the checkpointed "
+                "stream position",
+                node=op.name,
+                hint="feed checkpointed graphs from a Kafka source or "
+                     "an EVENT-time DeviceSource (withTimestampFn / "
+                     "withTimestampBounds)"))
+        elif op.checkpoint_opaque:
+            diags.append(Diagnostic(
+                "WF603",
+                f"operator '{op.name}' ({type(op).__name__}) holds "
+                "cross-batch state the checkpoint cannot capture — a "
+                "restore silently resets it",
+                node=op.name,
+                hint="use the TPU window/stateful operators "
+                     "(FfatWindowsTPU, StatefulMapTPU, Reduce) for "
+                     "checkpointed graphs"))
+
+
+def manifest_conflicts(graph, manifest) -> List[Diagnostic]:
+    """WF602: named diff between a composed (possibly unbuilt) graph and
+    a checkpoint manifest's topology signature — the gate
+    ``PipeGraph.restore()`` runs before touching any state.  Empty list
+    means the restore may proceed."""
+    from windflow_tpu.durability.checkpoint import topology_signature
+    diags: List[Diagnostic] = []
+    want = manifest.get("topology") or []
+    have = topology_signature(graph._topo_operators())
+    if len(want) != len(have):
+        diags.append(Diagnostic(
+            "WF602",
+            f"checkpoint has {len(want)} operator(s), graph has "
+            f"{len(have)} — "
+            f"checkpoint: {[w['name'] for w in want]}, "
+            f"graph: {[h['name'] for h in have]}"))
+        return diags
+    for i, (w, h) in enumerate(zip(want, have)):
+        for field in ("name", "type", "parallelism", "routing",
+                      "is_tpu", "record_spec"):
+            if w.get(field) != h.get(field):
+                diags.append(Diagnostic(
+                    "WF602",
+                    f"operator #{i} {field} differs: checkpoint has "
+                    f"{w.get(field)!r} ('{w.get('name')}'), graph has "
+                    f"{h.get(field)!r} ('{h.get('name')}')",
+                    node=h.get("name"),
+                    hint="restore needs the same composition that wrote "
+                         "the checkpoint (names, types, parallelism, "
+                         "record specs)"))
     return diags
 
 

@@ -259,6 +259,30 @@ class Config:
     # Kill switch: WF_TPU_FUSE=0 restores one-dispatch-per-hop sweeps.
     whole_chain_fusion: bool = bool(int(os.environ.get("WF_TPU_FUSE",
                                                        "1")))
+    # Durable state (windflow_tpu/durability, docs/DURABILITY.md): the
+    # directory holding the graph's epoch-versioned checkpoint store.
+    # Non-empty enables watermark-aligned checkpointing — at every
+    # `durability_epoch_sweeps`-th scheduler sweep the driver quiesces the
+    # graph (flush + drain to an aligned barrier), commits exactly-once
+    # sink epochs (fenced Kafka commit / atomic file rename), snapshots
+    # all operator state (FFAT rings, stateful tables, reduce states,
+    # Kafka offsets, watermark frontiers) into the persistent LogKV, and
+    # writes the epoch manifest as the commit point.  A stopped/crashed
+    # graph rebuilds at the last complete epoch via PipeGraph.restore().
+    # "" (the default) is the kill switch: the plane is never built and
+    # the sweep loop keeps exactly one `is None` check (micro-asserted by
+    # tests/test_durability.py, same stance as the health/ledger planes).
+    durability: str = os.environ.get("WF_TPU_DURABILITY", "")
+    # Checkpoint cadence in scheduler sweeps.  Sweep-counted (not
+    # wall-clock) so two runs of the same graph over the same data place
+    # their barriers at the same stream positions — what makes the chaos
+    # harness's record-for-record A/B diff meaningful.
+    durability_epoch_sweeps: int = int(os.environ.get(
+        "WF_TPU_DURABILITY_EPOCH_SWEEPS", "64"))
+    # Complete epochs retained in the checkpoint store; older epochs are
+    # tombstoned (LogKV auto-compaction reclaims the log space).
+    durability_keep: int = int(os.environ.get(
+        "WF_TPU_DURABILITY_KEEP", "2"))
     # Multi-chip execution: a jax.sharding.Mesh with ("data", "key") axes
     # (see windflow_tpu.parallel.mesh.make_mesh).  When set, staging emitters
     # lay batches out data-sharded across the mesh and mesh-aware TPU

@@ -1,0 +1,29 @@
+"""Durable state: watermark-aligned checkpoint/restore + exactly-once
+sinks (docs/DURABILITY.md).
+
+The reference persists keyed operator state through RocksDB-backed
+builders (``/root/reference/wf/persistent/builders_rocksdb.hpp``); this
+package closes the other half of that story for the TPU reproduction:
+not just *persisting* state but **restoring a whole running graph** —
+FFAT pane rings, stateful slot tables, reduce states, Kafka source
+offsets, per-replica watermark frontiers — at the last complete epoch,
+with sinks that neither lose nor duplicate a record across the restart.
+
+* :mod:`windflow_tpu.durability.checkpoint` — the
+  :class:`DurabilityPlane` (epoch barriers, LogKV-backed snapshot store,
+  manifest commit protocol) and ``restore_graph`` behind
+  ``PipeGraph.restore()``.
+* :mod:`windflow_tpu.durability.sinks` — :class:`EpochFileSink`, the
+  stage-then-atomic-rename exactly-once file sink.
+* :mod:`windflow_tpu.durability.chaos` — the failure-injection harness
+  (seeded kills, restore, record-for-record A/B diff) driven by
+  ``tools/wf_chaos.py`` and ``tests/test_durability.py``.
+"""
+
+from windflow_tpu.durability.checkpoint import (CHECKPOINT_SCHEMA,
+                                                DurabilityPlane,
+                                                restore_graph)
+from windflow_tpu.durability.sinks import EpochFileSink
+
+__all__ = ["CHECKPOINT_SCHEMA", "DurabilityPlane", "restore_graph",
+           "EpochFileSink"]

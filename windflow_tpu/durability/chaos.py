@@ -1,0 +1,401 @@
+"""Failure-injection harness: seeded kills, restore, record-for-record
+A/B diff (the test family ROADMAP item 5 names; driven by
+``tools/wf_chaos.py`` and ``tests/test_durability.py``).
+
+The experiment, per (graph family, kill point, fusion on/off) cell:
+
+1. **Baseline** — run the factory's graph uninterrupted (durability ON,
+   same epoch cadence) and read the sunk output.
+2. **Chaos** — run an identical graph (own broker/output/checkpoint
+   store), kill it at the seeded point, ``PipeGraph.restore()`` a fresh
+   instance from the last complete epoch, drive it to completion, read
+   the sunk output.
+3. **Verdict** — the two outputs must match record for record: no loss,
+   no duplicates, no reordering within a partition.
+
+Kill points:
+
+* ``mid_epoch`` — raise :class:`ChaosKill` on the N-th driver sweep
+  (between checkpoints: operator state is mid-stream, sinks hold
+  uncommitted buffered output).
+* ``mid_window`` — raise after the N-th batch processed by a named
+  operator (a window/stateful replica dies with panes half-filled).
+* ``mid_sink_flush`` — raise inside checkpoint K, between the sink
+  epoch commit and the manifest write: the torn two-phase window where
+  output is published but the epoch never committed — exactly the case
+  the sink fence dedupes.
+
+Kills are simulated in-process (the exception rides the driver loop's
+crash path, postmortem and all); the broker, checkpoint store, and sink
+files survive as the "external world" a real restart would see.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, List, Optional
+
+from windflow_tpu.basic import WindFlowError
+
+KILL_POINTS = ("mid_epoch", "mid_window", "mid_sink_flush")
+
+
+class ChaosKill(RuntimeError):
+    """The injected failure.  RuntimeError so the driver's crash path
+    (salvage telemetry, postmortem, finalize) treats it like any crash."""
+
+
+@dataclasses.dataclass
+class KillSpec:
+    """One seeded kill.  ``after`` counts events at the kill point
+    (sweeps, batches, or checkpoints); ``op_name`` names the victim
+    operator for ``mid_window``."""
+
+    point: str
+    after: int = 3
+    op_name: Optional[str] = None
+
+    def __post_init__(self) -> None:
+        if self.point not in KILL_POINTS:
+            raise WindFlowError(
+                f"unknown kill point '{self.point}' (one of {KILL_POINTS})")
+        if self.point == "mid_window" and not self.op_name:
+            raise WindFlowError("mid_window kills need op_name")
+
+
+def arm(graph, spec: KillSpec) -> None:
+    """Install the kill on a STARTED graph (replicas and the durability
+    plane exist).  Test-only instrumentation: nothing here touches the
+    production hot path — the plane hooks run at checkpoint cadence and
+    the mid_window wrapper exists only on armed graphs."""
+    plane = graph._durability
+    if plane is None:
+        raise WindFlowError("chaos needs Config.durability enabled")
+    count = {"n": 0}
+    if spec.point == "mid_epoch":
+        def hook(site):
+            if site == "sweep":
+                count["n"] += 1
+                if count["n"] == spec.after:
+                    raise ChaosKill(f"mid_epoch kill at sweep {count['n']}")
+        plane.chaos_hook = hook
+    elif spec.point == "mid_sink_flush":
+        def hook(site):
+            if site == "post_sink_commit":
+                count["n"] += 1
+                if count["n"] == spec.after:
+                    raise ChaosKill(
+                        f"mid_sink_flush kill: checkpoint {count['n']} "
+                        "died after the sink commit, before the manifest")
+        plane.chaos_hook = hook
+    else:  # mid_window
+        victims = [op for op in graph._operators
+                   if op.name == spec.op_name]
+        if not victims:
+            raise WindFlowError(
+                f"mid_window kill: no operator named '{spec.op_name}'")
+        for op in victims:
+            for rep in op.replicas:
+                _wrap_replica(rep, count, spec.after)
+
+
+def _wrap_replica(rep, count: dict, after: int) -> None:
+    orig_dev = rep.process_device_batch
+    orig_single = rep.process_single
+
+    def _maybe_kill():
+        count["n"] += 1
+        if count["n"] == after:
+            raise ChaosKill(
+                f"mid_window kill: replica {rep.op.name}[{rep.index}] "
+                f"died processing batch {count['n']}")
+
+    def dev(batch):
+        _maybe_kill()
+        return orig_dev(batch)
+
+    def single(item, ts, wm):
+        _maybe_kill()
+        return orig_single(item, ts, wm)
+
+    rep.process_device_batch = dev
+    rep.process_single = single
+
+
+def abandon(graph) -> None:
+    """Post-kill teardown of the dead graph's external handles: Kafka
+    consumers leave their group (a real crash gets this from the broker
+    session timeout; in-process ghosts would keep partitions assigned
+    and starve the restored run), producers close.  The checkpoint
+    store was already flushed+closed by the crash path's finalize."""
+    for sr in graph._source_replicas:
+        c = getattr(sr, "_consumer", None)
+        if c is not None:
+            try:
+                c.close()
+            except Exception:  # lint: broad-except-ok (abandon runs in
+                # test teardown after a simulated crash; a half-dead
+                # client must not mask the experiment's verdict)
+                pass
+    for op in graph._operators:
+        if op.is_terminal:
+            for rep in op.replicas:
+                p = getattr(rep, "_producer", None)
+                if p is not None:
+                    try:
+                        p.close()
+                    except Exception:  # lint: broad-except-ok (same
+                        # teardown stance as the consumer close above)
+                        pass
+
+
+def run_killed_and_restored(factory: Callable[[], object],
+                            spec: KillSpec):
+    """Start the factory's graph, arm the kill, drive to the crash,
+    restore a fresh instance from the checkpoint store, and drive it to
+    completion.  Returns the completed (restored) graph.  Raises if the
+    kill never fired — a chaos cell that does not kill proves nothing."""
+    g = factory()
+    g.start()
+    arm(g, spec)
+    killed = False
+    try:
+        g.wait_end()
+    except ChaosKill:
+        killed = True
+        abandon(g)
+    if not killed:
+        raise WindFlowError(
+            f"chaos kill {spec} never fired — the run completed; "
+            "lower `after` or feed more data")
+    g2 = factory()
+    g2.restore(g2.config.durability)
+    g2.wait_end()
+    return g2
+
+
+def run_baseline(factory: Callable[[], object]):
+    """The uninterrupted control run (same durability config)."""
+    g = factory()
+    g.run()
+    return g
+
+
+# ---------------------------------------------------------------------------
+# output readers / diff
+# ---------------------------------------------------------------------------
+
+def read_topic(broker, topic: str) -> List[list]:
+    """Committed values per partition, in offset order — the unit of
+    Kafka's ordering guarantee, so the A/B diff compares per-partition
+    sequences, never a cross-partition interleaving."""
+    with broker._lock:
+        parts = broker._topics.get(topic, [])
+        return [[m.value for m in p.log] for p in parts]
+
+
+def diff_records(baseline, chaos) -> Optional[str]:
+    """None when the two outputs match record for record; otherwise the
+    first divergence, rendered for a test failure message."""
+    if baseline == chaos:
+        return None
+    if isinstance(baseline, list) and isinstance(chaos, list) \
+            and len(baseline) == len(chaos):
+        for i, (a, b) in enumerate(zip(baseline, chaos)):
+            if a != b:
+                if isinstance(a, list) and isinstance(b, list):
+                    return _diff_seq(f"partition {i}", a, b)
+                return f"record {i}: baseline={a!r} chaos={b!r}"
+    if isinstance(baseline, list) and isinstance(chaos, list):
+        return _diff_seq("output", baseline, chaos)
+    return f"outputs differ: baseline={baseline!r} chaos={chaos!r}"
+
+
+def _diff_seq(what: str, a: list, b: list) -> str:
+    n = min(len(a), len(b))
+    for i in range(n):
+        if a[i] != b[i]:
+            return (f"{what}: first divergence at index {i}: "
+                    f"baseline={a[i]!r} chaos={b[i]!r} "
+                    f"(lengths {len(a)} vs {len(b)})")
+    if len(a) != len(b):
+        kind = "loss" if len(b) < len(a) else "duplication"
+        extra = (a if len(a) > len(b) else b)[n:n + 3]
+        return (f"{what}: {kind} — baseline has {len(a)} records, chaos "
+                f"{len(b)}; first extra/missing: {extra!r}")
+    return f"{what}: sequences differ"
+
+
+# ---------------------------------------------------------------------------
+# standard graph families (tools/wf_chaos.py + tests/test_durability.py)
+# ---------------------------------------------------------------------------
+
+FAMILIES = ("window_cb", "window_tb", "reduce", "stateful",
+            "stateless_chain")
+
+#: per-family mid_window kill counts that land after the first
+#: checkpoint and before completion at the default cell size (device
+#: replicas count batches; the host reduce counts records)
+MID_WINDOW_AFTER = {"window_cb": 12, "window_tb": 12, "stateful": 12,
+                    "stateless_chain": 12, "reduce": 3000}
+
+#: the operator a mid_window kill targets, per family
+VICTIM = {"window_cb": "w", "window_tb": "w", "stateful": "st",
+          "stateless_chain": "f", "reduce": "red"}
+
+
+def make_cell(family: str, ckpt_dir: str, *, fusion: bool = True,
+              out_dir: Optional[str] = None, n: int = 4096,
+              keys: int = 8, app: str = "chaos",
+              epoch_sweeps: int = 3) -> dict:
+    """One isolated chaos cell: its own in-memory broker pre-filled with
+    a deterministic event-time stream, a graph factory (re-invocable:
+    the chaos path builds the graph twice), and an output reader.
+    Returns ``{"factory", "read", "broker"}``.
+
+    Determinism contract (docs/DURABILITY.md): EVENT-time records,
+    interval punctuation pushed out of reach, sweep-counted epoch
+    cadence — so the baseline run, the killed run, and the replay all
+    stage identical batches in identical order, which is what makes the
+    record-for-record diff (and the sink fence's seq-dedupe) exact."""
+    import dataclasses as _dc
+
+    import windflow_tpu as wf
+    from windflow_tpu.kafka.client import InMemoryBroker
+    from windflow_tpu.kafka.kafka_sink import KafkaSink, KafkaSinkMessage
+    from windflow_tpu.kafka.kafka_source import KafkaSource
+    if family not in FAMILIES:
+        raise WindFlowError(
+            f"unknown chaos family '{family}' (one of {FAMILIES})")
+    broker = InMemoryBroker()
+    broker.create_topic("in", 1)
+    p = broker.producer()
+    for i in range(n):
+        p.produce("in", {"key": i % keys, "value": float(i % 97)},
+                  timestamp_usec=1_000 + i * 7)
+    p.produce("in", "EOS", timestamp_usec=1_000 + n * 7)
+
+    def deser(msg, shipper):
+        if msg is None:
+            return True
+        if msg.value == "EOS":
+            return False
+        shipper.pushWithTimestamp(dict(msg.value), msg.timestamp_usec)
+        return True
+
+    file_sink = None
+    if family == "stateless_chain":
+        if out_dir is None:
+            raise WindFlowError("stateless_chain needs out_dir")
+        from windflow_tpu.durability.sinks import EpochFileSink
+        file_sink = EpochFileSink(out_dir)
+
+    def factory():
+        cfg = _dc.replace(wf.default_config)
+        cfg.durability = ckpt_dir
+        cfg.durability_epoch_sweeps = epoch_sweeps
+        cfg.whole_chain_fusion = fusion
+        # determinism: interval punctuation reads the wall clock, which
+        # would move batch boundaries between runs
+        cfg.punctuation_interval_usec = 10 ** 12
+        cfg.health_postmortem_on_crash = False
+        src = KafkaSource(deser, broker, ["in"], group_id="chaos",
+                          name="ksrc", output_batch_size=256)
+        g = wf.PipeGraph(app, config=cfg)
+        pipe = g.add_source(src)
+        ser = (lambda r: KafkaSinkMessage(
+            "out", tuple(sorted((k, round(float(v), 6))
+                                for k, v in r.items()))))
+        if family in ("window_cb", "window_tb"):
+            pipe.add(wf.MapTPU_Builder(
+                lambda t: {"key": t["key"], "value": t["value"] * 2.0})
+                .withName("m").build())
+            wb = wf.Ffat_WindowsTPU_Builder(lambda t: t["value"],
+                                            lambda a, b: a + b)
+            wb = (wb.withCBWindows(16, 8) if family == "window_cb"
+                  else wb.withTBWindows(70, 35))
+            pipe.add(wb.withKeyBy(lambda t: t["key"])
+                     .withMaxKeys(keys).withName("w").build())
+            pipe.add_sink(KafkaSink(ser, broker, name="ksnk"))
+        elif family == "stateful":
+            pipe.add(wf.MapTPU_Builder(
+                lambda t: {"key": t["key"], "value": t["value"] + 1.0})
+                .withName("m").build())
+
+            def st_fn(t, s):
+                ns = {"n": s["n"] + 1, "s": s["s"] + t["value"]}
+                return ({"key": t["key"], "value": t["value"],
+                         "n": ns["n"], "s": ns["s"]}, ns)
+
+            pipe.add(wf.MapTPU_Builder(st_fn)
+                     .withInitialState({"n": 0, "s": 0.0})
+                     .withKeyBy(lambda t: t["key"])
+                     .withNumKeySlots(keys).withDenseKeys()
+                     .withName("st").build())
+            pipe.add_sink(KafkaSink(ser, broker, name="ksnk"))
+        elif family == "reduce":
+            def red_fn(item, state):
+                state["key"] = item["key"]
+                state["n"] = state.get("n", 0) + 1
+                state["s"] = round(state.get("s", 0.0) + item["value"], 6)
+
+            pipe.add(wf.Reduce_Builder(red_fn, dict)
+                     .withKeyBy(lambda t: t["key"])
+                     .withName("red").build())
+            pipe.add_sink(KafkaSink(ser, broker, name="ksnk"))
+        else:  # stateless_chain -> exactly-once epoch file sink
+            pipe.add(wf.MapTPU_Builder(
+                lambda t: {"key": t["key"], "value": t["value"] * 3.0})
+                .withName("m").build())
+            pipe.add(wf.FilterTPU_Builder(lambda t: (t["key"] & 1) == 0)
+                     .withName("f").build())
+            pipe.add_sink(wf.Sink_Builder(file_sink).withName("fsink")
+                          .build())
+        return g
+
+    if family == "stateless_chain":
+        from windflow_tpu.durability.sinks import EpochFileSink as _EFS
+
+        def read():
+            return _EFS.read_committed(out_dir)
+    else:
+        def read():
+            return read_topic(broker, "out")
+
+    return {"factory": factory, "read": read, "broker": broker}
+
+
+def default_kill(family: str, point: str) -> KillSpec:
+    """The seeded kill each (family, point) cell uses by default."""
+    if point == "mid_window":
+        return KillSpec(point, after=MID_WINDOW_AFTER[family],
+                        op_name=VICTIM[family])
+    if point == "mid_sink_flush":
+        return KillSpec(point, after=2)
+    return KillSpec(point, after=6)
+
+
+def run_ab(factory_baseline: Callable[[], object],
+           factory_chaos: Callable[[], object],
+           spec: KillSpec,
+           read_baseline: Callable[[], object],
+           read_chaos: Callable[[], object]) -> dict:
+    """One chaos cell end to end.  The two factories must build
+    IDENTICAL graphs over identical input but isolated externals (own
+    broker/topic/checkpoint dir/output dir — and distinct consumer
+    groups if they do share a broker).  Returns the verdict dict
+    ``tools/wf_chaos.py`` renders; ``diff`` is None on exactly-once."""
+    gb = run_baseline(factory_baseline)
+    gc = run_killed_and_restored(factory_chaos, spec)
+    base_out, chaos_out = read_baseline(), read_chaos()
+    dur = gc.stats()["Durability"]
+    return {
+        "kill": dataclasses.asdict(spec),
+        "diff": diff_records(base_out, chaos_out),
+        "records": sum(len(p) for p in base_out)
+        if base_out and isinstance(base_out[0], list) else len(base_out),
+        "restored_epoch": dur.get("restored_epoch"),
+        "epochs_committed_baseline":
+            gb.stats()["Durability"].get("epochs_committed"),
+        "dedupe_hits": dur.get("dedupe_hits"),
+    }

@@ -106,6 +106,14 @@ class PipeGraph:
         # ledger's fusion section, and stats attribution; empty means
         # every hop dispatches its own program (the pre-fusion sweep).
         self._fused_segments = []
+        # durability plane (windflow_tpu/durability): epoch checkpoints +
+        # restore, built in _build when Config.durability names a
+        # directory; None leaves one `is None` check per sweep (the
+        # documented off-path, micro-asserted like health/ledger)
+        self._durability = None
+        # checkpoint blobs stashed by restore() for the plane to apply
+        # after _build (operator state) and before the first source tick
+        self._pending_restore = None
         # last postmortem bundle written (crash path or dump_postmortem);
         # the lock serializes writers — the monitor thread's watchdog
         # auto-bundle and the driver's stall/crash path may race into
@@ -197,18 +205,28 @@ class PipeGraph:
                 edges.append(("op", src, merged.operators[0]))
         return edges
 
-    def _build(self) -> None:
-        # 1. instantiate replicas
-        seen = set()
+    def _topo_operators(self):
+        """Every distinct operator in _build's enumeration order — the
+        ordinal space checkpoint manifests pin, factored out so restore
+        can validate a composed-but-unbuilt graph against a manifest
+        (durability/checkpoint.topology_signature) without the two
+        traversals ever diverging."""
+        seen, out = set(), []
         for mp in self._all_pipes():
             for op in mp.operators:
                 if id(op) not in seen:
                     seen.add(id(op))
-                    op.ordinal = len(self._operators)  # stable topo index
-                    self._operators.append(op)
-                    op.mesh = self.config.mesh
-                    op.config = self.config
-                    op.build_replicas(self.mode, self.time_policy)
+                    out.append(op)
+        return out
+
+    def _build(self) -> None:
+        # 1. instantiate replicas
+        for op in self._topo_operators():
+            op.ordinal = len(self._operators)  # stable topo index
+            self._operators.append(op)
+            op.mesh = self.config.mesh
+            op.config = self.config
+            op.build_replicas(self.mode, self.time_policy)
         for op in self._operators:
             self._all_replicas.extend(op.replicas)
             if isinstance(op, Source):
@@ -372,6 +390,15 @@ class PipeGraph:
         if cfg.health_watchdog:
             from windflow_tpu.monitoring.health import HealthPlane
             self._health = HealthPlane(self)
+
+        # 3d'. durability plane (windflow_tpu/durability): built after
+        # replicas exist so it can switch Kafka sink replicas to fenced
+        # exactly-once buffering; checkpoints run at sweep cadence from
+        # step(), restore state is applied by start() before the first
+        # source tick
+        if cfg.durability:
+            from windflow_tpu.durability.checkpoint import DurabilityPlane
+            self._durability = DurabilityPlane(self)
 
         # 3d. sweep ledger (monitoring/sweep_ledger.py): built AFTER the
         # operator list is final and BEFORE any batch runs, so its
@@ -550,6 +577,12 @@ class PipeGraph:
         self._run_preflight()
         self._started = True
         self._build()
+        if self._durability is not None and self._pending_restore is not None:
+            # restore(): apply the checkpointed operator/replica state
+            # now — replicas and fusion preludes exist, no source has
+            # ticked, the monitor has not sampled
+            pending, self._pending_restore = self._pending_restore, None
+            self._durability.apply_restore(pending)
         try:
             if self.config.tracing_enabled:
                 # reference: tracing spawns a MonitoringThread at run()
@@ -632,6 +665,12 @@ class PipeGraph:
             for sr in self._source_replicas:
                 if not sr.exhausted and sr.tick(self._tick_chunk(sr)):
                     progress = True
+        if self._durability is not None:
+            # epoch cadence (windflow_tpu/durability): counts sweeps and,
+            # every Config.durability_epoch_sweeps-th, quiesces to the
+            # aligned barrier and commits a checkpoint epoch.  Off-path
+            # cost is exactly this one check (micro-asserted).
+            self._durability.on_sweep()
         return progress
 
     def _tick_chunk(self, sr) -> int:
@@ -664,7 +703,24 @@ class PipeGraph:
     def is_done(self) -> bool:
         return all(r.done for r in self._all_replicas)
 
+    def restore(self, checkpoint_dir: Optional[str] = None) -> "PipeGraph":
+        """Rebuild this composed-but-unstarted graph at the last complete
+        checkpoint epoch (windflow_tpu/durability, docs/DURABILITY.md):
+        validates the manifest's topology signature against the graph
+        (WF602 named diff on mismatch), restores every operator's state
+        — FFAT pane rings, stateful slot tables, reduce states — plus
+        per-replica watermark frontiers, seeks Kafka sources back to the
+        checkpointed offsets, and re-fences exactly-once sinks so the
+        replay neither loses nor duplicates a record.  Returns the graph
+        STARTED; drive it with :meth:`wait_end` (or :meth:`step`)."""
+        from windflow_tpu.durability.checkpoint import restore_graph
+        return restore_graph(self, checkpoint_dir)
+
     def _finalize(self, dump: bool = True, aborted: bool = False) -> None:
+        if self._durability is not None:
+            # flush + close the checkpoint store (counters stay readable:
+            # stats() reads the cached section fields, not the KV)
+            self._durability.close()
         if self._pool is not None:
             self._pool.shutdown(wait=True)
             self._pool = None
@@ -718,6 +774,19 @@ class PipeGraph:
         except Exception as e:  # lint: broad-except-ok (same stance as
             # the device section: a watchdog read must never take the
             # pipeline or a stats dump down)
+            return {"enabled": True, "error": f"{type(e).__name__}: "
+                                              f"{e}"[:200]}
+
+    def _durability_section(self) -> dict:
+        """Guarded like the health/device/sweep sections; with
+        ``Config.durability`` unset this is the whole cost: one check."""
+        if self._durability is None:
+            return {"enabled": False}
+        try:
+            return self._durability.section()
+        except Exception as e:  # lint: broad-except-ok (a checkpoint
+            # telemetry read must never take the pipeline or a stats
+            # dump down — same stance as every other plane section)
             return {"enabled": True, "error": f"{type(e).__name__}: "
                                               f"{e}"[:200]}
 
@@ -947,6 +1016,10 @@ class PipeGraph:
             # misses, hop-boundary residency — the attribution layer the
             # fusion advisor (tools/wf_advisor.py) plans against
             "Sweep": self._sweep_section(),
+            # durability plane (windflow_tpu/durability): epochs
+            # committed, checkpoint/restore wall cost + bytes, sink
+            # fence dedupe hits — docs/DURABILITY.md
+            "Durability": self._durability_section(),
             "Operators": [op.dump_stats() for op in self._operators],
         }
 
@@ -1036,6 +1109,7 @@ class PipeGraph:
             return {"jit": reg.snapshot(), "totals": reg.totals()}
         write("jit.json", jit_tables)
         write("sweep.json", self._sweep_section)
+        write("durability.json", self._durability_section)
         write("preflight.json", lambda: {
             "mode": getattr(self.config, "preflight", "error"),
             "check_ms": self._preflight_ms,

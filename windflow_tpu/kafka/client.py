@@ -53,6 +53,17 @@ class ConsumerClient:
         cannot know (the source then uses wall-clock idleness)."""
         return None
 
+    def positions(self):
+        """Next-poll offset per assigned (topic, partition) — what a
+        durability checkpoint records so restore resumes exactly where
+        the barrier drained to — or None when the client cannot tell."""
+        return None
+
+    def seek_positions(self, positions) -> None:
+        """Rewind/advance the consumer to explicit per-partition
+        offsets (restore path).  Default: unsupported, ignored — the
+        source then falls back to the coarser per-topic start offsets."""
+
     def subscribe(self, topics: Sequence[str], group_id: str,
                   offsets: Optional[Sequence[int]] = None) -> None:
         raise NotImplementedError
@@ -106,6 +117,13 @@ class InMemoryBroker:
         self._groups: Dict[str, "_Group"] = {}
         self._lock = threading.Lock()
         self._rr = itertools.count()
+        # exactly-once sink fences (windflow_tpu/durability): fence_id ->
+        # (epoch, seq) of the LAST message committed through
+        # fenced_commit.  The in-process stand-in for Kafka transactions:
+        # commit + fence advance are atomic under the broker lock, so a
+        # kill can never half-publish an epoch, and a replayed commit
+        # dedupes on the producer-lifetime sequence number.
+        self._fences: Dict[str, Tuple[int, int]] = {}
 
     # -- admin ---------------------------------------------------------------
     def create_topic(self, name: str, num_partitions: int = 1) -> None:
@@ -142,28 +160,62 @@ class InMemoryBroker:
     def _append(self, topic: str, value: Any, key: Optional[bytes],
                 partition: Optional[int], ts: Optional[int]) -> None:
         with self._lock:
-            parts = self._topics.get(topic)
-            if parts is None:
-                parts = self._topics[topic] = [_Partition()]
-                self._rebalance_subscribers(topic)
-            if partition is None:
-                if key is not None:
-                    # deterministic placement: Python's hash() is salted
-                    # per process, which would scatter one key across
-                    # partitions between producer processes (Kafka uses
-                    # murmur2 for the same reason); stable_hash is crc32
-                    # for bytes
-                    partition = stable_hash(key) % len(parts)
-                else:
-                    partition = next(self._rr) % len(parts)
-            if not 0 <= partition < len(parts):
-                raise WindFlowError(
-                    f"partition {partition} out of range for '{topic}'")
-            p = parts[partition]
-            p.log.append(KafkaMessage(
-                topic=topic, partition=partition, offset=len(p.log), key=key,
-                value=value,
-                timestamp_usec=ts if ts is not None else current_time_usecs()))
+            self._append_locked(topic, value, key, partition, ts)
+
+    def _append_locked(self, topic: str, value: Any, key: Optional[bytes],
+                       partition: Optional[int], ts: Optional[int]) -> None:
+        parts = self._topics.get(topic)
+        if parts is None:
+            parts = self._topics[topic] = [_Partition()]
+            self._rebalance_subscribers(topic)
+        if partition is None:
+            if key is not None:
+                # deterministic placement: Python's hash() is salted
+                # per process, which would scatter one key across
+                # partitions between producer processes (Kafka uses
+                # murmur2 for the same reason); stable_hash is crc32
+                # for bytes
+                partition = stable_hash(key) % len(parts)
+            else:
+                partition = next(self._rr) % len(parts)
+        if not 0 <= partition < len(parts):
+            raise WindFlowError(
+                f"partition {partition} out of range for '{topic}'")
+        p = parts[partition]
+        p.log.append(KafkaMessage(
+            topic=topic, partition=partition, offset=len(p.log), key=key,
+            value=value,
+            timestamp_usec=ts if ts is not None else current_time_usecs()))
+
+    # -- exactly-once sink fence (windflow_tpu/durability) -------------------
+    def fenced_commit(self, fence_id: str, epoch: int, msgs) -> Tuple[int,
+                                                                      int]:
+        """Atomically publish an epoch's buffered sink messages, deduping
+        on the producer-lifetime sequence number: ``msgs`` is a list of
+        ``(seq, topic, value, key, partition, ts)`` with ``seq`` strictly
+        increasing across the replica's whole lifetime (checkpoint state
+        restores it, so a replayed epoch regenerates the SAME seqs).
+        Messages at/below the fence were already committed by the run
+        that crashed after its commit — they are skipped, which is the
+        whole exactly-once story for the mid-sink-flush kill window.
+        Returns ``(appended, deduped)``."""
+        with self._lock:
+            _, fseq = self._fences.get(fence_id, (-1, -1))
+            appended = deduped = 0
+            for seq, topic, value, key, partition, ts in msgs:
+                if seq <= fseq:
+                    deduped += 1
+                    continue
+                self._append_locked(topic, value, key, partition, ts)
+                self._fences[fence_id] = (epoch, seq)
+                fseq = seq
+                appended += 1
+            return appended, deduped
+
+    def fence(self, fence_id: str):
+        """Last committed (epoch, seq) for a sink fence, or None."""
+        with self._lock:
+            return self._fences.get(fence_id)
 
     # -- clients -------------------------------------------------------------
     def producer(self) -> "InMemoryProducer":
@@ -202,6 +254,17 @@ class InMemoryProducer(ProducerClient):
                 timestamp_usec=None):
         self._broker._append(topic, value, key, partition, timestamp_usec)
         self.produced += 1
+
+    def fenced_commit(self, fence_id: str, epoch: int, msgs):
+        """Exactly-once epoch commit (windflow_tpu/durability): the
+        broker appends + fence-advances atomically.  Real-client
+        producers have no fence — the sink detects the missing attribute
+        and degrades to flush-per-epoch (at-least-once, documented in
+        docs/DURABILITY.md limits)."""
+        appended, deduped = self._broker.fenced_commit(fence_id, epoch,
+                                                       msgs)
+        self.produced += appended
+        return appended, deduped
 
     def flush(self) -> None:
         pass  # appends are synchronous
@@ -257,6 +320,22 @@ class InMemoryConsumer(ConsumerClient):
                     out.extend(log[pos:pos + take])
                     self._group.positions[tp] = pos + take
         return out
+
+    def positions(self):
+        """Next-poll offset per assigned partition (group-held read
+        positions) — the durability checkpoint's replay cursor."""
+        with self._broker._lock:
+            return {tp: self._group.positions.get(tp, 0)
+                    for tp in self._assignment}
+
+    def seek_positions(self, positions) -> None:
+        """Restore path: rewind the GROUP's read positions to the
+        checkpointed offsets.  Group-level on purpose — whichever
+        replica a partition lands on after the restart resumes at the
+        barrier's cursor, exactly as committed offsets behave on a real
+        broker."""
+        with self._broker._lock:
+            self._group.positions.update(dict(positions))
 
     def idle_partitions(self):
         """Assigned partitions with nothing pending RIGHT NOW (consumer
@@ -346,6 +425,13 @@ class ConfluentConsumer(ConsumerClient):
         self.assignment_policy = assignment_policy
         self._consumer = None
         self._consumed_tps = set()   # partitions that delivered data
+        #: restore cursors awaiting assignment (seek_positions):
+        #: librdkafka assignment materializes asynchronously through
+        #: on_assign during later poll()s, so an immediate seek() right
+        #: after subscribe() would hit unassigned partitions and raise —
+        #: the cursors are applied in on_assign instead, exactly like
+        #: the user start-offset path below
+        self._pending_seek = {}
 
     def subscribe(self, topics, group_id, offsets=None):
         self._consumed_tps = set()   # scoped to this consumer session
@@ -355,36 +441,45 @@ class ConfluentConsumer(ConsumerClient):
                 "auto.offset.reset": "earliest",
                 "partition.assignment.strategy": self.assignment_policy}
         self._consumer = self._ck.Consumer(conf)
-        if offsets:
-            def on_assign(consumer, partitions):
-                for part in partitions:
-                    tp = (part.topic, part.partition)
-                    # apply the user's START offset only until the
-                    # partition has actually DELIVERED data (tracked in
-                    # poll): an EAGER rebalance re-delivers the full
-                    # assignment, and re-seeking a mid-stream partition
-                    # would rewind it into duplicates — but a partition
-                    # revoked before consuming anything must still get
-                    # its start offset, not auto.offset.reset
-                    if tp in self._consumed_tps:
-                        continue
-                    try:
-                        off = offsets[topics.index(part.topic)]
-                    except (ValueError, IndexError):
-                        continue
-                    if off is not None and off > -1:
-                        part.offset = off
-                # librdkafka requires incremental_assign under the
-                # COOPERATIVE protocol and plain assign under EAGER
-                # strategies (roundrobin/range)
-                if cooperative:
-                    consumer.incremental_assign(partitions)
-                else:
-                    consumer.assign(partitions)
 
-            self._consumer.subscribe(list(topics), on_assign=on_assign)
-        else:
-            self._consumer.subscribe(list(topics))
+        def on_assign(consumer, partitions):
+            for part in partitions:
+                tp = (part.topic, part.partition)
+                # apply a start cursor only until the partition has
+                # actually DELIVERED data (tracked in poll): an EAGER
+                # rebalance re-delivers the full assignment, and
+                # re-seeking a mid-stream partition would rewind it
+                # into duplicates — but a partition revoked before
+                # consuming anything must still get its cursor, not
+                # auto.offset.reset.  Durability restore cursors
+                # (seek_positions — exact per-partition offsets) take
+                # precedence over the user's per-topic start offsets.
+                if tp in self._consumed_tps:
+                    continue
+                seek = self._pending_seek.get(tp)
+                if seek is not None:
+                    part.offset = seek
+                    continue
+                if not offsets:
+                    continue
+                try:
+                    off = offsets[topics.index(part.topic)]
+                except (ValueError, IndexError):
+                    continue
+                if off is not None and off > -1:
+                    part.offset = off
+            # librdkafka requires incremental_assign under the
+            # COOPERATIVE protocol and plain assign under EAGER
+            # strategies (roundrobin/range)
+            if cooperative:
+                consumer.incremental_assign(partitions)
+            else:
+                consumer.assign(partitions)
+
+        # the callback is always installed: restore cursors arrive via
+        # seek_positions AFTER subscribe() but BEFORE the first poll —
+        # the only point librdkafka lets them apply is on_assign
+        self._consumer.subscribe(list(topics), on_assign=on_assign)
 
     def poll(self, max_msgs: int) -> List[KafkaMessage]:
         out = []
@@ -406,6 +501,57 @@ class ConfluentConsumer(ConsumerClient):
     def assignment(self):
         return [(p.topic, p.partition)
                 for p in self._consumer.assignment()]
+
+    def positions(self):
+        """Durability checkpoint cursor via librdkafka position() — the
+        next offset to be fetched per assigned partition.  Every
+        assigned partition gets a cursor: a never-fetched partition
+        reports OFFSET_INVALID and falls back to the group's committed
+        offset (then to 0 = earliest, matching auto.offset.reset) —
+        omitting it would let the group's auto-commit advance it past
+        the barrier and the restore skip unreplayed records.
+        UNVERIFIED against a live broker in this build environment
+        (zero egress — same validation status as the adapter notes
+        below)."""
+        try:
+            parts = self._consumer.assignment()
+            out = {}
+            missing = []
+            for p in self._consumer.position(parts):
+                if p.offset is not None and p.offset >= 0:
+                    out[(p.topic, p.partition)] = p.offset
+                else:
+                    missing.append(p)
+            if missing:
+                for p in self._consumer.committed(missing, timeout=5):
+                    off = p.offset if p.offset is not None \
+                        and p.offset >= 0 else 0
+                    out[(p.topic, p.partition)] = off
+            return out
+        except Exception:  # lint: broad-except-ok (a position probe must
+            # degrade to "unknown" — the checkpoint then records no
+            # cursor and restore falls back to the per-topic offsets)
+            return None
+
+    def seek_positions(self, positions) -> None:
+        """Restore path: stage the checkpointed per-partition cursors
+        for ``subscribe``'s on_assign callback — assignment does not
+        exist yet when the source calls this (right after subscribe),
+        so an immediate ``seek()`` would raise on every partition;
+        partitions already assigned (a later re-seek) ARE sought
+        directly.  UNVERIFIED against a live broker (see the adapter
+        validation notes below)."""
+        self._pending_seek.update(dict(positions))
+        TopicPartition = self._ck.TopicPartition
+        try:
+            assigned = {(p.topic, p.partition)
+                        for p in self._consumer.assignment()}
+        except Exception:  # lint: broad-except-ok (no assignment yet —
+            # the normal restore case; on_assign applies the cursors)
+            return
+        for (topic, part), off in dict(positions).items():
+            if (topic, part) in assigned:
+                self._consumer.seek(TopicPartition(topic, part, off))
 
     def close(self):
         if self._consumer is not None:

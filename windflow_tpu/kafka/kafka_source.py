@@ -168,6 +168,18 @@ class KafkaSourceReplica(SourceReplica):
                                        self.op.assignment_policy)
         self._consumer.subscribe(self.op.topics, self.op.group_id,
                                  self.op.offsets)
+        # durability restore (windflow_tpu/durability): seek back to the
+        # checkpointed per-partition cursors — the group may still hold
+        # post-barrier positions from the run that crashed (messages it
+        # polled but lost), and replaying them is exactly the point
+        if self.op._restore_positions:
+            self._consumer.seek_positions(self.op._restore_positions)
+        if self.op._restore_part_max:
+            # group-level per-partition event-time frontiers: every
+            # replica seeds the full merged map (assignment may differ
+            # from the checkpointing run); the first poll prunes entries
+            # for partitions this replica does not own
+            self._part_max.update(self.op._restore_part_max)
         # riched deserializers see a KafkaRuntimeContext (reference passes
         # KafkaRuntimeContext instead of RuntimeContext, kafka_source.hpp:134)
         self.context = KafkaRuntimeContext(
@@ -235,6 +247,14 @@ class KafkaSourceReplica(SourceReplica):
 
 class KafkaSource(Source):
     replica_class = KafkaSourceReplica
+
+    #: per-(topic, partition) cursors a durability restore stashes before
+    #: start(); replicas seek to them right after subscribing (None on
+    #: fresh runs — one attribute check at start, nothing per poll)
+    _restore_positions = None
+    #: merged per-partition event-time frontiers (same restore path):
+    #: group-level, seeded into every replica at start
+    _restore_part_max = None
 
     def __init__(self, deser_fn: Callable, brokers, topics: Sequence[str],
                  group_id: str = "windflow",

@@ -273,6 +273,26 @@ def _families(stats: dict,
                 "fusion (windflow_tpu/fusion)") \
                 .add(fusion["dispatches_saved_per_batch"], base)
 
+    # -- durability plane ----------------------------------------------------
+    dur = stats.get("Durability") or {}
+    if dur.get("enabled"):
+        fam("wf_durability_epochs_committed_total", "counter",
+            "Checkpoint epochs committed (manifest written + fsynced)") \
+            .add(dur.get("epochs_committed", 0), base)
+        fam("wf_durability_checkpoint_ms", "gauge",
+            "Wall cost of the last checkpoint (barrier + snapshot + "
+            "manifest)") \
+            .add(dur.get("last_checkpoint_ms") or 0, base)
+        fam("wf_durability_checkpoint_bytes", "gauge",
+            "Snapshot bytes written by the last checkpoint") \
+            .add(dur.get("last_checkpoint_bytes", 0), base)
+        fam("wf_durability_dedupe_hits_total", "counter",
+            "Sink messages skipped by the exactly-once fence on replay") \
+            .add(dur.get("dedupe_hits", 0), base)
+        fam("wf_durability_restored", "gauge",
+            "1 when this graph was rebuilt from a checkpoint epoch") \
+            .add(0 if dur.get("restored_epoch") is None else 1, base)
+
     # -- latency histograms --------------------------------------------------
     lat = stats.get("Latency") or {}
     f_svc = fam("wf_service_latency_usec", "histogram",

@@ -326,6 +326,28 @@ class Operator:
             r.time_policy = time_policy
         return self.replicas
 
+    #: True on operators holding cross-batch state the durability plane
+    #: cannot snapshot yet (host window engines, persistent-DB suites):
+    #: a checkpoint of a graph containing one restores everything else
+    #: and the pre-flight checker surfaces the gap as WF603
+    checkpoint_opaque = False
+
+    def snapshot_state(self) -> Optional[dict]:
+        """Durable-state hook (windflow_tpu/durability): one picklable
+        blob capturing ALL cross-batch state this operator owns (its
+        replicas' included), taken at the quiesced checkpoint barrier.
+        ``None`` means stateless — nothing written, nothing restored.
+        Device arrays must come back as host numpy (the plane's only
+        device sync, at checkpoint cadence)."""
+        return None
+
+    def restore_state(self, blob: dict) -> None:
+        """Inverse of :meth:`snapshot_state`, applied to a freshly built
+        (never-stepped) operator before the first source tick."""
+        raise WindFlowError(
+            f"operator '{self.name}' ({type(self).__name__}) cannot "
+            "restore checkpoint state it never snapshots")
+
     def num_dropped_tuples(self) -> int:
         """Tuples this operator dropped beyond collector-level drops (e.g.
         out-of-range keys on the mesh reduce, late tuples on TB windows);

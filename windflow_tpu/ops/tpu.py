@@ -429,6 +429,19 @@ class ReduceTPU(Operator):
             return 0
         return int(self._mesh_dropped)  # one device sync, diagnostics only
 
+    # -- durable state (windflow_tpu/durability) -----------------------------
+    # ReduceTPU's dense tables are rebuilt per batch (per-batch reduce
+    # semantics — cross-batch aggregation is the windows' job), so the
+    # only state worth a checkpoint is the accumulated drop counter the
+    # stats layer reports.
+    def snapshot_state(self):
+        if self._mesh_dropped is None:
+            return None
+        return {"kind": "reduce_tpu", "dropped": int(self._mesh_dropped)}
+
+    def restore_state(self, blob):
+        self._mesh_dropped = jnp.asarray(blob["dropped"], jnp.int64)
+
     def _maybe_warn_drops(self, n_drop: int) -> None:
         """One-time RuntimeWarning the first time the single-chip dense
         path (withMaxKeys + withMonoidCombiner) is SEEN dropping

@@ -324,6 +324,23 @@ class _StatefulTPUBase(Operator):
             self._steps[capacity] = step
         return step
 
+    # -- durable state (windflow_tpu/durability) -----------------------------
+    def snapshot_state(self):
+        """The dense ``[num_key_slots, ...]`` state table plus the host
+        key→slot intern map (the two halves of per-key device state: the
+        values AND where each key lives).  The table exists from
+        construction, so this snapshots even before the first batch —
+        restore then simply re-seeds the same initial table."""
+        return {
+            "kind": "stateful_tpu",
+            "state": jax.tree.map(np.asarray, self._state),
+            "interner": dict(self._interner._ids),
+        }
+
+    def restore_state(self, blob):
+        self._state = jax.tree.map(jnp.asarray, blob["state"])
+        self._interner._ids = dict(blob["interner"])
+
     def _stateful_step(self, batch: DeviceBatch):
         cap = batch.capacity
         if self.mesh is not None:

@@ -60,6 +60,9 @@ class _PersistentReplica(Replica):
 
 
 class _PersistentOperator(Operator):
+    # persistent ops already own their LogKV durability, but epoch
+    # alignment with the graph checkpoint is not implemented (WF603)
+    checkpoint_opaque = True
     def __init__(self, fn: Callable, name: str, parallelism: int,
                  key_extractor: Optional[Callable],
                  db_path: str,

@@ -194,6 +194,8 @@ class FfatWindowsReplica(Replica):
 
 
 class FfatWindows(Operator):
+    # host FlatFAT trees are not snapshot-capable yet (WF603)
+    checkpoint_opaque = True
     """Keyed FlatFAT windows (reference ``Ffat_Windows``): KEYBY routing like
     Keyed_Windows, incremental lift/combine logic."""
 

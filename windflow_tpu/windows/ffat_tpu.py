@@ -651,6 +651,65 @@ class FfatWindowsTPU(Operator):
         if rebase_lo is not None:
             self._rebase_ring(rebase_lo, hi)
 
+    # -- durable state (windflow_tpu/durability) -----------------------------
+    def snapshot_state(self):
+        """All cross-batch state: the dense pane rings/tables per state
+        index (device -> host numpy), the compiled-capacity/ring-size
+        pair the step program is rebuilt from, and the regrow/overflow
+        estimator bookkeeping — so a restored ring neither re-learns its
+        span nor re-arms a stale error grace.  Fused chains need nothing
+        extra here: the tail operator owns the merged state, and restore
+        rebuilds the step through ``_build_step``, which re-inlines the
+        fused prelude."""
+        if not self._states:
+            return None     # never stepped: nothing to restore
+        return {
+            "kind": "ffat_tpu",
+            "states": {k: jax.tree.map(np.asarray, st)
+                       for k, st in self._states.items()},
+            "capacity": self._capacity,
+            "NP": self.NP,
+            "auto_np": self._auto_np,
+            "np_ceil": self._np_ceil,
+            "overflow_steps": self._overflow_steps,
+            "evicted_seen": self._evicted_seen,
+            "evicted_base": self._evicted_base,
+            "error_armed": self._error_armed,
+            "clean_checks": self._clean_checks,
+            "dirty_checks": self._dirty_checks,
+            "unres_lo": self._unres_lo,
+            "unres_hi": self._unres_hi,
+            "fold_stepped": self._fold_stepped,
+            "flushed": self._flushed,
+            "eos_replicas": self._eos_replicas,
+            "payload_zero": (jax.tree.map(np.asarray, self._payload_zero)
+                            if self._payload_zero is not None else None),
+        }
+
+    def restore_state(self, blob):
+        self.NP = blob["NP"]
+        self._auto_np = blob["auto_np"]
+        self._np_ceil = blob["np_ceil"]
+        self._overflow_steps = blob["overflow_steps"]
+        self._evicted_seen = blob["evicted_seen"]
+        self._evicted_base = blob["evicted_base"]
+        self._error_armed = blob["error_armed"]
+        self._clean_checks = blob["clean_checks"]
+        self._dirty_checks = blob["dirty_checks"]
+        self._unres_lo = blob["unres_lo"]
+        self._unres_hi = blob["unres_hi"]
+        self._fold_stepped = blob["fold_stepped"]
+        self._flushed = blob["flushed"]
+        self._eos_replicas = blob["eos_replicas"]
+        self._pending_evct = None   # lazy device read: re-primed on step
+        self._states = {k: jax.tree.map(jnp.asarray, st)
+                        for k, st in blob["states"].items()}
+        if blob["payload_zero"] is not None:
+            self._payload_zero = jax.tree.map(jnp.asarray,
+                                              blob["payload_zero"])
+        self._capacity = blob["capacity"]
+        self._jit_step = self._build_step(self._capacity)
+
     def _check_overflow(self):
         # operator-wide: counters and the excused-eviction base
         # are summed over every replica state
