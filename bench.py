@@ -1246,6 +1246,63 @@ def main() -> None:
             import shutil as _sh
             _sh.rmtree(_dwork, ignore_errors=True)
 
+    # shard-plane section (windflow_tpu/monitoring/shard_ledger, guarded
+    # by tools/check_bench_keys.py + check_bench_regress.py): drive a
+    # seeded Zipf-skewed keyby workload (40% of the stream on one hot
+    # key) through a keyed ReduceTPU at parallelism 2 with the shard
+    # ledger ON and report the measured imbalance ratio, the hot key's
+    # stream share, and the ICI model total (0.0 on a single chip — the
+    # key exists so the multi-chip legs guard the same schema).  The
+    # stream is deterministic, so these are regression tripwires, not
+    # weather: a drifting imbalance_ratio means the sketch or the
+    # placement hash broke.
+    try:
+        import numpy as np
+        import windflow_tpu as wf
+        _sn = int(os.environ.get("BENCH_SHARD_TUPLES", "32768"))
+        _srng = np.random.default_rng(11)
+        _sk = _srng.integers(0, 64, _sn)
+        _sk[_srng.random(_sn) < 0.4] = 7          # injected hot key
+        def _s_build():
+            src = (wf.Source_Builder(
+                lambda: iter({"key": int(k), "v": 1.0} for k in _sk))
+                .withOutputBatchSize(4096).withName("sh_src").build())
+            red = (wf.ReduceTPU_Builder(
+                lambda a, b: {"key": b["key"], "v": a["v"] + b["v"]})
+                .withKeyBy(lambda t: t["key"]).withParallelism(2)
+                .withName("sh_red").build())
+            pg = wf.PipeGraph("bench_shard")
+            pg.add_source(src).add(red).add_sink(
+                wf.Sink_Builder(lambda t, ctx=None: None)
+                .withName("sh_snk").build())
+            return pg
+        _s_build().run()     # warmup: the overhead ratio below must
+        #                      compare sketch time against a steady run,
+        #                      not one dominated by first-compile wall
+        _s_pg = _s_build()
+        t0 = time.perf_counter()
+        _s_pg.run()
+        _s_run_usec = (time.perf_counter() - t0) * 1e6
+        _s_sec = _s_pg.stats()["Shard"]
+        _s_load = _s_sec["per_op"]["sh_red"]["load"]
+        _s_tot = _s_sec["totals"]
+        result["shard"] = {
+            "imbalance_ratio": _s_load.get("imbalance_ratio"),
+            "hot_key_share": _s_load.get("hot_key_share"),
+            "hot_key": (_s_load.get("hot_keys") or [{}])[0].get("key"),
+            "hot_shard": _s_load.get("hot_shard"),
+            "ici_bytes_per_tuple": _s_tot.get("ici_bytes_per_tuple",
+                                              0.0),
+            "sketch_overhead_pct": round(
+                100.0 * _s_tot.get("sketch_host_update_usec", 0.0)
+                / _s_run_usec, 3) if _s_run_usec else 0.0,
+            "tuples": _sn,
+        }
+    except Exception as e:  # lint: broad-except-ok (same stance as the
+        # preflight/health legs: a shard-plane regression must fail
+        # check_bench_keys loudly, not kill the bench artifact)
+        result["shard_error"] = f"{type(e).__name__}: {e}"[:200]
+
     # device-plane section (windflow_tpu/monitoring/jit_registry, guarded
     # by tools/check_bench_keys.py): the compile watcher's process totals
     # over every leg above — compile wall cost, recompile events (any
@@ -1324,6 +1381,7 @@ def main() -> None:
                  "preflight": result.get("preflight"),
                  "device": result.get("device"),
                  "health": result.get("health"),
+                 "shard": result.get("shard"),
                  "durability": result.get("durability"),
                  "e2e": result.get("e2e"),
                  "e2e_device_source": result.get("e2e_device_source"),

@@ -17,7 +17,10 @@ python tools/wf_lint.py
 # the <2% overhead budget), the analysis contracts (preflight diagnostic
 # codes, wf_lint fixtures, debug-mode race detector), the device-plane
 # contracts (compile watcher, OpenMetrics exposition, HBM-gauge CPU
-# guard), the health-plane contracts (watchdog state machine, stall
+# guard), the shard-plane contracts (seeded Zipf-skew attribution,
+# sketch accuracy bound, dispatch neutrality of the in-program sketch,
+# reshard plan, kill-switch off-path budget),
+# the health-plane contracts (watchdog state machine, stall
 # attribution, postmortem/wf_doctor round trip, crash-path END_APP), and
 # the durability contracts (one chaos kill->restore->record-diff cell
 # per mechanism, checkpoint store layout/GC, WF602 restore validation,
@@ -32,7 +35,8 @@ python tools/wf_lint.py
 python -m pytest tests/test_staging.py tests/test_observability.py \
     tests/test_analysis.py tests/test_device_metrics.py \
     tests/test_health.py tests/test_sweep_ledger.py \
-    tests/test_fusion.py tests/test_durability.py -q -m 'not slow'
+    tests/test_fusion.py tests/test_durability.py \
+    tests/test_shard_plane.py -q -m 'not slow'
 python -m pytest tests/ -q -m 'not slow'
 python __graft_entry__.py 8
 BENCH_PLATFORM=cpu BENCH_E2E_TUPLES=131072 python bench.py | tee bench_ci_out.txt

@@ -63,6 +63,15 @@ acceptance bound's evidence (<5%); its disappearance would orphan the
 whole exactly-once/restore contract of its perf guard.  Guarded here
 identically.
 
+Since the shard-plane round the bench also publishes a ``shard``
+section (``imbalance_ratio``, ``hot_key_share``,
+``ici_bytes_per_tuple`` — docs/OBSERVABILITY.md "Shard plane") from a
+seeded Zipf-skew keyby run with the shard ledger on.  The stream is
+deterministic, so the skew numbers are regression tripwires (wired
+into ``check_bench_regress.py``): a drifting ``imbalance_ratio`` means
+the sketch or the placement hash broke, and ``sketch_overhead_pct``
+doubles as the <2% budget's evidence.  Guarded here identically.
+
 Since the fusion round the bench also publishes a ``fusion`` section
 (``fused_chains``, ``dispatches_saved``, ``bytes_saved_per_batch`` —
 docs/PERF.md round 10) from the staged e2e run's sweep ledger: the
@@ -85,6 +94,7 @@ DEVICE_KEYS = ("compile_ms_total", "recompiles", "flops_per_batch")
 HEALTH_KEYS = ("graph_state", "stall_events", "watchdog_overhead_pct")
 DURABILITY_KEYS = ("checkpoint_ms", "restore_ms", "checkpoint_bytes",
                    "overhead_pct")
+SHARD_KEYS = ("imbalance_ratio", "hot_key_share", "ici_bytes_per_tuple")
 
 
 def fail(msg: str) -> None:
@@ -110,6 +120,8 @@ def check_source() -> None:
              "compile watcher — docs/OBSERVABILITY.md device-plane"),
             ("health", HEALTH_KEYS,
              "watchdog — docs/OBSERVABILITY.md health-plane"),
+            ("shard", SHARD_KEYS,
+             "shard plane — docs/OBSERVABILITY.md shard-plane"),
             ("durability", DURABILITY_KEYS,
              "checkpoint/restore — docs/DURABILITY.md")):
         missing = [k for k in keys if f'"{k}"' not in src] \
@@ -119,7 +131,8 @@ def check_source() -> None:
                  f"{missing} ({contract} contract)")
     print("check_bench_keys: OK (bench.py source emits "
           + ", ".join(KEYS + ("latency", "preflight", "device",
-                              "health", "fusion", "durability")) + ")")
+                              "health", "shard", "fusion",
+                              "durability")) + ")")
 
 
 def last_json_object(path: str):
@@ -229,6 +242,26 @@ def check_output(path: str) -> None:
         # environmental failure mode (it ships zeroed under the
         # WF_TPU_FUSE kill switch) — its absence IS the regression
         fail("bench fusion section absent from bench output")
+    shard = result.get("shard")
+    if isinstance(shard, dict):
+        missing = [k for k in SHARD_KEYS if k not in shard]
+        if missing:
+            fail(f"'shard' section missing {missing} from bench output")
+        hot = shard.get("hot_key")
+        if hot is not None and hot != 7:
+            # the shard leg injects key 7 as 40% of the stream — the
+            # ledger failing to name it means the sketch broke
+            fail(f"shard leg misattributed the seeded hot key: got "
+                 f"{hot!r}, injected 7")
+        ovh = shard.get("sketch_overhead_pct")
+        if isinstance(ovh, (int, float)) and ovh > 2.0:
+            fail(f"shard sketch overhead {ovh}% exceeds the 2% budget "
+                 "(docs/OBSERVABILITY.md shard plane)")
+    else:
+        # the shard leg runs on any backend with no environmental
+        # failure mode — its absence IS the regression
+        fail("bench shard section absent or errored "
+             f"(shard_error={result.get('shard_error')!r})")
     dura = result.get("durability")
     if isinstance(dura, dict):
         missing = [k for k in DURABILITY_KEYS if k not in dura]

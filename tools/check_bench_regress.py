@@ -64,6 +64,13 @@ GUARDED = (
     # threshold, and no recorded dispersion describes them — the
     # overhead's hard budget lives in check_bench_keys instead.
     ("durability.checkpoint_bytes", False, None),
+    # shard plane: the bench leg's stream is SEEDED, so the measured
+    # imbalance and hot-key share are deterministic — any >10% move is
+    # a sketch/placement regression, not weather.  Both directions
+    # matter, but the ratios only drift DOWN when the sketch starts
+    # losing counts, which is the failure mode worth tripping on.
+    ("shard.imbalance_ratio", True, None),
+    ("shard.hot_key_share", True, None),
 )
 
 
@@ -89,6 +96,10 @@ def comparable(cur: dict, prev: dict, path: str) -> bool:
         # stream lengths checkpoint different state — not comparable
         return dig(cur, "durability.tuples") == dig(prev,
                                                     "durability.tuples")
+    if path.startswith("shard."):
+        # the shard leg's skew numbers are seeded per tuple count
+        # (BENCH_SHARD_TUPLES): a different stream is a different truth
+        return dig(cur, "shard.tuples") == dig(prev, "shard.tuples")
     return True
 
 

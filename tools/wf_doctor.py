@@ -41,7 +41,7 @@ SECTIONS = ("stats.json", "events.json", "health.json", "device.json",
 #: sections newer writers add; validated when present, but their absence
 #: must not reject a bundle written before they existed (same schema) —
 #: this tool's job is exactly the historical crash bundle
-OPTIONAL_SECTIONS = ("sweep.json", "durability.json")
+OPTIONAL_SECTIONS = ("sweep.json", "durability.json", "shard.json")
 
 
 class BundleError(Exception):
@@ -134,6 +134,58 @@ def validate(bundle: dict) -> None:
                 raise BundleError(
                     f"sweep.json: hop {op!r} bytes_per_tuple {bpt!r} is "
                     "not a non-negative number")
+    shard = sections.get("shard.json") or {}
+    if shard.get("enabled") and "error" not in shard:
+        per_op = shard.get("per_op")
+        if not isinstance(per_op, dict):
+            raise BundleError("shard.json: per_op must be an object")
+        for op, entry in per_op.items():
+            if not isinstance(entry, dict):
+                raise BundleError(
+                    f"shard.json: operator {op!r} entry is not an object")
+            reps = entry.get("replicas")
+            if reps is not None and not isinstance(reps, list):
+                raise BundleError(
+                    f"shard.json: operator {op!r} replicas must be a "
+                    "list")
+            for r in reps or []:
+                if not isinstance(r, dict):
+                    raise BundleError(
+                        f"shard.json: operator {op!r} replica entry "
+                        f"{r!r} is not an object")
+                q = r.get("queue_depth")
+                if not isinstance(q, int) or q < 0:
+                    raise BundleError(
+                        f"shard.json: operator {op!r} shard queue_depth "
+                        f"{q!r} is not a non-negative integer")
+            load = entry.get("load")
+            if load is not None:
+                if not isinstance(load, dict):
+                    raise BundleError(
+                        f"shard.json: operator {op!r} load is not an "
+                        "object")
+                ratio = load.get("imbalance_ratio")
+                if ratio is not None and (
+                        not isinstance(ratio, (int, float)) or ratio < 0):
+                    raise BundleError(
+                        f"shard.json: operator {op!r} imbalance_ratio "
+                        f"{ratio!r} is not a non-negative number")
+                hks = load.get("hot_keys")
+                if hks is not None and not isinstance(hks, list):
+                    raise BundleError(
+                        f"shard.json: operator {op!r} hot_keys must be "
+                        "a list")
+                for hk in hks or []:
+                    if not isinstance(hk, dict):
+                        raise BundleError(
+                            f"shard.json: operator {op!r} hot-key entry "
+                            f"{hk!r} is not an object")
+                    v = hk.get("est_tuples")
+                    if not isinstance(v, int) or v < 0:
+                        raise BundleError(
+                            f"shard.json: operator {op!r} hot-key "
+                            f"est_tuples {v!r} is not a non-negative "
+                            "integer")
     dur = sections.get("durability.json") or {}
     if dur.get("enabled") and "error" not in dur:
         for key in ("epochs_committed", "dedupe_hits", "sink_commits"):
@@ -181,6 +233,24 @@ def diagnose(bundle: dict) -> dict:
                    "excess_vs_model": h.get("excess_vs_model")}
     donation_misses = {op: h["donation_miss"] for op, h in hops.items()
                        if h.get("donation_miss")}
+    shard = sections.get("shard.json") or {}
+    shard_imbalance = None
+    if shard.get("enabled") and "error" not in shard:
+        tot = shard.get("totals") or {}
+        if tot.get("max_imbalance_op"):
+            worst = (shard.get("per_op") or {}) \
+                .get(tot["max_imbalance_op"]) or {}
+            load = worst.get("load") or {}
+            hot = (load.get("hot_keys") or [{}])[0]
+            shard_imbalance = {
+                "op": tot["max_imbalance_op"],
+                "imbalance_ratio": tot.get("max_imbalance_ratio"),
+                "hot_shard": load.get("hot_shard"),
+                "hot_key": hot.get("key"),
+                "hot_key_share": tot.get("hot_key_share"),
+                "loads": load.get("tuples"),
+                "ici_bytes_per_tuple": tot.get("ici_bytes_per_tuple"),
+            }
     dur = sections.get("durability.json") or {}
     durability = None
     if dur.get("enabled") and "error" not in dur:
@@ -208,6 +278,7 @@ def diagnose(bundle: dict) -> dict:
         "recompiles": jit.get("recompiles"),
         "compile_ms_total": jit.get("compile_ms_total"),
         "span_events": len(sections.get("events.json") or []),
+        "shard_imbalance": shard_imbalance,
         "sweep_top_hop": top_hop,
         "sweep_totals": sweep.get("totals") or None,
         "donation_misses": donation_misses,
@@ -264,6 +335,16 @@ def render_text(d: dict) -> str:
             f"{n(t['excess_vs_model'])}x the record model); "
             f"graph total {n(tot.get('bytes_per_tuple'))} B/tuple over "
             f"{n(tot.get('dispatches_per_batch'))} dispatches/batch")
+    if d.get("shard_imbalance"):
+        s = d["shard_imbalance"]
+        n = lambda v: "?" if v is None else v
+        lines.append(
+            f"  shard: worst imbalance '{s['op']}' at "
+            f"{n(s['imbalance_ratio'])}x (hot shard {n(s['hot_shard'])}, "
+            f"loads {n(s['loads'])}); hottest key {n(s['hot_key'])} "
+            f"carries {n(s['hot_key_share'])} of the stream"
+            + (f"; ICI {s['ici_bytes_per_tuple']} B/tuple"
+               if s.get("ici_bytes_per_tuple") else ""))
     for op, miss in (d.get("donation_misses") or {}).items():
         lines.append(
             f"  donation miss: '{op}' re-copies "

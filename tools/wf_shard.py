@@ -1,0 +1,152 @@
+#!/usr/bin/env python
+"""wf_shard: rank shard imbalance and emit a rebalance plan.
+
+CLI face of the reshard advisor (windflow_tpu/analysis/resharding.py),
+mirroring ``tools/wf_advisor.py``: point it at a stats dump carrying a
+``Shard`` section (a ``dump_stats`` JSON, a postmortem ``stats.json`` /
+``shard.json``, or a bare section file) and get every keyed operator
+ranked by per-shard load imbalance, the hot-key table, and the concrete
+key→shard rebalance contract a resharding executor implements
+(``plan(...)`` — the interface an elastic/resharding executor PR
+implements, exactly as ``wf_advisor.plan`` was the whole-chain-fusion
+executor's contract).
+
+Usage::
+
+    python tools/wf_shard.py --stats DUMP            # rank + plan
+    python tools/wf_shard.py APP_MODULE --stats DUMP # graph named from
+                                                     # the app module
+    python tools/wf_shard.py ... --json              # machine-readable
+    python tools/wf_shard.py ... --threshold 1.5     # imbalance bound
+    python tools/wf_shard.py ... --top N             # worst N ops only
+
+This tool never imports jax (``wf_metrics``/``wf_doctor`` scrape-host
+stance) unless an APP_MODULE is given to name the graph.  Exit status:
+0 when at least one operator has rebalance actions, 1 when every keyed
+operator is balanced (nothing to do), 2 on usage/load failures.
+"""
+
+from __future__ import annotations
+
+import argparse
+import importlib.util
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def _load_resharding():
+    """File-direct import of analysis/resharding.py (pure stdlib):
+    skips the ``windflow_tpu`` package __init__, which imports jax —
+    the ``wf_metrics``/``wf_doctor`` scrape-host stance."""
+    path = os.path.join(REPO, "windflow_tpu", "analysis", "resharding.py")
+    spec = importlib.util.spec_from_file_location("_wf_resharding", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def fail(msg: str) -> None:
+    print(f"wf_shard: FAIL: {msg}", file=sys.stderr)
+    sys.exit(2)
+
+
+def load_shard_section(path: str) -> dict:
+    """The ``Shard`` section out of a stats dump / postmortem
+    stats.json / bare shard.json file."""
+    try:
+        with open(path) as f:
+            obj = json.load(f)
+    except (OSError, ValueError) as e:
+        fail(f"cannot read stats dump '{path}': {e}")
+    if isinstance(obj, dict) and "per_op" in obj:
+        return obj
+    shard = (obj or {}).get("Shard")
+    if not isinstance(shard, dict) or not shard.get("enabled"):
+        fail(f"'{path}' carries no enabled 'Shard' section — run the "
+             "graph with Config.shard_ledger on and dump_stats first")
+    return shard
+
+
+def render_text(p: dict) -> str:
+    lines = [f"wf_shard: graph '{p.get('graph') or '?'}' — "
+             f"{p['actionable']} operator(s) above imbalance threshold "
+             f"{p['threshold']}"]
+    for i, o in enumerate(p["ops"], 1):
+        lines.append(
+            f"  #{i} {o['op']} ({o['n_shards']} shard(s), "
+            f"{o['placement']}, basis {o['basis']}): "
+            f"imbalance {o['imbalance_ratio']}, "
+            f"hot shard {o['hot_shard']}, loads {o['loads']}")
+        if o.get("hot_keys"):
+            hk = o["hot_keys"][0]
+            lines.append(
+                f"      hottest key {hk.get('key')} ~{hk.get('est_tuples')}"
+                f" tuple(s) ({100 * (hk.get('share') or 0):.1f}% of the "
+                f"stream) on shard {hk.get('shard')}")
+        if o.get("lag_spread_usec") is not None:
+            lines.append(f"      watermark-lag spread across shards: "
+                         f"{o['lag_spread_usec'] / 1e3:.1f} ms")
+        for a in o["actions"]:
+            if a["kind"] == "move_keys":
+                mv = ", ".join(
+                    f"{m['key']}: {m['from_shard']}→{m['to_shard']} "
+                    f"(~{m['est_tuples']})" for m in a["moves"])
+                lines.append(
+                    f"      PLAN move_keys [{mv}] — projected imbalance "
+                    f"{a['projected_imbalance_ratio']}")
+            elif a["kind"] == "split_hot_key":
+                lines.append(
+                    f"      PLAN split_hot_key {a['key']} "
+                    f"(~{a['est_tuples']} tuple(s)): {a['note']}")
+        if not o["actions"]:
+            lines.append("      balanced (no action)")
+    if not p["ops"]:
+        lines.append("  (no keyed operator with a measured load — is "
+                     "the shard ledger on and the graph keyed?)")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("app", nargs="?",
+                    help="optional APP_MODULE[:ATTR] building the "
+                         "PipeGraph (names the graph in the plan; the "
+                         "wf_advisor loading contract)")
+    ap.add_argument("--stats", metavar="DUMP", required=True,
+                    help="stats JSON with a Shard section (dump_stats "
+                         "output, postmortem stats.json, or a bare "
+                         "shard section / shard.json)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the ranked plan as JSON")
+    ap.add_argument("--threshold", type=float, default=None,
+                    help="max/mean load ratio above which an operator "
+                         "gets rebalance actions (default 1.25)")
+    ap.add_argument("--top", type=int, default=0,
+                    help="emit only the worst N operators")
+    args = ap.parse_args(argv)
+
+    graph_name = None
+    if args.app:
+        # reuse wf_advisor's loader so one app module serves both CLIs
+        # (this path DOES import the package, jax included)
+        from tools.wf_advisor import load_graph
+        graph_name = load_graph(args.app).name
+    shard = load_shard_section(args.stats)
+    rs = _load_resharding()
+    p = rs.plan(shard, graph_name=graph_name,
+                threshold=args.threshold if args.threshold is not None
+                else rs.DEFAULT_THRESHOLD,
+                top=args.top)
+    if args.json:
+        print(json.dumps(p, indent=2))
+    else:
+        print(render_text(p))
+    return 0 if p["actionable"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -244,6 +244,22 @@ class Config:
     # off leaves one `is not None` check at each read site.
     sweep_ledger: bool = bool(int(os.environ.get("WF_TPU_SWEEP_LEDGER",
                                                  "1")))
+    # Shard plane (monitoring/shard_ledger.py, docs/OBSERVABILITY.md
+    # "Shard plane"): per-shard/per-replica attribution of the gauges the
+    # earlier planes only report per OPERATOR — queue depth, watermark
+    # frontier/lag, service latency, HBM bytes — plus key-skew sketches
+    # on the keyed edges (count-min + hot-key tables computed in-program
+    # on the existing keys lane: folded into the keyby split / fused
+    # chain programs, zero extra dispatches, merged to host only at
+    # monitor cadence) and a reshard advisor
+    # (analysis/resharding.py, tools/wf_shard.py).  Off removes the
+    # plane entirely: no sketches attach and every call site keeps one
+    # `is not None` check (micro-asserted by tests/test_shard_plane.py).
+    shard_ledger: bool = bool(int(os.environ.get("WF_TPU_SHARD_LEDGER",
+                                                 "1")))
+    # Hot keys retained per keyed edge in the shard ledger's top-K table
+    # (stats()["Shard"] hot_keys, the reshard advisor's move candidates).
+    shard_topk: int = int(os.environ.get("WF_TPU_SHARD_TOPK", "8"))
     # Whole-chain fusion (windflow_tpu/fusion, docs/PERF.md round 10):
     # at graph build, maximal fusible runs of adjacent TPU operators
     # (the fusion advisor's plan — analysis/fusion.py) lower into ONE

@@ -168,6 +168,14 @@ class FusedStatelessExec:
         # concrete specs (donation_aliases_cleanly)
         self._donate_pending = donate_inputs
         self._donate = False
+        # shard plane (monitoring/shard_ledger.py): when the ledger
+        # attaches a sketch, the downstream key extraction this program
+        # already performs also updates an on-device count-min/candidate
+        # state threaded through as one donated operand — zero extra
+        # dispatches; None leaves one check per batch in step()
+        self._sketch = None
+        self._sk_n = 1
+        self._sk_state = None
         self._raw_step = None
         self._jit = None
         self._build()
@@ -187,19 +195,49 @@ class FusedStatelessExec:
         unfused ``ChainedTPU`` hops, which share this machinery."""
         self._donate_pending = True
 
+    def attach_shard_sketch(self, sketch, n_shards: int) -> None:
+        """Fold the shard-plane sketch update into this chain program:
+        the keys computed for the downstream KEYBY consumer feed the
+        on-device count-min/candidate state inside the SAME dispatch.
+        Called by the shard ledger at graph build (before any compile);
+        ``n_shards`` is the consumer's replica count, so the sketch's
+        per-shard counts use the exact splitmix placement the keyby
+        routing applies downstream."""
+        self._sketch = sketch
+        self._sk_n = max(1, n_shards)
+        sketch.register_device_state(lambda: self._sk_state)
+        self._build()
+
     def _build(self) -> None:
         prelude = self._prelude
         kx = self._key_extractor
+        sketched = self._sketch is not None and kx is not None
+        n_sh = self._sk_n
 
-        def step(payload, valid):
+        def raw(payload, valid):
             payload, valid = prelude(payload, valid)
             keys = (jax.vmap(kx)(payload).astype(jnp.int32)
                     if kx is not None else None)
             return payload, valid, keys
 
-        self._raw_step = step
-        self._jit = wf_jit(step, op_name=self.name,
-                           donate_argnums=(0, 1) if self._donate else ())
+        # the donation aliasing probe always evaluates the sketch-free
+        # two-arg form: the sketch state trivially aliases itself and
+        # must not mask a payload lane that fails to alias
+        self._raw_step = raw
+        if sketched:
+            from windflow_tpu.monitoring.shard_ledger import \
+                device_sketch_update
+
+            def step(payload, valid, sk):
+                payload, valid, keys = raw(payload, valid)
+                return payload, valid, keys, device_sketch_update(
+                    sk, keys, valid, n_sh)
+
+            donate = ((0, 1) if self._donate else ()) + (2,)
+        else:
+            step = raw
+            donate = (0, 1) if self._donate else ()
+        self._jit = wf_jit(step, op_name=self.name, donate_argnums=donate)
 
     def step(self, batch: DeviceBatch) -> DeviceBatch:
         if self._donate_pending:
@@ -208,7 +246,15 @@ class FusedStatelessExec:
                                         batch.valid):
                 self._donate = True
                 self._build()
-        payload, valid, keys = self._jit(batch.payload, batch.valid)
+        if self._sketch is not None and self._key_extractor is not None:
+            if self._sk_state is None:
+                from windflow_tpu.monitoring.shard_ledger import \
+                    device_sketch_init
+                self._sk_state = device_sketch_init(self._sk_n)
+            payload, valid, keys, self._sk_state = self._jit(
+                batch.payload, batch.valid, self._sk_state)
+        else:
+            payload, valid, keys = self._jit(batch.payload, batch.valid)
         size = None if self._has_filter else batch.known_size
         return DeviceBatch(payload, batch.ts, valid, keys=keys,
                            watermark=batch.watermark, size=size,

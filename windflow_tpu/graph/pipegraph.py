@@ -99,6 +99,12 @@ class PipeGraph:
         # None leaves one `is not None` check at each read site (stats,
         # trace metadata, postmortem) — nothing on the per-batch path
         self._ledger = None
+        # shard plane (monitoring/shard_ledger.py): per-shard load/ICI
+        # attribution + key-skew sketches on the keyed edges, built in
+        # _build when Config.shard_ledger is on; None leaves one
+        # `is not None` check at each read site and attaches no sketch
+        # anywhere (the per-batch paths then carry one check each)
+        self._shard = None
         # whole-chain fusion (windflow_tpu/fusion): the executable fused
         # segments installed by _build when Config.whole_chain_fusion is
         # on — each routes a whole operator chain as ONE jitted dispatch
@@ -407,6 +413,15 @@ class PipeGraph:
         if cfg.sweep_ledger:
             from windflow_tpu.monitoring.sweep_ledger import SweepLedger
             self._ledger = SweepLedger(self)
+
+        # 3e. shard plane (monitoring/shard_ledger.py): built AFTER
+        # wiring and fusion (it attaches key-skew sketches to the keyed
+        # emitters and folds the in-program updates into the keyby
+        # split / fused-chain programs, all of which must exist and
+        # none of which may have compiled yet)
+        if getattr(cfg, "shard_ledger", True):
+            from windflow_tpu.monitoring.shard_ledger import ShardLedger
+            self._shard = ShardLedger(self)
 
         # sanity: every non-sink replica must have an emitter (fused
         # members are inert by design — the segment host emits for them)
@@ -804,6 +819,20 @@ class PipeGraph:
             return {"enabled": True, "error": f"{type(e).__name__}: "
                                               f"{e}"[:200]}
 
+    def _shard_section(self) -> dict:
+        """Guarded like the health/device/sweep sections: a shard-plane
+        read must never take the pipeline or a stats dump down.  With
+        ``Config.shard_ledger`` off this is the whole cost: one check."""
+        if self._shard is None:
+            return {"enabled": False}
+        try:
+            return self._shard.section()
+        except Exception as e:  # lint: broad-except-ok (the ledger
+            # merges device sketch states and walks abstract specs at
+            # stats cadence — telemetry degrades, the report still ships)
+            return {"enabled": True, "error": f"{type(e).__name__}: "
+                                              f"{e}"[:200]}
+
     def _rolling_rate(self, window_s: float) -> float:
         """Sunk-tuples/sec over (at least) the trailing ``window_s``: the
         delta between the newest sample and the youngest sample that is at
@@ -938,6 +967,9 @@ class PipeGraph:
             # sweep-ledger cross-reference: per-hop dispatch counts and
             # attributed HBM bytes for the spans in this trace
             "sweep": self._sweep_section(),
+            # shard-plane cross-reference: per-shard load + hot keys for
+            # the operators whose spans this trace carries
+            "shard": self._shard_section(),
         })
         root, ext = os.path.splitext(path)
         base = root[:-len("_trace")] if root.endswith("_trace") else root
@@ -1016,6 +1048,11 @@ class PipeGraph:
             # misses, hop-boundary residency — the attribution layer the
             # fusion advisor (tools/wf_advisor.py) plans against
             "Sweep": self._sweep_section(),
+            # shard plane (monitoring/shard_ledger.py): per-shard queue/
+            # lag/latency/HBM attribution, key-skew sketches on keyed
+            # edges, mesh ICI model — the measurement layer the reshard
+            # advisor (tools/wf_shard.py) plans against
+            "Shard": self._shard_section(),
             # durability plane (windflow_tpu/durability): epochs
             # committed, checkpoint/restore wall cost + bytes, sink
             # fence dedupe hits — docs/DURABILITY.md
@@ -1109,6 +1146,7 @@ class PipeGraph:
             return {"jit": reg.snapshot(), "totals": reg.totals()}
         write("jit.json", jit_tables)
         write("sweep.json", self._sweep_section)
+        write("shard.json", self._shard_section)
         write("durability.json", self._durability_section)
         write("preflight.json", lambda: {
             "mode": getattr(self.config, "preflight", "error"),

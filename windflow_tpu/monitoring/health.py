@@ -62,7 +62,7 @@ class _OpTrack:
 
     __slots__ = ("name", "state", "since_usec", "last_advance_usec",
                  "last_inputs", "last_frontier", "queue_depth", "frontier",
-                 "compile_storm", "failure", "stall_latched")
+                 "compile_storm", "failure", "stall_latched", "hot_shard")
 
     def __init__(self, name: str, now: int) -> None:
         self.name = name
@@ -80,9 +80,14 @@ class _OpTrack:
         #: sample inside the grace window must not flip a confirmed
         #: root cause back to OK)
         self.stall_latched = False
+        #: shard-plane attribution (monitoring/shard_ledger.py): the
+        #: specific replica holding the backlog when the operator is
+        #: degraded and runs at parallelism > 1 — a BACKPRESSURED/
+        #: STALLED verdict names the hot SHARD, not just the operator
+        self.hot_shard: Optional[dict] = None
 
     def verdict(self, now: int) -> dict:
-        return {
+        v = {
             "state": self.state,
             "since_usec": self.since_usec,
             "queue_depth": self.queue_depth,
@@ -91,6 +96,9 @@ class _OpTrack:
             "compile_storm": self.compile_storm,
             "failure": self.failure,
         }
+        if self.hot_shard is not None:
+            v["hot_shard"] = self.hot_shard
+        return v
 
 
 class HealthPlane:
@@ -199,6 +207,27 @@ class HealthPlane:
         track.queue_depth = depth
         track.frontier = frontier
         track.compile_storm = storm
+        # hot-shard attribution: the replica holding the deepest backlog
+        # (ties broken by the most-lagged frontier) — per-replica reads
+        # only, so it works with the shard ledger off too; the ledger's
+        # hot-KEY table joins in at diagnose_stall
+        track.hot_shard = None
+        if len(op.replicas) > 1 and depth > 0:
+            from windflow_tpu.batch import WM_MAX, WM_NONE
+            worst, w_depth, w_front = None, -1, None
+            for rep in op.replicas:
+                d = len(rep.inbox)
+                wm = rep.current_wm
+                f = wm if (wm != WM_NONE and wm < WM_MAX) else None
+                if d > w_depth or (d == w_depth and f is not None
+                                   and (w_front is None or f < w_front)):
+                    worst, w_depth, w_front = rep.index, d, f
+            if worst is not None and w_depth > 0:
+                track.hot_shard = {
+                    "shard": worst,
+                    "queue_depth": w_depth,
+                    "watermark_frontier_usec": w_front,
+                }
         if advanced:
             track.stall_latched = False
         if track.failure is not None:
@@ -312,6 +341,18 @@ class HealthPlane:
                 "verdicts": verdicts,
             }
             self.last_stall = diag
+        if root is not None:
+            # shard-plane join: the root operator's per-shard load and
+            # hot-key table, so the diagnosis names the hot SHARD (and
+            # the key pinning it) rather than just the operator
+            led = getattr(self.graph, "_shard", None)
+            if led is not None:
+                try:
+                    diag["shard"] = led.op_summary(root)
+                except Exception:  # lint: broad-except-ok (same stance
+                    # as every other health read: a ledger bug must not
+                    # replace the stall diagnosis)
+                    pass
         return diag
 
     @staticmethod
@@ -329,6 +370,16 @@ class HealthPlane:
                     f"last advance "
                     f"{(v.get('last_advance_age_usec') or 0) / 1e6:.3f}s "
                     "ago)")
+            hs = v.get("hot_shard")
+            if hs:
+                head += (f"; hot shard {hs.get('shard')} holds "
+                         f"{hs.get('queue_depth')} of them")
+            sh = diag.get("shard") or {}
+            hot = (sh.get("hot_keys") or [{}])[0]
+            if hot.get("key") is not None:
+                head += (f" — key {hot['key']} alone carries "
+                         f"{100 * (hot.get('share') or 0):.0f}% of the "
+                         f"stream (shard ledger, {sh.get('basis')})")
         else:
             head = ("no operator holds pending input — sources idle but "
                     "the graph never terminated (source starvation or a "

@@ -151,24 +151,30 @@ def _families(stats: dict,
         return f
 
     # -- per-operator lifetime counters --------------------------------------
+    # one sample per REPLICA with a `replica` label (stats are tracked
+    # per replica; the old per-op collapse hid skew — sum over the label
+    # in PromQL for the per-operator view).  A single-replica operator
+    # still gets exactly one sample per family, so existing consumers
+    # reading one value per op keep working.
     ops = stats.get("Operators") or []
     f_in = fam("wf_operator_inputs_total", "counter",
-               "Tuples received per operator (summed over replicas)")
+               "Tuples received per operator replica (shard)")
     f_out = fam("wf_operator_outputs_total", "counter",
-                "Tuples emitted per operator")
+                "Tuples emitted per operator replica")
     f_ign = fam("wf_operator_inputs_ignored_total", "counter",
-                "Tuples ignored per operator (e.g. late at windows)")
+                "Tuples ignored per operator replica (e.g. late at "
+                "windows)")
     f_prog = fam("wf_operator_device_programs_total", "counter",
-                 "Compiled-program dispatches per operator")
+                 "Compiled-program dispatches per operator replica")
     for op in ops:
         name = op.get("Operator_name") or op.get("Name") or "?"
-        reps = op.get("Replicas") or []
-        lab = dict(base, operator=name)
-        f_in.add(sum(r.get("Inputs_received", 0) for r in reps), lab)
-        f_out.add(sum(r.get("Outputs_sent", 0) for r in reps), lab)
-        f_ign.add(sum(r.get("Inputs_ignored", 0) for r in reps), lab)
-        f_prog.add(sum(r.get("Device_programs_launched", 0)
-                       for r in reps), lab)
+        for idx, r in enumerate(op.get("Replicas") or []):
+            lab = dict(base, operator=name,
+                       replica=str(r.get("Replica_id", idx)))
+            f_in.add(r.get("Inputs_received", 0), lab)
+            f_out.add(r.get("Outputs_sent", 0), lab)
+            f_ign.add(r.get("Inputs_ignored", 0), lab)
+            f_prog.add(r.get("Device_programs_launched", 0), lab)
 
     # -- graph-level counters / gauges ---------------------------------------
     for key, mname, mtype, help_text in (
@@ -272,6 +278,47 @@ def _families(stats: dict,
                 "Jitted dispatches per batch elided by whole-chain "
                 "fusion (windflow_tpu/fusion)") \
                 .add(fusion["dispatches_saved_per_batch"], base)
+
+    # -- shard plane ---------------------------------------------------------
+    shard = stats.get("Shard") or {}
+    if shard.get("enabled"):
+        f_sht = fam("wf_shard_tuples_total", "counter",
+                    "Tuples routed to each shard of a keyed operator "
+                    "(key-skew sketch / exact histogram)")
+        f_shq = fam("wf_shard_queue_depth", "gauge",
+                    "Queued inbox messages per operator shard (replica)")
+        f_shl = fam("wf_shard_watermark_lag_usec", "gauge",
+                    "Wall clock minus the shard's own watermark frontier")
+        f_shb = fam("wf_shard_hbm_bytes_total", "counter",
+                    "Steady XLA-cost HBM bytes attributed to the "
+                    "shard's own dispatches")
+        f_shi = fam("wf_shard_imbalance_ratio", "gauge",
+                    "Max over mean per-shard load of a keyed operator")
+        f_shh = fam("wf_shard_hot_key_share", "gauge",
+                    "Share of the operator's stream carried by its "
+                    "hottest key")
+        f_ici = fam("wf_shard_ici_bytes_per_tuple", "gauge",
+                    "Modeled ICI collective bytes per tuple for the "
+                    "operator's sharded program (mesh graphs)")
+        for name, entry in (shard.get("per_op") or {}).items():
+            lab = dict(base, operator=name)
+            for rep in entry.get("replicas") or []:
+                rlab = dict(lab, shard=str(rep.get("shard", "?")))
+                f_shq.add(rep.get("queue_depth", 0), rlab)
+                if rep.get("watermark_lag_usec") is not None:
+                    f_shl.add(rep["watermark_lag_usec"], rlab)
+                if isinstance(rep.get("hbm_bytes"), (int, float)):
+                    f_shb.add(rep["hbm_bytes"], rlab)
+            load = entry.get("load") or {}
+            for i, n_t in enumerate(load.get("tuples") or []):
+                f_sht.add(n_t, dict(lab, shard=str(i)))
+            if isinstance(load.get("imbalance_ratio"), (int, float)):
+                f_shi.add(load["imbalance_ratio"], lab)
+            if isinstance(load.get("hot_key_share"), (int, float)):
+                f_shh.add(load["hot_key_share"], lab)
+            ici = entry.get("ici") or {}
+            if isinstance(ici.get("ici_bytes_per_tuple"), (int, float)):
+                f_ici.add(ici["ici_bytes_per_tuple"], lab)
 
     # -- durability plane ----------------------------------------------------
     dur = stats.get("Durability") or {}

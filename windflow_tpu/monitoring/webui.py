@@ -151,6 +151,55 @@ async function render(id) {
   // per tuple for the hop, "disp/batch" its jitted dispatches per
   // staged batch; a flagged hop ("!don") has donation-miss copies
   const sweepHops = (last.Sweep || {}).per_hop || {};
+  // shard plane (monitoring/shard_ledger.py): per-shard drill-down
+  // under each op row — click the operator name to expand its shards
+  // (queue/lag/load per replica, hot-key table for keyed edges)
+  const shardOps = (last.Shard || {}).per_op || {};
+  const shardRow = (name, i) => {
+    const sh = shardOps[name];
+    if (!sh) return "";
+    const reps = sh.replicas || [];
+    const load = sh.load || {};
+    const tuples = load.tuples || [];
+    if (reps.length < 2 && !tuples.length) return "";
+    const rows = reps.map(r => {
+      const q = r.service_usec || {};
+      const t = tuples[r.shard];
+      const hotMark = load.hot_shard === r.shard ? " 🔥" : "";
+      return `<tr><td>shard ${r.shard}${hotMark}</td>` +
+             `<td>${r.queue_depth}</td><td>${fmtUs(r.watermark_lag_usec)}` +
+             `</td><td>${t == null ? "–" : t}</td>` +
+             `<td>${fmtUs(q.p50)}</td><td>${fmtUs(q.p99)}</td>` +
+             `<td>${r.dispatches}</td>` +
+             `<td>${r.hbm_bytes == null ? "–" : r.hbm_bytes}</td></tr>`;
+    }).join("");
+    const hot = (load.hot_keys || []).slice(0, 4).map(h =>
+      `${esc(h.key)}→shard ${h.shard ?? "?"} ` +
+      `(${((h.share || 0) * 100).toFixed(1)}%)`).join(", ");
+    const imb = load.imbalance_ratio != null
+      ? ` imbalance=${load.imbalance_ratio}` : "";
+    const ici = (sh.ici || {}).ici_bytes_per_tuple;
+    const open = (window._openShards || new Set()).has(i);
+    return `<tr id="shard_${i}" style="display:${open ? "" : "none"}">` +
+           `<td colspan="12">` +
+           `<table><tr><th>shard</th><th>queue</th><th>wm lag</th>` +
+           `<th>tuples</th><th>p50</th><th>p99</th><th>disp</th>` +
+           `<th>HBM B</th></tr>${rows}</table>` +
+           `<small>${load.basis ? `load basis=${esc(load.basis)}` : ""}` +
+           `${imb}${hot ? ` hot keys: ${hot}` : ""}` +
+           `${ici != null ? ` ICI=${ici} B/tuple` : ""}</small>` +
+           `</td></tr>`;
+  };
+  window._openShards = window._openShards || new Set();
+  window.toggleShard = i => {
+    const el = document.getElementById(`shard_${i}`);
+    if (!el) return;
+    const hidden = el.style.display === "none";
+    el.style.display = hidden ? "" : "none";
+    // survives the 1 Hz re-render: membership drives the next render
+    if (hidden) window._openShards.add(i);
+    else window._openShards.delete(i);
+  };
   document.getElementById("ops").innerHTML =
     `<table><tr><th>operator</th><th>health</th><th>replicas</th>` +
     `<th>outputs</th>` +
@@ -181,14 +230,20 @@ async function render(id) {
         ? `⇒ ${esc(hop.fused_into)}`
         : (hop.dispatches_per_batch == null ? "–"
            : hop.dispatches_per_batch);
-      return `<tr><td>${esc(name)}</td><td>${hCell}</td>` +
+      const idx = lastOps.indexOf(op);
+      const sub = shardRow(name, idx);
+      const nameCell = sub
+        ? `<td style="cursor:pointer" onclick="toggleShard(${idx})">` +
+          `▸ ${esc(name)}</td>`
+        : `<td>${esc(name)}</td>`;
+      return `<tr>${nameCell}<td>${hCell}</td>` +
              `<td>${reps.length}</td>` +
              `<td>${outs}</td><td>${ign}</td>` +
              `<td>${fmtUs(q.p50)}</td><td>${fmtUs(q.p95)}</td>` +
              `<td>${fmtUs(q.p99)}</td>` +
              `<td>${dpb}</td><td>${bpt}</td>` +
              `<td>${spark(lh.slice(-60), 80, 26)} ${fmtUs(lag)}</td>` +
-             `<td>${spark(h.slice(-60), 160, 26)} ${cur}</td></tr>`;
+             `<td>${spark(h.slice(-60), 160, 26)} ${cur}</td></tr>` + sub;
     }).join("") + "</table>";
 }
 

@@ -348,6 +348,16 @@ class Operator:
             f"operator '{self.name}' ({type(self).__name__}) cannot "
             "restore checkpoint state it never snapshots")
 
+    def key_space(self) -> Optional[int]:
+        """Declared dense key-space bound of a keyed operator (the
+        ``withMaxKeys`` / dense ``withNumKeySlots`` contract), or None
+        for arbitrary/interned key spaces.  The shard ledger
+        (monitoring/shard_ledger.py) keys off this: a bounded space gets
+        an EXACT per-key histogram (and, on a mesh, per-key-shard load
+        from the ranges each chip owns); unbounded spaces fall back to
+        the count-min sketch."""
+        return None
+
     def num_dropped_tuples(self) -> int:
         """Tuples this operator dropped beyond collector-level drops (e.g.
         out-of-range keys on the mesh reduce, late tuples on TB windows);

@@ -424,6 +424,11 @@ class ReduceTPU(Operator):
             self._jit_steps[("mesh", capacity)] = step
         return step
 
+    def key_space(self) -> Optional[int]:
+        # keys-lane plumbing for the shard ledger: the dense-table
+        # contract bounds the key space exactly where routing/state do
+        return self.max_keys if self.key_extractor is not None else None
+
     def num_dropped_tuples(self) -> int:
         if self._mesh_dropped is None:
             return 0

@@ -324,6 +324,13 @@ class _StatefulTPUBase(Operator):
             self._steps[capacity] = step
         return step
 
+    def key_space(self):
+        # keys-lane plumbing for the shard ledger: dense extractors are
+        # bounded by the slot table; interned key spaces are unbounded
+        # (the intern map assigns slots in arrival order, so slot ids
+        # say nothing about the user's key distribution)
+        return self.num_key_slots if self.dense_keys else None
+
     # -- durable state (windflow_tpu/durability) -----------------------------
     def snapshot_state(self):
         """The dense ``[num_key_slots, ...]`` state table plus the host

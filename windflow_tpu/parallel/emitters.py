@@ -298,6 +298,11 @@ class KeyByEmitter(Emitter):
         super().__init__(dests, output_batch_size)
         self.key_extractor = key_extractor
         self._open = [_OpenBatch() for _ in dests]
+        #: shard-plane sketch (monitoring/shard_ledger.py), attached by
+        #: the ledger at graph build; None leaves one check per FLUSH —
+        #: the per-tuple emit path carries no sketch work at all (the
+        #: flush path samples one key per shipped batch instead)
+        self._sketch = None
 
     @hot_path
     def emit(self, item, ts, wm, shared=False, tid=None):
@@ -310,6 +315,16 @@ class KeyByEmitter(Emitter):
     def _flush_dest(self, d):
         ob = self._open[d]
         if ob.items:
+            if self._sketch is not None:
+                try:
+                    key = self.key_extractor(ob.items[0])
+                except Exception:  # lint: broad-except-ok (telemetry
+                    # sampling of an arbitrary user key — a throwing
+                    # extractor degrades the sketch, never routing)
+                    key = None
+                # exactly ONE note_flush per shipped batch (note_flush
+                # itself never raises), so loads stay single-counted
+                self._sketch.note_flush(d, len(ob.items), key)
             self._send(d, HostBatch(ob.items, ob.tss, ob.wm,
                                     shared=ob.shared,
                                     ids=ob.ids_or_none(),
@@ -412,6 +427,13 @@ class DeviceStageEmitter(Emitter):
         self._b_wm = WM_NONE            # running row-frontier max
         self._b_ts_min = None           # data-ts extrema of the OPEN batch
         self._b_ts_max = None
+        # shard-plane key probe (monitoring/shard_ledger.HostKeyProbe):
+        # attached by the ledger when this non-keyed staging edge feeds
+        # a keyed device consumer whose key extraction runs in-program
+        # (mesh FFAT / dense reduce / stateful) — the probe applies that
+        # extractor host-side at batch granularity; None leaves one
+        # check per columnar chunk / per shipped record batch
+        self._shard_probe = None
         # Multi-chip: lay staged batch lanes out data-sharded over the mesh
         # so downstream sharded programs consume them without a reshard
         # (parallel/mesh.py batch_sharding).
@@ -463,6 +485,8 @@ class DeviceStageEmitter(Emitter):
         pinned staging, ``forward_emitter_gpu.hpp:254-300`` +
         ``recycling.hpp``).  Mesh-sharded targets and non-packable lanes
         fall back to the chunk-accumulate path below."""
+        if self._shard_probe is not None:
+            self._shard_probe.columns(cols, len(tss))
         if self._stage_target is None and not self._col_chunks:
             leaves, treedef = jax.tree.flatten(
                 {nm: np.asarray(a) for nm, a in cols.items()})
@@ -604,6 +628,8 @@ class DeviceStageEmitter(Emitter):
         self._advance_frontier(wm)
         if not self._ob.items:
             return
+        if self._shard_probe is not None:
+            self._shard_probe.items(self._ob.items)
         hb = HostBatch(self._ob.items, self._ob.tss, self._ob.wm)
         db = host_to_device(hb, capacity=self.output_batch_size,
                             device=self._stage_target,
@@ -636,6 +662,12 @@ class KeyedDeviceStageEmitter(Emitter):
         # one single-destination staging emitter per partition
         self._inner = [DeviceStageEmitter([d], output_batch_size, mesh=mesh)
                        for d in dests]
+        #: shard-plane sketch (monitoring/shard_ledger.py), attached by
+        #: the ledger at graph build; None leaves one check per tuple /
+        #: per columnar chunk.  The per-tuple path buffers truncated
+        #: keys (plain list appends) and bulk-updates every 256 tuples.
+        self._sketch = None
+        self._sk_buf = []
 
     def bind_observability(self, stats, ring, flight):
         super().bind_observability(stats, ring, flight)
@@ -654,8 +686,24 @@ class KeyedDeviceStageEmitter(Emitter):
     def emit(self, item, ts, wm, shared=False, tid=None):
         # scalar splitmix64 (bit-identical to the native/columnar path) —
         # pure int ops, no per-tuple FFI or array allocation
-        h = splitmix64_int(self._key32(self.key_extractor(item)))
+        k32 = self._key32(self.key_extractor(item))
+        h = splitmix64_int(k32)
         self._inner[h % len(self.dests)].emit(item, ts, wm)
+        if self._sketch is not None:
+            self._sk_buf.append(k32)
+            if len(self._sk_buf) >= 256:
+                self._drain_sketch_buf()
+
+    def _drain_sketch_buf(self):
+        buf, self._sk_buf = self._sk_buf, []
+        try:
+            # placement counts derive inside update_host from the same
+            # splitmix hash this emit path routed with
+            self._sketch.update_host(np.asarray(buf, np.int64))
+        except Exception:  # lint: broad-except-ok (telemetry on the
+            # staging path: a sketch failure disables the sketch, it
+            # must never take routing down — the HostKeyProbe stance)
+            self._sketch = None
 
     def emit_columns(self, cols, tss, wm, row_wms=None):
         from windflow_tpu import native
@@ -682,6 +730,16 @@ class KeyedDeviceStageEmitter(Emitter):
                  for i in range(len(tss))], np.int64)
         # native C hash+count partition (wf_host.cpp wf_keyby_partition)
         dest, counts = native.keyby_partition(keys, n)
+        if self._sketch is not None:
+            try:
+                # the key column + per-destination counts already exist
+                # here: the shard-plane update is bincount passes over
+                # them
+                self._sketch.update_host(keys, counts=counts)
+            except Exception:  # lint: broad-except-ok (telemetry on the
+                # staging path: a sketch failure disables the sketch,
+                # never routing — the HostKeyProbe stance)
+                self._sketch = None
         for d in range(n):
             if counts[d]:
                 idx = np.nonzero(dest == d)[0]
@@ -698,6 +756,8 @@ class KeyedDeviceStageEmitter(Emitter):
             "edges use DeviceKeyByEmitter")
 
     def flush(self, wm):
+        if self._sketch is not None and self._sk_buf:
+            self._drain_sketch_buf()
         for e in self._inner:
             e.flush(wm)
 
@@ -724,6 +784,20 @@ class DeviceKeyByEmitter(Emitter):
         super().__init__(dests, output_batch_size=0)
         self.key_extractor = key_extractor
         self._splits = {}
+        #: shard-plane sketch (monitoring/shard_ledger.py): when
+        #: attached at graph build, the split PROGRAM below also updates
+        #: an on-device count-min/candidate state threaded through as
+        #: one donated operand — zero extra dispatches; None leaves one
+        #: check per batch
+        self._sketch = None
+        self._sk_state = None
+
+    def attach_shard_sketch(self, sketch) -> None:
+        """Fold the shard-plane sketch update into the split program
+        (called by the ledger at graph build, before any compile)."""
+        self._sketch = sketch
+        self._splits = {}   # force the sketch variant at first compile
+        sketch.register_device_state(lambda: self._sk_state)
 
     def _get_split(self, capacity: int):
         import jax
@@ -732,8 +806,12 @@ class DeviceKeyByEmitter(Emitter):
         if split is None:
             n = len(self.dests)
             key_fn = self.key_extractor
+            sketched = self._sketch is not None
+            if sketched:
+                from windflow_tpu.monitoring.shard_ledger import \
+                    device_sketch_update
 
-            def split(payload, ts, valid, keys):
+            def split(payload, ts, valid, keys, sk=None):
                 if keys is None:
                     keys = jax.vmap(key_fn)(payload).astype(jnp.int32)
                 # splitmix64 placement, bit-identical to the host staging
@@ -746,16 +824,33 @@ class DeviceKeyByEmitter(Emitter):
                 # immutable payload/ts/keys buffers and differs only in
                 # its validity mask — O(capacity) total work instead of
                 # O(capacity * num_dests) sorts+copies
-                return keys, [dest == d for d in range(n)]
+                masks = [dest == d for d in range(n)]
+                if sk is None:
+                    return keys, masks
+                # shard plane: the key-skew sketch updates INSIDE this
+                # same program (a few fused scatter-adds on the donated
+                # state) — the dispatch count is unchanged
+                return keys, masks, device_sketch_update(
+                    sk, keys, valid, n, dest=dest)
 
             from windflow_tpu.monitoring.jit_registry import wf_jit
-            split = wf_jit(split, op_name="emitter.device_keyby_split")
+            split = wf_jit(split, op_name="emitter.device_keyby_split",
+                           donate_argnums=(4,) if sketched else ())
             self._splits[capacity] = split
         return split
 
     def emit_device_batch(self, batch):
-        keys, masks = self._get_split(batch.capacity)(
-            batch.payload, batch.ts, batch.valid, batch.keys)
+        if self._sketch is None:
+            keys, masks = self._get_split(batch.capacity)(
+                batch.payload, batch.ts, batch.valid, batch.keys)
+        else:
+            if self._sk_state is None:
+                from windflow_tpu.monitoring.shard_ledger import \
+                    device_sketch_init
+                self._sk_state = device_sketch_init(len(self.dests))
+            keys, masks, self._sk_state = self._get_split(batch.capacity)(
+                batch.payload, batch.ts, batch.valid, batch.keys,
+                self._sk_state)
         for d, mask in enumerate(masks):
             self._send(d, DeviceBatch(batch.payload, batch.ts, mask,
                                       keys=keys,

@@ -748,6 +748,11 @@ class FfatWindowsTPU(Operator):
         # replica states (and over key-shard lanes on a mesh)
         return sum(int(jnp.sum(st[name])) for st in self._states.values())
 
+    def key_space(self):
+        # keys-lane plumbing for the shard ledger: the dense pane state
+        # bounds the key space exactly where the compiled step does
+        return self.max_keys if self.key_extractor is not None else None
+
     def num_dropped_tuples(self) -> int:
         if self.is_tb and self._states:
             return self._tb_counter("n_late")
