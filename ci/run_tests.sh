@@ -11,6 +11,21 @@ cd "$(dirname "$0")/.."
 # expensive runs
 python tools/wf_lint.py
 
+# wfverify stage (object-level, imports jax + the graphs): every kernel
+# the repo ships — the bench e2e pipeline and one graph per chaos
+# family — must verify clean under --strict (zero unsuppressed
+# trace-safety/recompile/donation/determinism findings) before the test
+# legs spend minutes.  The deliberately-violating determinism family
+# (chaos "wallclock") is excluded by design: tests/test_tracecheck.py
+# asserts it IS flagged.
+python tools/wf_verify.py --strict \
+    tools.verify_targets:bench_e2e \
+    tools.verify_targets:chaos_window_cb \
+    tools.verify_targets:chaos_window_tb \
+    tools.verify_targets:chaos_reduce \
+    tools.verify_targets:chaos_stateful \
+    tools.verify_targets:chaos_stateless_chain
+
 # fast tier-1 gate: the staging-plane contracts (pool reuse, fused
 # transfer round-trip, prefetch ordering), the observability contracts
 # (histogram percentile math, trace-export schema, recorder-off zero-cost,
@@ -36,7 +51,7 @@ python -m pytest tests/test_staging.py tests/test_observability.py \
     tests/test_analysis.py tests/test_device_metrics.py \
     tests/test_health.py tests/test_sweep_ledger.py \
     tests/test_fusion.py tests/test_durability.py \
-    tests/test_shard_plane.py -q -m 'not slow'
+    tests/test_shard_plane.py tests/test_tracecheck.py -q -m 'not slow'
 python -m pytest tests/ -q -m 'not slow'
 python __graft_entry__.py 8
 BENCH_PLATFORM=cpu BENCH_E2E_TUPLES=131072 python bench.py | tee bench_ci_out.txt
@@ -51,10 +66,12 @@ rm -f bench_ci_out.txt
 CI="${CI:-1}" python tools/check_bench_regress.py
 # host worker-pool smoke (reduced size; reports pool overhead on 1 core)
 BENCH_HOST_TUPLES=4000 BENCH_HOST_VEC=2048 BENCH_HOST_REPS=1 python bench_host.py
-# nightly leg (CI_NIGHTLY=1): the slow-marked tail — the host-pool RSS
-# soak, the two-OS-process DCN validation, the 100k ordering-perf pair,
-# the heaviest fuzz seeds, and the xplane-serialize profile capture —
-# runs here so deselecting `slow` above never leaves them uncovered
+# nightly leg (CI_NIGHTLY=1): the slow-marked tail — the RSS soaks, the
+# two-OS-process DCN validation, the 100k ordering-perf pair, the
+# heaviest fuzz seeds and spec-sweep cells, the grouping/bench-chain/
+# sketch-overhead heavies (wfverify-round headroom pass), the chaos
+# soak matrix, and the xplane-serialize profile capture — runs here so
+# deselecting `slow` above never leaves them uncovered
 if [ "${CI_NIGHTLY:-0}" != "0" ]; then
     python -m pytest tests/ -q -m slow
 fi

@@ -16,6 +16,7 @@ import math
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 import bench
 from windflow_tpu.windows.ffat_kernels import make_ffat_state, make_ffat_step
@@ -45,6 +46,9 @@ def _mk_batches(n, rng):
     return out
 
 
+@pytest.mark.slow  # ~8s compile: validates the BENCH harness's unroll
+# transform, not product semantics — rides the nightly leg
+# (wfverify-round headroom pass)
 def test_unrolled_chain_matches_sequential_steps():
     unroll = 3
     step_fn, state0 = _mk_step()
@@ -74,6 +78,8 @@ def test_unrolled_chain_matches_sequential_steps():
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
 
 
+@pytest.mark.slow  # ~8s compile: bench-harness validation, nightly leg
+# (wfverify-round headroom pass)
 def test_unrolled_chain_continues_across_dispatches():
     """Chained dispatches thread state exactly like 2*unroll sequential
     steps (the timing loop calls the chain repeatedly)."""

@@ -163,13 +163,18 @@ def _run_dag(seed, config_rnd):
 # and before origin-id tie-breaking (HostBatch.ids) the tuples' window
 # assignment depended on which replica relayed them — equal counts,
 # different totals across configurations
-# the three heaviest generic seeds (~13-16s each) ride the nightly run;
-# the ordering-regression seeds and the remaining generic seeds keep the
-# tier-1 fuzz coverage
+# the heaviest generic seeds (~6-16s each) ride the nightly run; the
+# ordering-regression seeds and the remaining generic seeds keep the
+# tier-1 fuzz coverage (404/707/1212 joined the nightly tier in the
+# wfverify round's headroom pass — the gate had drifted back toward the
+# 870s budget)
 @pytest.mark.parametrize("seed", [
-    101, pytest.param(202, marks=pytest.mark.slow), 303, 404, 505, 606,
-    707, pytest.param(808, marks=pytest.mark.slow),
-    pytest.param(909, marks=pytest.mark.slow), 1212,
+    101, pytest.param(202, marks=pytest.mark.slow), 303,
+    pytest.param(404, marks=pytest.mark.slow), 505, 606,
+    pytest.param(707, marks=pytest.mark.slow),
+    pytest.param(808, marks=pytest.mark.slow),
+    pytest.param(909, marks=pytest.mark.slow),
+    pytest.param(1212, marks=pytest.mark.slow),
     2009, 2011, 2018, 2031])
 def test_dag_fuzz(seed):
     oracle = _run_dag(seed, random.Random(seed * 13 + 1))

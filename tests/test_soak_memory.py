@@ -21,6 +21,9 @@ def _rss_kb() -> int:
         return int(f.read().split()[1]) * (os.sysconf("SC_PAGESIZE") // 1024)
 
 
+@pytest.mark.slow  # ~11s: the 1M-tuple driver-path RSS soak rides the
+# nightly leg next to its host-pool sibling below (wfverify-round
+# headroom pass)
 @pytest.mark.skipif(sys.platform != "linux", reason="/proc RSS sampling")
 def test_soak_rss_bounded():
     n_tuples, cap, n_keys = 1_048_576, 32_768, 64

@@ -72,6 +72,13 @@ into ``check_bench_regress.py``): a drifting ``imbalance_ratio`` means
 the sketch or the placement hash broke, and ``sketch_overhead_pct``
 doubles as the <2% budget's evidence.  Guarded here identically.
 
+Since the wfverify round the bench also publishes a ``verify`` section
+(``findings``, ``check_ms`` — docs/ANALYSIS.md "wfverify") timing the
+object-level kernel verifier over the representative pipeline.
+``findings`` doubles as a tripwire: the bench kernels ship clean, so
+any nonzero unsuppressed count is a verifier false positive or a real
+kernel regression — both block.  Guarded here identically.
+
 Since the fusion round the bench also publishes a ``fusion`` section
 (``fused_chains``, ``dispatches_saved``, ``bytes_saved_per_batch`` —
 docs/PERF.md round 10) from the staged e2e run's sweep ledger: the
@@ -95,6 +102,7 @@ HEALTH_KEYS = ("graph_state", "stall_events", "watchdog_overhead_pct")
 DURABILITY_KEYS = ("checkpoint_ms", "restore_ms", "checkpoint_bytes",
                    "overhead_pct")
 SHARD_KEYS = ("imbalance_ratio", "hot_key_share", "ici_bytes_per_tuple")
+VERIFY_KEYS = ("findings", "check_ms")
 
 
 def fail(msg: str) -> None:
@@ -116,6 +124,8 @@ def check_source() -> None:
             ("fusion", FUSION_KEYS,
              "whole-chain fusion — docs/PERF.md round 10"),
             ("preflight", ("check_ms",), "docs/ANALYSIS.md"),
+            ("verify", VERIFY_KEYS,
+             "wfverify — docs/ANALYSIS.md wfverify section"),
             ("device", DEVICE_KEYS,
              "compile watcher — docs/OBSERVABILITY.md device-plane"),
             ("health", HEALTH_KEYS,
@@ -130,7 +140,7 @@ def check_source() -> None:
             fail(f"bench.py no longer emits the {section} section keys "
                  f"{missing} ({contract} contract)")
     print("check_bench_keys: OK (bench.py source emits "
-          + ", ".join(KEYS + ("latency", "preflight", "device",
+          + ", ".join(KEYS + ("latency", "preflight", "verify", "device",
                               "health", "shard", "fusion",
                               "durability")) + ")")
 
@@ -286,6 +296,23 @@ def check_output(path: str) -> None:
         # environmental failure mode — its absence IS the regression
         fail("bench durability section absent or errored "
              f"(durability_error={result.get('durability_error')!r})")
+    ver = result.get("verify")
+    if isinstance(ver, dict):
+        missing = [k for k in VERIFY_KEYS if k not in ver]
+        if missing:
+            fail(f"'verify' section missing {missing} from bench output")
+        if ver.get("findings"):
+            # the bench pipeline's kernels ship clean: a nonzero
+            # unsuppressed finding count is either a wfverify false
+            # positive or a real kernel regression — both block
+            fail(f"bench verify run reported {ver['findings']} "
+                 "unsuppressed wfverify finding(s) on the shipped "
+                 "bench kernels")
+    else:
+        # wfverify is device-free (static analysis of live callables) —
+        # its absence IS the analysis regression this guard catches
+        fail("bench verify section absent or errored "
+             f"(preflight_error={result.get('preflight_error')!r})")
     pf = result.get("preflight")
     if isinstance(pf, dict):
         if "check_ms" not in pf:

@@ -1,0 +1,78 @@
+#!/usr/bin/env python
+"""Zero-arg graph factories for the wfverify CI stage.
+
+``ci/run_tests.sh`` runs ``tools/wf_verify.py --strict`` over these
+entrypoints — the bench e2e pipeline shape and one graph per chaos
+family — so every kernel the repo itself ships stays clean under the
+object-level verifier (``windflow_tpu/analysis/tracecheck.py``).  The
+factories compose but never start their graphs: verification needs the
+live callables, not a run.
+
+The deliberately-violating determinism family (``wallclock``,
+``durability/chaos.py``) is NOT listed here: it exists to be flagged
+(WF612), which ``tests/test_tracecheck.py`` asserts — a strict CI pass
+over it would always fail by design.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def bench_e2e():
+    """The representative bench pipeline shape (bench.py ``_e2e_graph``):
+    columnar source spec → MapTPU → chained FilterTPU → FFAT CB window →
+    columnar sink."""
+    import numpy as np
+
+    import windflow_tpu as wf
+    src = (wf.Source_Builder(lambda: iter(()))
+           .withOutputBatchSize(4096)
+           .withRecordSpec({"key": np.int32(0),
+                            "v0": np.float32(0.0)}).build())
+    m = wf.MapTPU_Builder(
+        lambda t: {"key": t["key"], "v0": t["v0"] * 1.5 + 1.0}).build()
+    f = wf.FilterTPU_Builder(lambda t: (t["key"] & 7) != 7).build()
+    w = (wf.Ffat_WindowsTPU_Builder(lambda t: t["v0"], lambda a, b: a + b)
+         .withCBWindows(64, 16)
+         .withKeyBy(lambda t: t["key"]).withMaxKeys(64).build())
+    g = wf.PipeGraph("verify_bench_e2e")
+    pipe = g.add_source(src)
+    pipe.add(m)
+    pipe.chain(f)
+    pipe.add(w).add_sink(
+        wf.Sink_Builder(lambda r: None).withColumnarSink(defer=4).build())
+    return g
+
+
+def _chaos(family: str):
+    from windflow_tpu.durability.chaos import make_cell
+    ckpt = tempfile.mkdtemp(prefix=f"wfverify_{family}_ck_")
+    out = tempfile.mkdtemp(prefix=f"wfverify_{family}_out_") \
+        if family in ("stateless_chain", "wallclock") else None
+    cell = make_cell(family, ckpt, out_dir=out, n=64)
+    return cell["factory"]()
+
+
+def chaos_window_cb():
+    return _chaos("window_cb")
+
+
+def chaos_window_tb():
+    return _chaos("window_tb")
+
+
+def chaos_reduce():
+    return _chaos("reduce")
+
+
+def chaos_stateful():
+    return _chaos("stateful")
+
+
+def chaos_stateless_chain():
+    return _chaos("stateless_chain")

@@ -49,10 +49,15 @@ def run_cell(family: str, point: str, fusion: bool, records: int,
 
 
 def main(argv=None) -> int:
-    from windflow_tpu.durability.chaos import FAMILIES, KILL_POINTS
+    from windflow_tpu.durability.chaos import (DETERMINISM_FAMILIES,
+                                               FAMILIES, KILL_POINTS)
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
-    ap.add_argument("--family", choices=FAMILIES, action="append",
-                    help="graph family (repeatable; default: all)")
+    ap.add_argument("--family", choices=FAMILIES + DETERMINISM_FAMILIES,
+                    action="append",
+                    help="graph family (repeatable; default: every "
+                         "exactly-once family — the determinism-"
+                         "violating families are expected-fail-dynamic "
+                         "and must be named explicitly)")
     ap.add_argument("--point", choices=KILL_POINTS, action="append",
                     help="kill point (repeatable; default: all)")
     ap.add_argument("--fusion", choices=("on", "off", "both"),
@@ -74,6 +79,26 @@ def main(argv=None) -> int:
             for fusion in fusions:
                 v = run_cell(family, point, fusion, args.records, workdir)
                 results.append(v)
+                if family in DETERMINISM_FAMILIES:
+                    # expected-fail-dynamic, caught-static: the cell
+                    # exists to PROVE the replay diverges — holding
+                    # exactly-once here would mean the seeded violation
+                    # stopped violating (and wfverify's WF61x fixture
+                    # with it)
+                    ok = v["diff"] is not None
+                    v["expected_fail_dynamic"] = True
+                    failed += 0 if ok else 1
+                    if not args.json:
+                        if ok:
+                            print(f"XFAIL {family:<15} {point:<15} "
+                                  f"fusion={'on ' if fusion else 'off'} "
+                                  "diverged as seeded (caught static: "
+                                  "wfverify WF61x)")
+                        else:
+                            print(f"FAIL {family}: determinism cell "
+                                  "held exactly-once — the seeded "
+                                  "violation is gone")
+                    continue
                 ok = v["diff"] is None
                 failed += 0 if ok else 1
                 if not args.json:
@@ -86,12 +111,18 @@ def main(argv=None) -> int:
     if args.json:
         json.dump(results, sys.stdout, indent=1)
         print()
+    n_det = sum(1 for v in results if v.get("expected_fail_dynamic"))
+    n_eo = len(results) - n_det
     if failed:
         print(f"wf_chaos: FAIL — {failed}/{len(results)} cell(s) "
-              "diverged (exactly-once violated)", file=sys.stderr)
+              "violated their contract (exactly-once cells must hold; "
+              "determinism cells must diverge as seeded)",
+              file=sys.stderr)
         return 1
-    print(f"wf_chaos: OK — {len(results)} cell(s) held exactly-once "
-          f"(workdir {workdir})")
+    print(f"wf_chaos: OK — {n_eo} cell(s) held exactly-once"
+          + (f", {n_det} determinism cell(s) diverged as seeded"
+             if n_det else "")
+          + f" (workdir {workdir})")
     return 0
 
 

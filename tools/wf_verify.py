@@ -1,0 +1,94 @@
+#!/usr/bin/env python
+"""wf_verify: object-level static verification of an application's kernels.
+
+CLI face of wfverify (``windflow_tpu/analysis/tracecheck.py``), mirroring
+``tools/wf_check.py``: point it at the module that builds your PipeGraph
+and every *live function object* the runtime will trace or call back —
+map/filter/flatmap kernels, reduce combiners, FFAT lift/comb, key
+extractors, sink callbacks, the framework's own wf_jit wrapper bodies —
+is statically verified for trace-safety (WF80x), recompile hazards
+(WF81x), donation safety (WF82x) and, when the graph checkpoints,
+replay determinism (WF61x).  Unlike the pure-AST ``tools/wf_lint.py``
+this DOES import jax and the application: closures resolve to their
+current values, donation is read off the real jit wrappers.
+
+Usage::
+
+    python tools/wf_verify.py APP_MODULE[:ATTR] [MORE...]
+    python tools/wf_verify.py ... --json       # machine-readable
+    python tools/wf_verify.py ... --strict     # exit 1 on warnings too
+
+Several ``module[:attr]`` targets may be named in one invocation (the CI
+stage verifies every bench/chaos entrypoint in one interpreter).  Inline
+suppressions (``# wfverify: ok (reason)``) are honored and counted; a
+suppression without a reason is rejected and the finding reported.
+
+Exit status: 0 clean, 1 error-severity findings (or any finding under
+``--strict``), 2 usage/load failures.
+"""
+
+from __future__ import annotations
+
+import argparse
+import importlib.util
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _load_wf_check():
+    spec = importlib.util.spec_from_file_location(
+        "wf_check", os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                 "wf_check.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("apps", nargs="+",
+                    help="APP_MODULE or APP_MODULE:ATTR building the "
+                         "PipeGraph (several allowed)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit per-app reports as one JSON object")
+    ap.add_argument("--strict", action="store_true",
+                    help="exit nonzero on warnings too")
+    args = ap.parse_args(argv)
+
+    load_graph = _load_wf_check().load_graph
+    from windflow_tpu.analysis.tracecheck import verify_graph
+
+    out = {}
+    total_errors = total_findings = 0
+    for app in args.apps:
+        g = load_graph(app)
+        report = verify_graph(g)
+        errors = [d for d in report.diagnostics if d.severity == "error"]
+        total_errors += len(errors)
+        total_findings += len(report.diagnostics)
+        out[app] = {
+            "graph": g.name,
+            "errors": len(errors),
+            "warnings": len(report.diagnostics) - len(errors),
+            **report.to_json(),
+        }
+        if not args.json:
+            for d in report.diagnostics:
+                print(str(d))
+            print(f"wf_verify: {app} ({g.name}): "
+                  f"{len(errors)} error(s), "
+                  f"{len(report.diagnostics) - len(errors)} warning(s), "
+                  f"{len(report.suppressed)} suppressed, "
+                  f"{report.checked} callables in {report.check_ms} ms")
+    if args.json:
+        print(json.dumps(out, indent=2))
+    if total_errors or (args.strict and total_findings):
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -7,6 +7,11 @@ Three coordinated passes share one :class:`Diagnostic` record type
 * ``analysis.preflight`` — ``PipeGraph.check()``: abstract evaluation of
   the whole dataflow graph before any device dispatch (auto-run at
   ``start()`` under ``Config.preflight``);
+* ``analysis.tracecheck`` — wfverify, the object-level verifier of the
+  actual kernel/callback function objects: trace-safety (WF80x),
+  recompile hazards (WF81x), donation safety (WF82x), replay
+  determinism (WF61x) — folded into ``check()``, standalone as
+  ``tools/wf_verify.py``;
 * ``analysis.hotpath`` — the ``@hot_path`` annotation enforced statically
   by ``tools/wf_lint.py``;
 * ``analysis.debug_concurrency`` — ``WF_TPU_DEBUG_CONCURRENCY=1`` runtime
@@ -34,5 +39,13 @@ def check_graph(graph):
     return _cg(graph)
 
 
+def verify_graph(graph):
+    """Run only the wfverify families over an unstarted PipeGraph and
+    return the :class:`~windflow_tpu.analysis.tracecheck.VerifyReport`
+    (lazy import, same stance as :func:`check_graph`)."""
+    from windflow_tpu.analysis.tracecheck import verify_graph as _vg
+    return _vg(graph)
+
+
 __all__ = ["CODES", "ConcurrencyViolation", "Diagnostic", "check_graph",
-           "hot_path", "set_enabled"]
+           "hot_path", "set_enabled", "verify_graph"]

@@ -70,6 +70,18 @@ CODES = {
                        "manifest topology"),
     "WF603": ("warning", "operator holds cross-batch state the "
                          "checkpoint cannot capture"),
+    # -- determinism for replay (WF61x, wfverify — analysis/tracecheck.py):
+    #    kernels and callbacks of a durability-enabled graph must
+    #    regenerate the committed prefix identically on replay
+    #    (docs/DURABILITY.md "Determinism requirements") -------------------
+    "WF611": ("warning", "RNG without an explicitly threaded key in a "
+                         "kernel/callback of a checkpointed graph"),
+    "WF612": ("warning", "wall-clock read in a kernel/callback of a "
+                         "checkpointed graph"),
+    "WF613": ("warning", "id()/hash() identity dependence in a "
+                         "kernel/callback of a checkpointed graph"),
+    "WF614": ("warning", "set iteration-order dependence in a "
+                         "kernel/callback of a checkpointed graph"),
     # -- hot-path lint (WF7xx, emitted by tools/wf_lint.py) ------------------
     "WF701": ("error", "allocation inside a @hot_path function"),
     "WF702": ("error", "host synchronization inside a @hot_path function"),
@@ -79,6 +91,34 @@ CODES = {
                        "justification"),
     "WF721": ("error", "lock-guarded attribute accessed outside its "
                        "declared lock"),
+    # -- wfverify: object-level static verification of the actual
+    #    function objects handed to device operators plus the
+    #    framework's wf_jit wrapper bodies (analysis/tracecheck.py) --------
+    "WF800": ("warning", "wfverify pass failed internally and was "
+                         "skipped (analysis degraded, graph unchecked "
+                         "by the object-level verifier)"),
+    # trace-safety (WF80x)
+    "WF801": ("error", "host materialization of a traced value inside a "
+                       "jit-traced kernel"),
+    "WF802": ("error", "Python control flow on a traced value inside a "
+                       "jit-traced kernel"),
+    "WF803": ("warning", "mutation of closure/global/default-arg state "
+                         "inside a jit-traced kernel (trace-time side "
+                         "effect)"),
+    "WF804": ("warning", "print() inside a jit-traced kernel (runs at "
+                         "trace time only; use jax.debug.print)"),
+    # recompile hazards (WF81x) — the static twin of the wf_jit
+    # recompile-storm tripwire (monitoring/jit_registry.py)
+    "WF811": ("warning", "trace-time value that can vary per call baked "
+                         "into a jit-traced kernel (stale constant / "
+                         "recompile driver)"),
+    "WF812": ("warning", "data-dependent output shape inside a "
+                         "jit-traced kernel (fails to trace or "
+                         "recompiles per batch)"),
+    # donation safety (WF82x) — the static twin of the sweep ledger's
+    # donation-miss audit (monitoring/sweep_ledger.py)
+    "WF821": ("error", "donated operand read after dispatch (the buffer "
+                       "is dead once the compiled program owns it)"),
 }
 
 

@@ -191,7 +191,27 @@ def check_graph(graph) -> List[Diagnostic]:
     _watermark_pass(graph, ops, upstreams, diags)
     _durability_pass(graph, ops, diags)
     _kernel_pass(graph, ops, edges, upstreams, diags)
+    _tracecheck_pass(graph, diags)
     return diags
+
+
+def _tracecheck_pass(graph, diags) -> None:
+    """wfverify (analysis/tracecheck.py): object-level trace-safety /
+    recompile / donation / determinism verification of the live kernel
+    objects.  Guarded: a verifier bug must degrade to 'unchecked', never
+    block a run the runtime itself would have accepted."""
+    try:
+        from windflow_tpu.analysis.tracecheck import verify_graph
+        report = verify_graph(graph)
+        graph._tracecheck_report = report
+        diags.extend(report.diagnostics)
+    except Exception as e:  # noqa: BLE001 - lint: broad-except-ok (the
+        # verifier inspects arbitrary user sources; any internal failure
+        # degrades to a note instead of masking the preflight result)
+        diags.append(Diagnostic(
+            "WF800", f"wfverify pass failed internally and was skipped "
+                     f"— {type(e).__name__}: {e}"[:300],
+            severity="warning"))
 
 
 def _durability_pass(graph, ops, diags) -> None:

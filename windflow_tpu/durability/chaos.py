@@ -233,15 +233,26 @@ def _diff_seq(what: str, a: list, b: list) -> str:
 FAMILIES = ("window_cb", "window_tb", "reduce", "stateful",
             "stateless_chain")
 
+#: seeded determinism-VIOLATING families — cells that break the
+#: docs/DURABILITY.md replay contract ON PURPOSE, so the static and
+#: dynamic layers can be cross-validated: wfverify flags the graph
+#: before any batch runs (WF61x, analysis/tracecheck.py), and the chaos
+#: A/B diff fails dynamically on the same graph (the kernel bakes a
+#: wall-clock read at trace time, so the restored run's re-trace
+#: diverges from the committed prefix).  Expected-fail-dynamic,
+#: caught-static: NOT part of the exactly-once soak matrix above.
+DETERMINISM_FAMILIES = ("wallclock",)
+
 #: per-family mid_window kill counts that land after the first
 #: checkpoint and before completion at the default cell size (device
 #: replicas count batches; the host reduce counts records)
 MID_WINDOW_AFTER = {"window_cb": 12, "window_tb": 12, "stateful": 12,
-                    "stateless_chain": 12, "reduce": 3000}
+                    "stateless_chain": 12, "reduce": 3000,
+                    "wallclock": 12}
 
 #: the operator a mid_window kill targets, per family
 VICTIM = {"window_cb": "w", "window_tb": "w", "stateful": "st",
-          "stateless_chain": "f", "reduce": "red"}
+          "stateless_chain": "f", "reduce": "red", "wallclock": "m"}
 
 
 def make_cell(family: str, ckpt_dir: str, *, fusion: bool = True,
@@ -264,9 +275,10 @@ def make_cell(family: str, ckpt_dir: str, *, fusion: bool = True,
     from windflow_tpu.kafka.client import InMemoryBroker
     from windflow_tpu.kafka.kafka_sink import KafkaSink, KafkaSinkMessage
     from windflow_tpu.kafka.kafka_source import KafkaSource
-    if family not in FAMILIES:
+    if family not in FAMILIES + DETERMINISM_FAMILIES:
         raise WindFlowError(
-            f"unknown chaos family '{family}' (one of {FAMILIES})")
+            f"unknown chaos family '{family}' "
+            f"(one of {FAMILIES + DETERMINISM_FAMILIES})")
     broker = InMemoryBroker()
     broker.create_topic("in", 1)
     p = broker.producer()
@@ -342,6 +354,19 @@ def make_cell(family: str, ckpt_dir: str, *, fusion: bool = True,
             pipe.add(wf.Reduce_Builder(red_fn, dict)
                      .withKeyBy(lambda t: t["key"])
                      .withName("red").build())
+            pipe.add_sink(KafkaSink(ser, broker, name="ksnk"))
+        elif family == "wallclock":
+            # DELIBERATE determinism violation (DETERMINISM_FAMILIES):
+            # the kernel bakes a wall-clock read into the traced program
+            # as a constant — wfverify flags it statically (WF612) and
+            # the A/B diff fails dynamically because the restored run
+            # re-traces with a different clock.  No suppression: being
+            # flagged is this family's purpose.
+            import time as _time
+            pipe.add(wf.MapTPU_Builder(
+                lambda t: {"key": t["key"],
+                           "value": t["value"] + (_time.time() % 3600.0)})
+                .withName("m").build())
             pipe.add_sink(KafkaSink(ser, broker, name="ksnk"))
         else:  # stateless_chain -> exactly-once epoch file sink
             pipe.add(wf.MapTPU_Builder(

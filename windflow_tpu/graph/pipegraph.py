@@ -139,6 +139,10 @@ class PipeGraph:
         # diagnostics + wall cost, surfaced through stats() and bench.py
         self._preflight_diags = None
         self._preflight_ms = None
+        # wfverify (analysis/tracecheck.py): the object-level verifier's
+        # last report (diagnostics folded into _preflight_diags; the
+        # report keeps the suppressed findings and per-callable counts)
+        self._tracecheck_report = None
         # profiler bridge: directory the last profile() capture actually
         # landed in, so dump_trace()'s cross-reference points at a real
         # capture even when profile(log_dir=...) overrode the config

@@ -225,11 +225,14 @@ class WfJit:
     compile, not a recompile); counters aggregate per op name in the
     process-wide registry."""
 
-    __slots__ = ("op_name", "_jit", "_seen", "_last_sig", "_lock",
+    __slots__ = ("op_name", "_jit", "_fn", "_seen", "_last_sig", "_lock",
                  "_entry", "_donate", "dispatches", "cost")
 
     def __init__(self, fn: Callable, op_name: str, jit_kwargs: dict) -> None:
         self.op_name = op_name
+        #: the undecorated traced body — wfverify (analysis/tracecheck.py)
+        #: statically analyzes it through this handle
+        self._fn = fn
         self._jit = jax.jit(fn, **jit_kwargs)
         self._seen = set()
         self._last_sig = None
