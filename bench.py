@@ -512,6 +512,97 @@ def run_bench_reduce(platform: str, cfg: dict, jax) -> dict:
     return out
 
 
+def run_bench_compaction(platform: str, cfg: dict, jax) -> dict:
+    """Device-side key compaction A/B (parallel/compaction.py, guarded
+    by tools/check_bench_keys.py + check_bench_regress.py): the seeded
+    Zipf ARBITRARY-key reduce — keys drawn Zipf(1.5) and scrambled to
+    arbitrary int32 values, so no ``withMaxKeys`` declaration is
+    possible — run through the same declared-monoid ReduceTPU twice:
+    the legacy sorted segmented path vs the compacted remap (dense slot
+    table + overflow lane in one program).  Both paths fold the same
+    batch back-to-back in one process, so the speedup ratio holds even
+    when the box is loaded.  The Zipf tail keeps ~2% of lanes missing
+    the warm table every batch, so the measured number pays the FULL
+    compacted machinery: lookup, packed scatter, overflow sort, rank
+    merge — not just the all-hit fast lane."""
+    import numpy as np
+
+    import windflow_tpu as wf
+    from windflow_tpu.batch import DeviceBatch
+    from windflow_tpu.parallel.compaction import KeyCompactor
+
+    import jax.numpy as jnp
+
+    CAP = cfg["cap"]
+    SLOTS = 1024
+    rng = np.random.default_rng(7)
+    # rank-scramble: hot ranks land on arbitrary int32 values, not the
+    # dense small ints a withMaxKeys user would declare
+    r = rng.zipf(1.5, CAP).astype(np.uint64)
+    keys = ((r * np.uint64(0x9E3779B97F4A7C15) >> np.uint64(31))
+            & np.uint64(0x7FFFFFFE)).astype(np.int32)
+    dev = jax.devices()[0]
+    payload = {"key": jax.device_put(jnp.asarray(keys), dev),
+               "v": jax.device_put(
+                   jnp.asarray(rng.random(CAP, dtype=np.float32)), dev)}
+    batch = DeviceBatch(payload,
+                        jax.device_put(
+                            jnp.arange(CAP, dtype=jnp.int64), dev),
+                        jax.device_put(jnp.ones(CAP, bool), dev))
+    comb = lambda a, b: {"key": jnp.maximum(a["key"], b["key"]),
+                         "v": jnp.maximum(a["v"], b["v"])}
+    ops = {}
+    comp = None
+    for label in ("sorted", "compacted"):
+        op = (wf.ReduceTPU_Builder(comb).withKeyBy(lambda t: t["key"])
+              .withMonoidCombiner("max").build())
+        if label == "compacted":
+            comp = KeyCompactor(SLOTS, name="bench_compact")
+            op.enable_compaction(comp)
+            # warm admission, hottest-first — what the emitter/sketch
+            # seeding converges to on a steady stream
+            u, cnt = np.unique(keys, return_counts=True)
+            comp.observe(u[np.argsort(-cnt)][:SLOTS])
+        for _ in range(cfg["warmup"]):
+            o = op._step(batch)
+        jax.block_until_ready(o.payload)
+        ops[label] = op
+
+    def window(op) -> float:
+        t0 = time.perf_counter()
+        for _ in range(cfg["steps"]):
+            o = op._step(batch)
+        jax.block_until_ready(o.payload)
+        return cfg["steps"] * CAP / (time.perf_counter() - t0)
+
+    # paired windows: each round times sorted then compacted under the
+    # same instantaneous box load, so the per-round ratio is immune to
+    # the slow load drift that skews a sequential leg-then-leg A/B
+    # (the ratio IS the guarded scalar — check_bench_regress trips it)
+    rates = {"sorted": [], "compacted": []}
+    ratios = []
+    for _ in range(5):
+        s, c = window(ops["sorted"]), window(ops["compacted"])
+        rates["sorted"].append(s)
+        rates["compacted"].append(c)
+        ratios.append(c / s)
+    out = {}
+    for label, rs in rates.items():
+        med, disp = _median_disp(rs)
+        out[label + "_tps"] = round(med, 1)
+        out[label + "_dispersion"] = disp
+    med, disp = _median_disp(ratios)
+    out["speedup_vs_sorted"] = round(med, 2)
+    out["speedup_dispersion"] = disp
+    s = comp.summary()
+    out["hit_rate"] = s["hit_rate"]
+    out["overflow_share"] = s["overflow_share"]
+    out["churn_per_sweep"] = s["churn_per_sweep"]
+    out["big_fallbacks"] = s["big_fallbacks"]
+    out["tuples"] = s["tuples"]
+    return out
+
+
 def _e2e_graph(cfg: dict, n_tuples: int, chunks, lat_sink):
     """Build the whole-framework pipeline (VERDICT r2 item 3: benchmark what
     ``PipeGraph.run()`` sustains, not the raw kernel): columnar byte ingest →
@@ -1006,6 +1097,12 @@ def main() -> None:
         result["reduce_error"] = f"{type(e).__name__}: {e}"[:300]
 
     try:
+        result["compaction"] = run_bench_compaction(
+            platform, CONFIGS[platform], jax)
+    except Exception as e:
+        result["compaction_error"] = f"{type(e).__name__}: {e}"[:300]
+
+    try:
         e2e = run_bench_e2e(platform, CONFIGS[platform], jax,
                             kernel_tps=result["value"])
         e2e["ratio_vs_kernel"] = round(
@@ -1404,6 +1501,7 @@ def main() -> None:
                  "e2e_device_source": result.get("e2e_device_source"),
                  "ysb": result.get("ysb"),
                  "reduce": result.get("reduce"),
+                 "compaction": result.get("compaction"),
                  "t": now,
                  "recorded_at": time.strftime("%Y-%m-%d %H:%M:%S")})
     del runs[:-48]  # retention: debugging reruns can burn through a

@@ -36,8 +36,11 @@ python tools/wf_verify.py --strict \
 # sketch accuracy bound, dispatch neutrality of the in-program sketch,
 # reshard plan, kill-switch off-path budget),
 # the health-plane contracts (watchdog state machine, stall
-# attribution, postmortem/wf_doctor round trip, crash-path END_APP), and
-# the durability contracts (one chaos kill->restore->record-diff cell
+# attribution, postmortem/wf_doctor round trip, crash-path END_APP),
+# the key-compaction contracts (record-for-record compacted vs sorted
+# vs declared-dense A/B, overflow-to-sorted under adversarial streams,
+# zero-extra-dispatch pin, churn/hit-rate surfacing, remap chaos
+# restore), and the durability contracts (one chaos kill->restore->record-diff cell
 # per mechanism, checkpoint store layout/GC, WF602 restore validation,
 # sink EOS fence, off-path budget — the full family x kill point x
 # fusion soak matrix is slow-marked for the nightly leg) fail
@@ -51,7 +54,8 @@ python -m pytest tests/test_staging.py tests/test_observability.py \
     tests/test_analysis.py tests/test_device_metrics.py \
     tests/test_health.py tests/test_sweep_ledger.py \
     tests/test_fusion.py tests/test_durability.py \
-    tests/test_shard_plane.py tests/test_tracecheck.py -q -m 'not slow'
+    tests/test_shard_plane.py tests/test_tracecheck.py \
+    tests/test_key_compaction.py -q -m 'not slow'
 python -m pytest tests/ -q -m 'not slow'
 python __graft_entry__.py 8
 BENCH_PLATFORM=cpu BENCH_E2E_TUPLES=131072 python bench.py | tee bench_ci_out.txt

@@ -101,10 +101,14 @@ def _run_dag(seed, config_rnd):
     # the host worker pool is a CONFIG dimension: pooled drains must
     # reproduce run 0's results bit-for-bit across every topology — and
     # so is whole-chain fusion (windflow_tpu/fusion): fused and unfused
-    # sweeps of the same topology must be record-for-record identical
+    # sweeps of the same topology must be record-for-record identical —
+    # and so is key compaction (windflow_tpu/parallel/compaction.py):
+    # compacted and legacy paths of the same keyed consumers must be too
     cfg = wf.Config(host_worker_threads=config_rnd.choice([0, 0, 2, 4]),
                     whole_chain_fusion=config_rnd.choice([True, True,
-                                                          False]))
+                                                          False]),
+                    key_compaction=config_rnd.choice([True, True,
+                                                      False]))
     g = wf.PipeGraph("fuzz", mode, wf.TimePolicy.EVENT, config=cfg)
     src_batch = config_rnd.randint(1, 64)
     mp = g.add_source(

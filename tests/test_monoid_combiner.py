@@ -313,6 +313,14 @@ def test_tb_monoid_with_lateness_and_disorder_matches_default(
 
 
 def _run_reduce_graph(stream, declare, max_keys=None):
+    # key_compaction OFF: this file pins the LEGACY declared-dense
+    # contract (out-of-range keys dropped + warned) that only exists
+    # under the WF_TPU_KEY_COMPACTION=0 kill switch since PR 11 —
+    # the default-on reroute behavior is pinned by
+    # tests/test_key_compaction.py
+    import dataclasses
+
+    from windflow_tpu.basic import default_config
     got = []
     src = (wf.Source_Builder(lambda: iter(stream))
            .withOutputBatchSize(64).build())
@@ -328,7 +336,9 @@ def _run_reduce_graph(stream, declare, max_keys=None):
     snk = wf.Sink_Builder(
         lambda r: got.append((int(r["key"]), float(r["v"])))
         if r is not None else None).build()
-    g = wf.PipeGraph("reduce_dense", wf.ExecutionMode.DEFAULT)
+    g = wf.PipeGraph("reduce_dense", wf.ExecutionMode.DEFAULT,
+                     config=dataclasses.replace(default_config,
+                                                key_compaction=False))
     g.add_source(src).add(op).add_sink(snk)
     g.run()
     return got, op

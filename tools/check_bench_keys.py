@@ -72,6 +72,15 @@ into ``check_bench_regress.py``): a drifting ``imbalance_ratio`` means
 the sketch or the placement hash broke, and ``sketch_overhead_pct``
 doubles as the <2% budget's evidence.  Guarded here identically.
 
+Since the key-compaction round the bench also publishes a
+``compaction`` section (``speedup_vs_sorted``, ``hit_rate``,
+``overflow_share``, ``churn_per_sweep`` — docs/PERF.md round 12) from
+a seeded Zipf arbitrary-key reduce A/B: the compacted remap path vs
+the legacy sorted path on the same batch.  ``hit_rate`` hard-fails
+below 0.9 — under that floor the speedup number is measuring the
+overflow lane, not the dense fast path — and ``speedup_vs_sorted`` is
+tripwired in ``check_bench_regress.py``.  Guarded here identically.
+
 Since the wfverify round the bench also publishes a ``verify`` section
 (``findings``, ``check_ms`` — docs/ANALYSIS.md "wfverify") timing the
 object-level kernel verifier over the representative pipeline.
@@ -103,6 +112,8 @@ DURABILITY_KEYS = ("checkpoint_ms", "restore_ms", "checkpoint_bytes",
                    "overhead_pct")
 SHARD_KEYS = ("imbalance_ratio", "hot_key_share", "ici_bytes_per_tuple")
 VERIFY_KEYS = ("findings", "check_ms")
+COMPACTION_KEYS = ("speedup_vs_sorted", "hit_rate", "overflow_share",
+                   "churn_per_sweep")
 
 
 def fail(msg: str) -> None:
@@ -132,6 +143,8 @@ def check_source() -> None:
              "watchdog — docs/OBSERVABILITY.md health-plane"),
             ("shard", SHARD_KEYS,
              "shard plane — docs/OBSERVABILITY.md shard-plane"),
+            ("compaction", COMPACTION_KEYS,
+             "key compaction — docs/PERF.md round 12"),
             ("durability", DURABILITY_KEYS,
              "checkpoint/restore — docs/DURABILITY.md")):
         missing = [k for k in keys if f'"{k}"' not in src] \
@@ -141,7 +154,7 @@ def check_source() -> None:
                  f"{missing} ({contract} contract)")
     print("check_bench_keys: OK (bench.py source emits "
           + ", ".join(KEYS + ("latency", "preflight", "verify", "device",
-                              "health", "shard", "fusion",
+                              "health", "shard", "compaction", "fusion",
                               "durability")) + ")")
 
 
@@ -272,6 +285,31 @@ def check_output(path: str) -> None:
         # failure mode — its absence IS the regression
         fail("bench shard section absent or errored "
              f"(shard_error={result.get('shard_error')!r})")
+    compc = result.get("compaction")
+    if isinstance(compc, dict):
+        missing = [k for k in COMPACTION_KEYS if k not in compc]
+        if missing:
+            fail(f"'compaction' section missing {missing} from bench "
+                 "output")
+        hr = compc.get("hit_rate")
+        if not isinstance(hr, (int, float)) or hr < 0.9:
+            # the leg seeds the remap with the Zipf stream's hot set
+            # before measuring: a hit rate under 0.9 means admission,
+            # the lookup, or the seeding walk broke — the speedup
+            # number above it would be measuring the overflow lane
+            fail(f"compaction hit_rate={hr!r} below the 0.9 floor on "
+                 "the seeded Zipf leg (docs/PERF.md round 12)")
+        if compc.get("big_fallbacks"):
+            # ~2% of lanes miss per batch — nowhere near the
+            # capacity//32 overflow budget, so any full-width fallback
+            # here means the miss accounting broke
+            fail(f"compaction leg took {compc['big_fallbacks']} "
+                 "full-width sorted fallbacks on a 2%-miss stream")
+    else:
+        # the compaction leg is an in-process kernel A/B with no
+        # environmental failure mode — its absence IS the regression
+        fail("bench compaction section absent or errored "
+             f"(compaction_error={result.get('compaction_error')!r})")
     dura = result.get("durability")
     if isinstance(dura, dict):
         missing = [k for k in DURABILITY_KEYS if k not in dura]

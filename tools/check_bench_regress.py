@@ -11,10 +11,18 @@ scalar moving more than the threshold in the bad direction.
 
 Under ``CI=1`` a regression fails (exit 1); locally it warns (exit 0),
 because a laptop run racing a browser is not a regression.  Noise is
-respected: a key whose own recorded dispersion (``rel_spread``) exceeds
-the threshold on either side of the comparison is reported but never
-tripped — when the measurement's noise floor is above the tripwire, the
-tripwire would only fire on weather.
+respected twice over: a key whose own recorded dispersion
+(``rel_spread``) exceeds the threshold on either side of the comparison
+is reported but never tripped — when the measurement's noise floor is
+above the tripwire, the tripwire would only fire on weather — and a key
+whose TRAILING HISTORY (the last same-methodology comparable runs)
+already spreads wider than the threshold is likewise reported, not
+tripped: within-run dispersion systematically understates run-to-run
+variance on a shared box (five windows seconds apart share the same
+weather; runs hours apart do not), and a key that historically swings
+2x with no code change cannot honestly gate a 10% move.  Deterministic
+keys (checkpoint bytes, seeded skew ratios) have flat histories and
+stay hard-guarded.
 
 Usage::
 
@@ -71,6 +79,14 @@ GUARDED = (
     # losing counts, which is the failure mode worth tripping on.
     ("shard.imbalance_ratio", True, None),
     ("shard.hot_key_share", True, None),
+    # key compaction: the whole round's reason to exist is the ratio —
+    # compacted over sorted, measured as the median of PAIRED windows
+    # (each round times both legs under the same instantaneous load),
+    # so the ratio's own recorded spread is the honest noise gate.
+    # hit_rate's hard 0.9 floor lives in check_bench_keys; this guards
+    # the SPEED.
+    ("compaction.speedup_vs_sorted", True,
+     "compaction.speedup_dispersion.rel_spread"),
 )
 
 
@@ -100,6 +116,12 @@ def comparable(cur: dict, prev: dict, path: str) -> bool:
         # the shard leg's skew numbers are seeded per tuple count
         # (BENCH_SHARD_TUPLES): a different stream is a different truth
         return dig(cur, "shard.tuples") == dig(prev, "shard.tuples")
+    if path.startswith("compaction."):
+        # the compaction A/B is seeded per batch width (cfg["cap"]):
+        # a different stream shape shifts the hot-set/overflow split
+        # and with it the honest speedup
+        return dig(cur, "compaction.tuples") == dig(prev,
+                                                    "compaction.tuples")
     return True
 
 
@@ -113,8 +135,37 @@ def pick_baseline(runs: list, cur: dict):
     return same[-1] if same else None
 
 
+#: trailing-history noise floor: how many prior same-methodology runs
+#: to consider, and how many are needed before history can vouch for a
+#: key (younger keys stay hard-guarded)
+HISTORY_WINDOW = 8
+HISTORY_MIN = 3
+
+
+def history_spread(runs: list, cur: dict, path: str):
+    """Relative spread ((max-min)/mean) of the guarded scalar over the
+    trailing window of same-methodology comparable runs BEFORE the run
+    under judgment; None when history is too short to vouch."""
+    vals = []
+    for r in runs[:-1]:
+        if r.get("methodology") != cur.get("methodology"):
+            continue
+        if not comparable(cur, r, path):
+            continue
+        v = dig(r, path)
+        if isinstance(v, (int, float)) and v:
+            vals.append(float(v))
+    vals = vals[-HISTORY_WINDOW:]
+    if len(vals) < HISTORY_MIN:
+        return None
+    mean = sum(vals) / len(vals)
+    return (max(vals) - min(vals)) / mean if mean else None
+
+
 def check_platform(platform: str, runs: list, threshold: float) -> list:
-    """[(path, change_pct, kind)] where kind is "regression" | "noisy"."""
+    """[(path, change_pct, kind)] where kind is "regression" | "noisy"
+    (own recorded dispersion above threshold) | "noisy_history"
+    (trailing run-over-run spread above threshold)."""
     if len(runs) < 2:
         return []
     cur = runs[-1]
@@ -140,8 +191,14 @@ def check_platform(platform: str, runs: list, threshold: float) -> list:
                 if isinstance(spread, (int, float)) \
                         and spread > threshold:
                     noisy = True
-        findings.append((path, round(100 * change, 1),
-                         "noisy" if noisy else "regression"))
+        kind = "regression"
+        if noisy:
+            kind = "noisy"
+        else:
+            hs = history_spread(runs, cur, path)
+            if hs is not None and hs > threshold:
+                kind = "noisy_history"
+        findings.append((path, round(100 * change, 1), kind))
     return findings
 
 
@@ -175,6 +232,11 @@ def main(argv=None) -> int:
                       f"moved {pct:+}% but its recorded dispersion "
                       f"exceeds the {threshold:.0%} threshold — noise "
                       "floor, not tripped")
+            elif kind == "noisy_history":
+                print(f"check_bench_regress: note [{platform}] {path} "
+                      f"moved {pct:+}% but its trailing run-over-run "
+                      f"spread already exceeds the {threshold:.0%} "
+                      "threshold — historical noise floor, not tripped")
             else:
                 tripped = True
                 print(f"check_bench_regress: "

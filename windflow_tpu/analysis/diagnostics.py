@@ -57,6 +57,16 @@ CODES = {
                        "axis"),
     "WF403": ("error", "merged upstream paths deliver unequal fixed "
                        "batch capacities"),
+    # key compaction (parallel/compaction.py, docs/PERF.md round 12):
+    # a declared-bounded reduce without a monoid runs the SORTED path —
+    # declared dense beats both sorting and the compacted remap
+    "WF404": ("warning", "bounded key space declared but no monoid "
+                         "combiner: the reduce takes the sorted path"),
+    # the declared kind REPLACES the combiner on every specialized stage
+    # (dense table, compacted remap, mesh collective) — a combiner that
+    # provably diverges from it leafwise silently changes results there
+    "WF405": ("warning", "declared monoid combiner diverges from the "
+                         "user combiner on at least one record leaf"),
     # -- watermarks / time (WF5xx) -------------------------------------------
     "WF501": ("error", "EVENT time policy requires a timestamp "
                        "extractor on every source"),

@@ -188,6 +188,7 @@ def check_graph(graph) -> List[Diagnostic]:
     _window_spec_pass(ops, diags)
     _capacity_pass(graph, upstreams, diags)
     _mesh_pass(graph, ops, edges, diags)
+    _compaction_pass(graph, ops, diags)
     _watermark_pass(graph, ops, upstreams, diags)
     _durability_pass(graph, ops, diags)
     _kernel_pass(graph, ops, edges, upstreams, diags)
@@ -400,7 +401,20 @@ def _mesh_pass(graph, ops, edges, diags) -> None:
     from windflow_tpu.ops.tpu_stateful import _StatefulTPUBase
     from windflow_tpu.windows.ffat_tpu import FfatWindowsTPU
     for op in ops:
-        if isinstance(op, FfatWindowsTPU) and op.max_keys % key_extent:
+        if isinstance(op, FfatWindowsTPU) and op.max_keys is None:
+            # compacted key space (withCompactedKeys): the remap table
+            # is single-chip device state — there is no per-shard slot
+            # ownership to shard the pane rings by (the graph build
+            # raises the same; reported here before any build work)
+            diags.append(Diagnostic(
+                "WF402",
+                f"operator '{op.name}': compacted key space "
+                "(withCompactedKeys) is single-chip; mesh execution "
+                "needs a declared dense key space",
+                node=op.name,
+                hint=f"declare withMaxKeys (a multiple of the key axis "
+                     f"{key_extent})"))
+        elif isinstance(op, FfatWindowsTPU) and op.max_keys % key_extent:
             diags.append(Diagnostic(
                 "WF402",
                 f"operator '{op.name}': max_keys {op.max_keys} not "
@@ -413,6 +427,130 @@ def _mesh_pass(graph, ops, edges, diags) -> None:
                 f"operator '{op.name}': num_key_slots {op.num_key_slots} "
                 f"not divisible by key axis {key_extent}",
                 node=op.name))
+
+
+_MONOID_PRIMS = {"add": "sum", "add_any": "sum", "max": "max", "min": "min"}
+
+
+def _monoid_comb_mismatches(comb, key_fn, monoid, spec) -> list:
+    """Leaves where the user combiner PROVABLY diverges from the declared
+    monoid (WF405), found structurally on the comb's jaxpr — abstract
+    tracing only, no device work.  Two classes, both zero-false-positive:
+    an output leaf passed through from ONE input unchanged (legal only
+    for the segment-constant key leaf under an idempotent max/min —
+    the blessed ``{"key": a["key"], ...}`` idiom; under "sum" the dense
+    scatter ADDS the equal keys), and a leaf combined by a recognized
+    monoid primitive of the WRONG kind.  Anything else is inconclusive
+    and stays silent — equivalence in general is the user's contract."""
+    import jax
+    closed = jax.make_jaxpr(comb)(spec, spec)
+    jaxpr = closed.jaxpr
+    leaves, _ = jax.tree_util.tree_flatten_with_path(spec)
+    n = len(leaves)
+    if len(jaxpr.invars) != 2 * n or len(jaxpr.outvars) != n:
+        return []
+    pos = {id(v): i for i, v in enumerate(jaxpr.invars)}
+    key_leaf = None
+    if key_fn is not None:
+        kj = jax.make_jaxpr(key_fn)(spec).jaxpr
+        if len(kj.outvars) == 1:
+            kpos = {id(v): i for i, v in enumerate(kj.invars)}
+            key_leaf = kpos.get(id(kj.outvars[0]))
+    made_by = {}
+    for eq in jaxpr.eqns:
+        for ov in eq.outvars:
+            made_by[id(ov)] = eq
+    out = []
+    for i, (path, _) in enumerate(leaves):
+        name = jax.tree_util.keystr(path) or "."
+        ov = jaxpr.outvars[i]
+        j = pos.get(id(ov))
+        if j is not None:
+            # passthrough is legal only at the segment-constant key
+            # LEAF ITSELF (output i IS the key leaf, copied from the
+            # same leaf of either input) under an idempotent kind — a
+            # key copied into a VALUE leaf diverges just the same
+            if monoid == "sum" or key_leaf is None \
+                    or i != key_leaf or j % n != i:
+                out.append((name, f"returns input {'ab'[j // n]}'s leaf "
+                                  "unchanged"))
+            continue
+        eq = made_by.get(id(ov))
+        if eq is None:
+            continue
+        kind = _MONOID_PRIMS.get(eq.primitive.name)
+        if kind is None or kind == monoid:
+            continue
+        operands = {pos.get(id(v)) for v in eq.invars}
+        if operands == {i, n + i}:
+            out.append((name, f"computes leafwise '{kind}'"))
+    return out
+
+
+def _compaction_pass(graph, ops, diags) -> None:
+    """Key-compaction advice (parallel/compaction.py, WF404): a keyed
+    reduce that DECLARED its key space bounded (``withMaxKeys``) but no
+    monoid still runs the sorted segmented path — the dense
+    scatter-combine table (and the compacted remap riding it) needs the
+    declared-monoid contract.  Declared dense beats compaction: the
+    user is one ``withMonoidCombiner`` away from the fast path, so say
+    so instead of silently sorting.
+
+    Also WF405: on every specialized stage the declared kind REPLACES
+    the combiner (docs/API.md "declared-monoid contract"), so a
+    combiner that provably diverges from it leafwise silently changes
+    results exactly where the declaration kicks in — newly urgent now
+    that key compaction routes UNDECLARED key spaces onto the monoid
+    path by default."""
+    from windflow_tpu.ops.tpu import ReduceTPU
+    in_specs = None
+    for op in ops:
+        if isinstance(op, ReduceTPU) and op.monoid in _MONOID_PRIMS.values():
+            if in_specs is None:
+                in_specs = propagate_specs(graph, ops=ops)[0]
+            spec = in_specs.get(id(op))
+            if spec is None:
+                continue
+            try:
+                bad = _monoid_comb_mismatches(
+                    op.comb, op.key_extractor, op.monoid, spec)
+            except Exception:  # noqa: BLE001 - lint: broad-except-ok (the
+                # probe must never block a run the runtime would accept;
+                # exotic-but-correct combiners simply go unchecked)
+                bad = []
+            for leaf, why in bad:
+                diags.append(Diagnostic(
+                    "WF405",
+                    f"operator '{op.name}': declared "
+                    f"withMonoidCombiner(\"{op.monoid}\") but the "
+                    f"combiner {why} at record leaf {leaf} — the dense/"
+                    "compacted/mesh stages compute the DECLARED "
+                    f"'{op.monoid}' there instead, silently diverging "
+                    "from the sorted path",
+                    node=op.name,
+                    hint="make the combiner leafwise "
+                         f"'{op.monoid}' on every field (a key leaf may "
+                         "pass through under idempotent max/min), or "
+                         "drop the declaration to keep the sorted "
+                         "path's semantics"))
+    for op in ops:
+        # mesh reduces are exempt: the sharded step's non-monoid variant
+        # runs the dense per-chip partial + gather fold, never the
+        # single-chip sorted path this warning prices
+        if isinstance(op, ReduceTPU) and op.key_extractor is not None \
+                and op.max_keys is not None and op.monoid is None \
+                and op.mesh is None:
+            diags.append(Diagnostic(
+                "WF404",
+                f"operator '{op.name}': withMaxKeys({op.max_keys}) "
+                "declares a bounded key space but no monoid combiner — "
+                "the reduce takes the sorted arbitrary-key path "
+                "(BENCH_r05: 3-42x slower than the dense table)",
+                node=op.name,
+                hint="declare withMonoidCombiner/withSumCombiner for "
+                     "the dense fast path; an undeclared key space "
+                     "with a monoid still compacts (Config."
+                     "key_compaction)"))
 
 
 def _source_wm_mode(op, time_policy, diags) -> str:

@@ -260,6 +260,31 @@ class Config:
     # Hot keys retained per keyed edge in the shard ledger's top-K table
     # (stats()["Shard"] hot_keys, the reshard advisor's move candidates).
     shard_topk: int = int(os.environ.get("WF_TPU_SHARD_TOPK", "8"))
+    # Device-side key compaction (parallel/compaction.py, docs/PERF.md
+    # round 12): keyed consumers over UNDECLARED int32 key spaces get a
+    # device-resident key→dense-slot remap table — hot keys run the
+    # dense scatter-combine / dense-slot stateful path, the cold tail
+    # falls back to the sorted lane inside the SAME program (zero extra
+    # dispatches), and the table is seeded from the shard plane's
+    # count-min/hot-key sketches plus an in-program miss-candidate
+    # ring.  Off removes the plane entirely: no compactor attaches and
+    # every step keeps one `is not None` check (micro-asserted by
+    # tests/test_key_compaction.py, same stance as the other planes).
+    key_compaction: bool = bool(int(os.environ.get(
+        "WF_TPU_KEY_COMPACTION", "1")))
+    # Dense slots per compacted consumer (the remap table capacity):
+    # hot keys get stable slots here; the cold tail overflows to the
+    # sorted lane.  Stateful/FFAT consumers use their own slot bound
+    # (num_key_slots / the compacted key space) instead.
+    key_compaction_slots: int = int(os.environ.get(
+        "WF_TPU_KEY_COMPACTION_SLOTS", "1024"))
+    # Remap reseed cadence in consumer batches: every N-th batch the
+    # compactor folds the sketch's hot candidates and the in-program
+    # miss ring into the table (evicting the coldest slots on a full
+    # table — the only churn source).  The only device sync the plane
+    # pays, at this cadence.
+    key_compaction_reseed: int = int(os.environ.get(
+        "WF_TPU_KEY_COMPACTION_RESEED", "64"))
     # Whole-chain fusion (windflow_tpu/fusion, docs/PERF.md round 10):
     # at graph build, maximal fusible runs of adjacent TPU operators
     # (the fusion advisor's plan — analysis/fusion.py) lower into ONE

@@ -231,7 +231,7 @@ def _diff_seq(what: str, a: list, b: list) -> str:
 # ---------------------------------------------------------------------------
 
 FAMILIES = ("window_cb", "window_tb", "reduce", "stateful",
-            "stateless_chain")
+            "stateless_chain", "window_compact")
 
 #: seeded determinism-VIOLATING families — cells that break the
 #: docs/DURABILITY.md replay contract ON PURPOSE, so the static and
@@ -248,11 +248,12 @@ DETERMINISM_FAMILIES = ("wallclock",)
 #: replicas count batches; the host reduce counts records)
 MID_WINDOW_AFTER = {"window_cb": 12, "window_tb": 12, "stateful": 12,
                     "stateless_chain": 12, "reduce": 3000,
-                    "wallclock": 12}
+                    "wallclock": 12, "window_compact": 12}
 
 #: the operator a mid_window kill targets, per family
 VICTIM = {"window_cb": "w", "window_tb": "w", "stateful": "st",
-          "stateless_chain": "f", "reduce": "red", "wallclock": "m"}
+          "stateless_chain": "f", "reduce": "red", "wallclock": "m",
+          "window_compact": "w"}
 
 
 def make_cell(family: str, ckpt_dir: str, *, fusion: bool = True,
@@ -328,6 +329,21 @@ def make_cell(family: str, ckpt_dir: str, *, fusion: bool = True,
                   else wb.withTBWindows(70, 35))
             pipe.add(wb.withKeyBy(lambda t: t["key"])
                      .withMaxKeys(keys).withName("w").build())
+            pipe.add_sink(KafkaSink(ser, broker, name="ksnk"))
+        elif family == "window_compact":
+            # compacted key space (parallel/compaction.py): the FFAT's
+            # pane rings index by REMAP slots, so this cell proves the
+            # remap table restores exactly — a replay under a different
+            # key→slot assignment would read the restored ring rows as
+            # the wrong keys and the record diff catches it.  Keys are
+            # deliberately arbitrary (sparse int32, not [0, keys)); the
+            # window is HOST-FED (keyed staging edge) so every key
+            # admits at the boundary — the compacted FFAT contract.
+            pipe.add(wf.Ffat_WindowsTPU_Builder(lambda t: t["value"],
+                                                lambda a, b: a + b)
+                     .withCBWindows(16, 8)
+                     .withKeyBy(lambda t: t["key"] * 131 + 7)
+                     .withCompactedKeys().withName("w").build())
             pipe.add_sink(KafkaSink(ser, broker, name="ksnk"))
         elif family == "stateful":
             pipe.add(wf.MapTPU_Builder(

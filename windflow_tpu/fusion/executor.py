@@ -81,7 +81,16 @@ def _tail_supported(op) -> bool:
     from windflow_tpu.ops.tpu import ReduceTPU
     from windflow_tpu.ops.tpu_stateful import _StatefulTPUBase
     from windflow_tpu.windows.ffat_tpu import FfatWindowsTPU
-    if isinstance(op, (FfatWindowsTPU, ReduceTPU)):
+    if isinstance(op, FfatWindowsTPU):
+        # compacted key spaces (withCompactedKeys, max_keys None) stay
+        # un-fused: their remap admits keys at the HOST staging boundary
+        # (parallel/compaction.py), and a prelude would move key
+        # extraction behind the chain where no host admission path can
+        # see it — a pinned table that never fills.  Compacted REDUCE
+        # tails fuse fine: their cold tail is the in-program sorted
+        # lane, so a slow-to-seed table costs speed, never records.
+        return op.max_keys is not None
+    if isinstance(op, ReduceTPU):
         return True
     if isinstance(op, _StatefulTPUBase):
         return bool(op.dense_keys)

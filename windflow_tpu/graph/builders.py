@@ -647,6 +647,21 @@ class Ffat_WindowsTPU_Builder(_WindowBuilderBase):
         self._max_keys = int(n)
         return self
 
+    def withCompactedKeys(self):
+        """ARBITRARY int32 keys via device-side key compaction
+        (parallel/compaction.py, docs/PERF.md round 12): the graph build
+        attaches a key→dense-slot remap table sized by
+        ``Config.key_compaction_slots``, so the dense pane rings work
+        without a declared key bound — new keys are admitted at the
+        host staging boundary (and from the in-program miss ring at
+        reseed cadence); keys beyond the slot budget are masked invalid
+        and counted, the operator's existing out-of-range contract.
+        Requires ``withKeyBy`` and ``Config.key_compaction`` on; a
+        declared ``withMaxKeys`` always beats compaction when the key
+        space is actually bounded (preflight WF404 says so)."""
+        self._max_keys = None
+        return self
+
     def withSumCombiner(self):
         """Declare the combiner leafwise ADDITION (``comb(a, b) == a + b``
         on every leaf — the same strictly-additive contract as

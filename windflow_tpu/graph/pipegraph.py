@@ -427,6 +427,16 @@ class PipeGraph:
             from windflow_tpu.monitoring.shard_ledger import ShardLedger
             self._shard = ShardLedger(self)
 
+        # 3f. key compaction (parallel/compaction.py): attach remap
+        # tables to qualifying keyed consumers and wire the feeding
+        # emitters for host admission / placement override — AFTER
+        # fusion (preludes installed, fused hosts known) and the shard
+        # plane (sketches exist to seed from), before anything compiles.
+        # Off attaches nothing: every step keeps one `is not None` check.
+        if getattr(cfg, "key_compaction", True):
+            from windflow_tpu.parallel.compaction import attach_compaction
+            attach_compaction(self)
+
         # sanity: every non-sink replica must have an emitter (fused
         # members are inert by design — the segment host emits for them)
         for op in self._operators:

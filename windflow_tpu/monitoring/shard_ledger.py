@@ -244,12 +244,17 @@ class ShardSketch:
                        % np.uint64(SKETCH_WIDTH)).astype(np.intp)
                 self.cms[i] += np.bincount(idx, minlength=SKETCH_WIDTH)
             step = max(1, n // CAND_PER_BATCH)
+            # per-batch rotating offset: a FIXED stride over periodic
+            # key layouts (every 8th lane is the cold key, say) would
+            # alias and sample the same phase forever, blinding the
+            # candidate pool to the other keys entirely
+            off = int((self.batches * 7) % step)
             with self._lock:
                 # candidate dict writes share the prune's lock: sibling
                 # replicas' emitters may update one consumer's sketch
                 # concurrently, and an unlocked insert during a prune's
                 # iteration would raise into the staging path
-                for k in keys[::step][:CAND_PER_BATCH]:
+                for k in keys[off::step][:CAND_PER_BATCH]:
                     self._cands[int(k)] = 0
             if len(self._cands) > _CAND_POOL_LIMIT:
                 self._prune_cands()
@@ -299,6 +304,39 @@ class ShardSketch:
         return int(min(
             c[i][int((h >> np.uint64(16 * i)) % np.uint64(SKETCH_WIDTH))]
             for i in range(SKETCH_DEPTH)))
+
+    def hot_candidates(self, limit: int) -> list:
+        """Top candidate keys with their load estimates, for the
+        key-compaction reseed (parallel/compaction.py): exact-histogram
+        sketches rank their dense counts; CMS sketches merge the host
+        candidate pool with every in-program site's ring and estimate
+        over the merged CMS.  Returns ``[(key, est_tuples), ...]``
+        ranked hottest-first, at most ``limit`` entries."""
+        if self.hist is not None:
+            body = self.hist[:self.max_keys]
+            order = np.argsort(body)[::-1][:limit]
+            return [(int(k), int(body[k])) for k in order if body[k] > 0]
+        cms = self.cms.copy()
+        with self._lock:
+            cands = set(self._cands)
+            cands.update(k for k in self._sampled
+                         if isinstance(k, (int, np.integer)))
+        for getter in self._device_states:
+            try:
+                st = getter()
+                if st is None:
+                    continue
+                cms = cms + np.asarray(st["cms"], np.int64)
+                ring = np.asarray(st["cand"], np.int64)
+            except Exception:  # lint: broad-except-ok (donated operand
+                # read racing the in-flight dispatch — skip the site for
+                # this read, the summary() stance)
+                continue
+            cands.update(int(k) for k in ring
+                         if k != np.iinfo(np.int32).min)
+        est = [(int(k), self._estimate(int(k), cms)) for k in cands]
+        est.sort(key=lambda kv: kv[1], reverse=True)
+        return est[:limit]
 
     def shard_of(self, key: int) -> int:
         from windflow_tpu.basic import stable_hash
@@ -429,14 +467,27 @@ class HostKeyProbe:
     applies host-side at batch granularity.  Any extractor failure
     disables the probe permanently (speculative-vectorization stance of
     ``KeyedDeviceStageEmitter.emit_columns``) — the pipeline must never
-    pay for a probe that cannot see."""
+    pay for a probe that cannot see.
 
-    __slots__ = ("sketch", "key_fn", "dead")
+    Doubles as the key-compaction admission point (``compactor``,
+    parallel/compaction.py): a host-fed compacted consumer admits every
+    key at this boundary, so its batches ship with a miss-free remap.
+    A probe failure deactivates the compactor too — the consumer falls
+    back to its legacy path instead of silently starving the table."""
 
-    def __init__(self, sketch: ShardSketch, key_fn) -> None:
+    __slots__ = ("sketch", "key_fn", "dead", "compactor")
+
+    def __init__(self, sketch: Optional[ShardSketch], key_fn,
+                 compactor=None) -> None:
         self.sketch = sketch
         self.key_fn = key_fn
+        self.compactor = compactor
         self.dead = False
+
+    def _fail(self) -> None:
+        self.dead = True
+        if self.compactor is not None:
+            self.compactor.deactivate()
 
     def columns(self, cols, n: int) -> None:
         if self.dead or n == 0:
@@ -445,12 +496,16 @@ class HostKeyProbe:
             k = np.asarray(self.key_fn(cols))
             if k.shape != (n,):
                 raise ValueError("extractor is not elementwise")
-            self.sketch.update_host(_key32_np(k))
+            k32 = _key32_np(k)
+            if self.compactor is not None:
+                self.compactor.observe(k32)
+            if self.sketch is not None:
+                self.sketch.update_host(k32)
         except Exception:  # lint: broad-except-ok (speculative probe of
             # an arbitrary user extractor over SoA columns — ANY failure
             # means "cannot see", and telemetry must never take the
             # staging path down)
-            self.dead = True
+            self._fail()
 
     def items(self, items) -> None:
         if self.dead or not items:
@@ -458,11 +513,15 @@ class HostKeyProbe:
         try:
             keys = np.fromiter((int(self.key_fn(it)) for it in items),
                                np.int64, count=len(items))
-            self.sketch.update_host(_key32_np(keys))
+            k32 = _key32_np(keys)
+            if self.compactor is not None:
+                self.compactor.observe(k32)
+            if self.sketch is not None:
+                self.sketch.update_host(k32)
         except Exception:  # lint: broad-except-ok (same stance as
             # columns(): a non-numeric or throwing extractor disables
             # the probe, never the staging path)
-            self.dead = True
+            self._fail()
 
 
 # ---------------------------------------------------------------------------
@@ -754,6 +813,12 @@ class ShardLedger:
                 s = load.get("hot_key_share")
                 if isinstance(s, (int, float)) and s > hot[0]:
                     hot = (s, op.name)
+            comp = op._compactor
+            if comp is not None:
+                # key compaction (parallel/compaction.py): remap table
+                # hit rate / overflow share / slot churn ride the shard
+                # section — the same per-consumer granularity as load
+                entry["compaction"] = comp.summary()
             st = self._statics.get(id(op)) or {}
             spec_bpt = st.get("bpt")
             bpt = (spec_bpt + LANE_BYTES_PER_TUPLE) \

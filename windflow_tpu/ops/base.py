@@ -287,6 +287,13 @@ class Operator:
     #: host op carries a FusedStatelessExec instead, dispatched through
     #: _TPUReplica._op_step (one attribute check per batch).
     _fusion_exec = None
+    #: device-side key compaction (parallel/compaction.py): non-None on
+    #: keyed consumers the graph build attached a KeyCompactor to —
+    #: their step resolves arbitrary int32 keys to dense slots through
+    #: the device-resident remap table.  None (Config.key_compaction
+    #: off, or a non-qualifying consumer) leaves exactly one
+    #: `is not None` check on the step path.
+    _compactor = None
 
     def __init__(self, name: str, parallelism: int,
                  routing: RoutingMode = RoutingMode.FORWARD,

@@ -296,9 +296,28 @@ class ReduceTPU(Operator):
         # surface the first observed drop loudly.  The cadence check reads
         # a device scalar enqueued 64 steps earlier (same lazy-read trick
         # as the FFAT regrow checkpoint), so the hot path never syncs.
+        # RETIRED under key compaction (PR 11): the compacted step routes
+        # out-of-range keys to the overflow/sorted lane instead of
+        # dropping them, so this path only exists for the
+        # WF_TPU_KEY_COMPACTION=0 kill switch.
         self._drop_warned = False
         self._drop_steps = 0
         self._pending_drop = None
+        # device-side key compaction (parallel/compaction.py): the
+        # accumulated hit/miss/candidate state threaded through the
+        # compacted step as one donated operand; _compactor itself is
+        # attached by the graph build (None = one check per batch)
+        self._cstats = None
+
+    def enable_compaction(self, comp) -> None:
+        """Attach a KeyCompactor (graph build, Config.key_compaction):
+        declared-monoid reduces over UNDECLARED int32 key spaces run the
+        dense scatter-combine path through the remap table, with the
+        cold tail on the sorted lane of the same program; declared
+        ``withMaxKeys`` reduces reroute out-of-range keys to that lane
+        instead of dropping them."""
+        self._compactor = comp
+        comp.register_device_stats(lambda: self._cstats)
 
     def _get_step(self, capacity: int, probe_batch=None):
         step = self._jit_steps.get(capacity)
@@ -402,6 +421,33 @@ class ReduceTPU(Operator):
             self._jit_steps[("dense", capacity)] = step
         return step
 
+    def _get_compacted_step(self, capacity: int):
+        """Compacted keyed reduce (parallel/compaction.py): remapped hot
+        keys scatter-combine into the dense slot table, the cold tail
+        runs the sorted lane, and the rank-merged output is bit-identical
+        to the sorted path's — one program, zero extra dispatches.  Also
+        the declared-``withMaxKeys`` variant (``bounded``): the identity
+        remap plus the overflow lane that retires the PR 1 silent-drop
+        path."""
+        step = self._jit_steps.get(("compact", capacity))
+        if step is None:
+            from windflow_tpu.parallel import compaction
+            bounded = self.max_keys is not None
+            step = compaction.make_compacted_reduce(
+                capacity,
+                self.max_keys if bounded else self._compactor.slots,
+                self.monoid, self.comb, self.key_extractor,
+                self._fused_prelude, bounded)
+            # the donated operand is the cstats state (last arg); the
+            # remap tables are read-only operands shared across steps
+            donate = (4,) if bounded else (6,)
+            step = wf_jit(step,
+                          op_name=f"{self._fused_name or self.name}"
+                                  ".compact",
+                          donate_argnums=donate)
+            self._jit_steps[("compact", capacity)] = step
+        return step
+
     def _get_sharded_step(self, capacity: int):
         step = self._jit_steps.get(("mesh", capacity))
         if step is None:
@@ -416,7 +462,12 @@ class ReduceTPU(Operator):
                 # remains the faster dense/psum variant for bounded keys.
                 step = make_sharded_reduce_arbitrary(
                     self.mesh, capacity, self.comb, self.key_extractor,
-                    op_name=f"{self.name}.mesh")
+                    op_name=f"{self.name}.mesh",
+                    # key compaction (parallel/compaction.py): the remap
+                    # overrides the owner hash per slot — hot keys
+                    # balanced over chips; built before the first batch,
+                    # so the cache key needs no variant tag
+                    remap=self._compactor is not None)
             else:
                 step = make_sharded_reduce_step(
                     self.mesh, capacity, K, self.comb, self.key_extractor,
@@ -440,12 +491,22 @@ class ReduceTPU(Operator):
     # only state worth a checkpoint is the accumulated drop counter the
     # stats layer reports.
     def snapshot_state(self):
-        if self._mesh_dropped is None:
-            return None
-        return {"kind": "reduce_tpu", "dropped": int(self._mesh_dropped)}
+        blob = {"kind": "reduce_tpu"}
+        if self._mesh_dropped is not None:
+            blob["dropped"] = int(self._mesh_dropped)
+        if self._compactor is not None:
+            # the remap table is operator state: a replay must rebuild
+            # the same key→slot assignment so hit/miss partitioning (and
+            # with it every device counter) evolves identically
+            blob["compactor"] = self._compactor.snapshot()
+        return blob if len(blob) > 1 else None
 
     def restore_state(self, blob):
-        self._mesh_dropped = jnp.asarray(blob["dropped"], jnp.int64)
+        if "dropped" in blob:
+            self._mesh_dropped = jnp.asarray(blob["dropped"], jnp.int64)
+        if blob.get("compactor") is not None \
+                and self._compactor is not None:
+            self._compactor.restore(blob["compactor"])
 
     def _maybe_warn_drops(self, n_drop: int) -> None:
         """One-time RuntimeWarning the first time the single-chip dense
@@ -466,6 +527,16 @@ class ReduceTPU(Operator):
 
     def dump_stats(self) -> dict:
         st = super().dump_stats()
+        comp = self._compactor
+        if comp is not None:
+            summary = comp.summary()
+            st["Key_compaction"] = summary
+            if comp.bounded and summary["overflow_tuples"]:
+                # compaction absorbed the PR 1 dense-path key drop: keys
+                # outside [0, max_keys) were REROUTED to the sorted
+                # overflow lane (kept, not dropped) and counted here
+                st["Out_of_range_keys_rerouted"] = \
+                    summary["overflow_tuples"]
         if self._mesh_dropped is not None:
             dropped = self.num_dropped_tuples()
             st["Out_of_range_keys_dropped"] = dropped
@@ -525,14 +596,47 @@ class ReduceTPU(Operator):
                 payload = prelude_out_spec(self._fused_prelude,
                                            batch.payload, batch.valid)
             self._check_comb_contract(payload)
+        comp = self._compactor
         if self.mesh is not None:
             # Sharded variant: dense per-chip partials combined over ICI;
             # output is a capacity-max_keys batch of distinct-key records.
-            table, ts_out, has, n_drop = self._get_sharded_step(
-                batch.capacity)(batch.payload, batch.ts, batch.valid)
+            step = self._get_sharded_step(batch.capacity)
+            if comp is not None and self.max_keys is None:
+                # arbitrary-key mesh reduce with a remap: the owner hash
+                # is overridden per slot (hot keys balanced over chips)
+                comp.on_batch()
+                tk, tsl = comp.tables()
+                table, ts_out, has, n_drop = step(
+                    batch.payload, batch.ts, batch.valid, tk, tsl)
+            else:
+                table, ts_out, has, n_drop = step(
+                    batch.payload, batch.ts, batch.valid)
             self._mesh_dropped = n_drop if self._mesh_dropped is None \
                 else self._mesh_dropped + n_drop
             return DeviceBatch(table, ts_out, has,
+                               watermark=batch.watermark, size=None,
+                               frontier=batch.frontier)
+        if comp is not None and self.monoid is not None \
+                and self.key_extractor is not None:
+            # compacted path (parallel/compaction.py): dense slots for
+            # the remapped hot keys + the sorted lane for the cold tail,
+            # in ONE program whose output matches the sorted path
+            # record-for-record
+            from windflow_tpu.parallel import compaction
+            comp.on_batch()
+            if self._cstats is None:
+                self._cstats = compaction.cstats_init()
+            step = self._get_compacted_step(batch.capacity)
+            if comp.bounded:
+                out_payload, out_ts, out_valid, self._cstats = step(
+                    batch.keys, batch.payload, batch.ts, batch.valid,
+                    self._cstats)
+            else:
+                tk, tsl = comp.tables()
+                out_payload, out_ts, out_valid, self._cstats = step(
+                    batch.keys, batch.payload, batch.ts, batch.valid,
+                    tk, tsl, self._cstats)
+            return DeviceBatch(out_payload, out_ts, out_valid,
                                watermark=batch.watermark, size=None,
                                frontier=batch.frontier)
         if self.monoid is not None and self.max_keys is not None:

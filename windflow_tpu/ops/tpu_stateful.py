@@ -243,6 +243,29 @@ class _StatefulTPUBase(Operator):
         self._interner = KeyInterner()
         self._extract = None
         self._steps = {}   # per-capacity program cache
+        # device-side key compaction (parallel/compaction.py): when the
+        # graph attaches a compactor (host-staged edges only), slots
+        # resolve IN-PROGRAM through the remap table and the per-batch
+        # D2H intern sync disappears; _cstats is the donated hit/miss
+        # state threaded through that program
+        self._cstats = None
+
+    def enable_compaction(self, comp) -> None:
+        """Attach a pinned KeyCompactor (graph build): the device-resident
+        interner.  Keys are admitted host-side at the staging boundary
+        (every key has a slot before its batch ships), the step resolves
+        slots with one in-program searchsorted, and the table raises on
+        overflow exactly like ``withNumKeySlots`` interning."""
+        self._compactor = comp
+        comp.register_device_stats(lambda: self._cstats)
+
+    def _adopt_compactor_mapping(self) -> None:
+        """Fallback after compactor deactivation (a speculative host
+        observation failed): fold the remap's key→slot dict into the
+        interner — slots were assigned contiguously in admission order,
+        so the intern path continues indexing the same state rows."""
+        comp, self._compactor = self._compactor, None
+        self._interner._ids.update(comp.export_mapping())
 
     # -- host-managed key→slot assignment -----------------------------------
     def _intern(self, uniq: np.ndarray) -> np.ndarray:
@@ -324,6 +347,33 @@ class _StatefulTPUBase(Operator):
             self._steps[capacity] = step
         return step
 
+    def _get_compact_step(self, capacity: int):
+        """Compacted slot resolution (parallel/compaction.py): the remap
+        tables ride the program as read-only operands and the whole step
+        stays one fully-async dispatch — no per-batch intern sync.  Miss
+        lanes (possible only for keys the host admission never saw) are
+        masked invalid and counted, the dense-key out-of-range
+        contract."""
+        step = self._steps.get(("compact", capacity))
+        if step is None:
+            from windflow_tpu.parallel import compaction
+            body = self._body(capacity)
+            key_fn = self.key_extractor
+
+            def step(state, payload, valid, keys, tk, tsl, cst):
+                if keys is None:
+                    keys = jax.vmap(key_fn)(payload).astype(jnp.int32)
+                slots, hit = compaction.lookup_slots(tk, tsl, keys, valid)
+                cst = compaction.cstats_update(cst, keys, hit,
+                                               valid & ~hit)
+                st, out, ov = body(state, payload, hit, slots)
+                return st, out, ov, cst
+
+            step = wf_jit(step, op_name=self._fused_name or self.name,
+                          donate_argnums=(0, 6))
+            self._steps[("compact", capacity)] = step
+        return step
+
     def key_space(self):
         # keys-lane plumbing for the shard ledger: dense extractors are
         # bounded by the slot table; interned key spaces are unbounded
@@ -342,11 +392,33 @@ class _StatefulTPUBase(Operator):
             "kind": "stateful_tpu",
             "state": jax.tree.map(np.asarray, self._state),
             "interner": dict(self._interner._ids),
+            # compacted runs: the remap IS the key→slot half of per-key
+            # state — restored so replays index the same table rows
+            "compactor": (self._compactor.snapshot()
+                          if self._compactor is not None else None),
         }
 
     def restore_state(self, blob):
         self._state = jax.tree.map(jnp.asarray, blob["state"])
         self._interner._ids = dict(blob["interner"])
+        cblob = blob.get("compactor")
+        if cblob is not None and self._compactor is not None:
+            self._compactor.restore(cblob)
+        elif cblob is not None:
+            # checkpoint taken under key compaction, restored with the
+            # plane OFF: the remap's key→slot dict is the key half of
+            # per-key state — fold it into the host interner so the
+            # restored table rows keep meaning the same keys (slots
+            # were assigned contiguously, the intern contract)
+            self._interner._ids.update(
+                {int(k): int(v) for k, v in cblob["key_slot"].items()})
+        elif self._compactor is not None and self._interner._ids:
+            # checkpoint taken WITHOUT compaction, restored with the
+            # plane ON: the restored interner owns the state rows — a
+            # fresh remap would assign CONFLICTING slots, so the
+            # operator keeps the host-interning path
+            self._compactor.deactivate()
+            self._compactor = None
 
     def _stateful_step(self, batch: DeviceBatch):
         cap = batch.capacity
@@ -356,6 +428,22 @@ class _StatefulTPUBase(Operator):
             # no interning: dispatch stays fully asynchronous
             return self._get_step(cap)(self._state, batch.payload,
                                        batch.valid, batch.keys)
+        comp = self._compactor
+        if comp is not None:
+            if not comp.active:
+                # a speculative host observation path died: fall back to
+                # interning, keeping the slots already assigned
+                self._adopt_compactor_mapping()
+            else:
+                from windflow_tpu.parallel import compaction
+                comp.on_batch()
+                if self._cstats is None:
+                    self._cstats = compaction.cstats_init()
+                tk, tsl = comp.tables()
+                st, out, ov, self._cstats = self._get_compact_step(cap)(
+                    self._state, batch.payload, batch.valid, batch.keys,
+                    tk, tsl, self._cstats)
+                return st, out, ov
         keys_dev, uniq_keys_dev, uniq_slots_dev = self._intern_batch(batch)
         return self._get_step(cap)(self._state, batch.payload, batch.valid,
                                    keys_dev, uniq_keys_dev, uniq_slots_dev)
@@ -389,6 +477,12 @@ class _StatefulTPUBase(Operator):
             np.concatenate([uniq_slots,
                             np.full(pad, self.num_key_slots, np.int32)]))
         return keys_dev, uniq_keys_dev, uniq_slots_dev
+
+    def dump_stats(self) -> dict:
+        st = super().dump_stats()
+        if self._compactor is not None:
+            st["Key_compaction"] = self._compactor.summary()
+        return st
 
     def _sharded_stateful_step(self, batch: DeviceBatch):
         """Mesh path: key-sharded state table, data-sharded batch, one

@@ -668,6 +668,14 @@ class KeyedDeviceStageEmitter(Emitter):
         #: keys (plain list appends) and bulk-updates every 256 tuples.
         self._sketch = None
         self._sk_buf = []
+        #: key compactor (parallel/compaction.py), attached by the graph
+        #: build when the consumer compacts: every key column admits at
+        #: this boundary (host-fed consumers see a miss-free remap), and
+        #: evictable compactors with placement_override route slotted
+        #: keys by ``slot % n`` instead of the splitmix hash — hot keys
+        #: balanced deterministically over the replicas.  None leaves
+        #: one check per emit path.
+        self._compactor = None
 
     def bind_observability(self, stats, ring, flight):
         super().bind_observability(stats, ring, flight)
@@ -687,8 +695,22 @@ class KeyedDeviceStageEmitter(Emitter):
         # scalar splitmix64 (bit-identical to the native/columnar path) —
         # pure int ops, no per-tuple FFI or array allocation
         k32 = self._key32(self.key_extractor(item))
-        h = splitmix64_int(k32)
-        self._inner[h % len(self.dests)].emit(item, ts, wm)
+        comp = self._compactor
+        d = None
+        if comp is not None:
+            try:
+                comp.observe_one(k32)
+                if comp.placement_override:
+                    d = comp.place_one(k32, len(self.dests))
+            except Exception:  # lint: broad-except-ok (admission is
+                # telemetry-adjacent host work: a compactor failure
+                # deactivates the plane, it must never take routing
+                # down — the HostKeyProbe stance)
+                comp.deactivate()
+                self._compactor = None
+        if d is None:
+            d = splitmix64_int(k32) % len(self.dests)
+        self._inner[d].emit(item, ts, wm)
         if self._sketch is not None:
             self._sk_buf.append(k32)
             if len(self._sk_buf) >= 256:
@@ -728,8 +750,25 @@ class KeyedDeviceStageEmitter(Emitter):
                 [self._key32(self.key_extractor(
                     {k: v[i].item() for k, v in cols.items()}))
                  for i in range(len(tss))], np.int64)
-        # native C hash+count partition (wf_host.cpp wf_keyby_partition)
-        dest, counts = native.keyby_partition(keys, n)
+        comp = self._compactor
+        if comp is not None:
+            try:
+                # admission BEFORE the batch ships: host-fed compacted
+                # consumers never see a remap miss
+                comp.observe(keys)
+            except Exception:  # lint: broad-except-ok (admission must
+                # never take routing down — the HostKeyProbe stance)
+                comp.deactivate()
+                comp = self._compactor = None
+        if comp is not None and comp.placement_override:
+            # remap placement: slotted (hot) keys go to slot % n — the
+            # same destinations the scalar emit path picks
+            dest = comp.place_np(keys, n)
+            counts = np.bincount(dest, minlength=n)
+        else:
+            # native C hash+count partition (wf_host.cpp
+            # wf_keyby_partition)
+            dest, counts = native.keyby_partition(keys, n)
         if self._sketch is not None:
             try:
                 # the key column + per-destination counts already exist
@@ -791,6 +830,12 @@ class DeviceKeyByEmitter(Emitter):
         #: check per batch
         self._sketch = None
         self._sk_state = None
+        #: key compactor (parallel/compaction.py) with placement
+        #: override, attached at graph build: the split program remaps
+        #: slotted keys to ``slot % n`` destinations (hot keys balanced
+        #: deterministically) with the cold tail on the splitmix hash —
+        #: the same placement the host keyed staging emitter applies
+        self._compactor = None
 
     def attach_shard_sketch(self, sketch) -> None:
         """Fold the shard-plane sketch update into the split program
@@ -798,6 +843,14 @@ class DeviceKeyByEmitter(Emitter):
         self._sketch = sketch
         self._splits = {}   # force the sketch variant at first compile
         sketch.register_device_state(lambda: self._sk_state)
+
+    def attach_compactor(self, comp) -> None:
+        """Fold the remap placement override into the split program
+        (called by the graph build, before any compile): the remap
+        tables ride as two read-only operands, re-passed unchanged in
+        steady state — zero extra dispatches."""
+        self._compactor = comp
+        self._splits = {}   # force the remap variant at first compile
 
     def _get_split(self, capacity: int):
         import jax
@@ -810,14 +863,24 @@ class DeviceKeyByEmitter(Emitter):
             if sketched:
                 from windflow_tpu.monitoring.shard_ledger import \
                     device_sketch_update
+            if self._compactor is not None:
+                from windflow_tpu.parallel.compaction import lookup_slots
 
-            def split(payload, ts, valid, keys, sk=None):
+            def split(payload, ts, valid, keys, sk=None, tk=None,
+                      tsl=None):
                 if keys is None:
                     keys = jax.vmap(key_fn)(payload).astype(jnp.int32)
                 # splitmix64 placement, bit-identical to the host staging
                 # emitter's — a keyed operator fed by both a host edge and
                 # a device edge must see each key on ONE replica
                 h = (_splitmix64_dev(keys) % jnp.uint64(n)).astype(jnp.int32)
+                if tk is not None:
+                    # compaction placement override: slotted keys place
+                    # by slot % n (the host keyed emitter's place_np),
+                    # the cold tail keeps the hash
+                    slot, hit = lookup_slots(tk, tsl, keys, valid)
+                    h = jnp.where(hit, (slot % jnp.int32(n))
+                                  .astype(jnp.int32), h)
                 dest = jnp.where(valid, h, jnp.int32(n))
                 # no per-destination sort or gather: consumers are
                 # mask-aware, so every destination shares the SAME
@@ -840,9 +903,17 @@ class DeviceKeyByEmitter(Emitter):
         return split
 
     def emit_device_batch(self, batch):
+        comp_args = ()
+        if self._compactor is not None:
+            comp_args = self._compactor.tables()
         if self._sketch is None:
-            keys, masks = self._get_split(batch.capacity)(
-                batch.payload, batch.ts, batch.valid, batch.keys)
+            if comp_args:
+                keys, masks = self._get_split(batch.capacity)(
+                    batch.payload, batch.ts, batch.valid, batch.keys,
+                    None, *comp_args)
+            else:
+                keys, masks = self._get_split(batch.capacity)(
+                    batch.payload, batch.ts, batch.valid, batch.keys)
         else:
             if self._sk_state is None:
                 from windflow_tpu.monitoring.shard_ledger import \
@@ -850,7 +921,7 @@ class DeviceKeyByEmitter(Emitter):
                 self._sk_state = device_sketch_init(len(self.dests))
             keys, masks, self._sk_state = self._get_split(batch.capacity)(
                 batch.payload, batch.ts, batch.valid, batch.keys,
-                self._sk_state)
+                self._sk_state, *comp_args)
         for d, mask in enumerate(masks):
             self._send(d, DeviceBatch(batch.payload, batch.ts, mask,
                                       keys=keys,

@@ -101,8 +101,13 @@ class FfatWindowsTPU(Operator):
     replica_class = FfatTPUReplica
     fixed_capacity_label = "FfatWindowsTPU"
 
+    #: compacted key space (parallel/compaction.py): True when the graph
+    #: build attached a KeyCompactor — ``max_keys`` then bounds the SLOT
+    #: space, not the user's (arbitrary int32) key space
+    _compact_keys = False
+
     def __init__(self, lift: Callable, comb: Callable, spec: WindowSpec, *,
-                 max_keys: int, name: str = "ffat_windows_tpu",
+                 max_keys: Optional[int], name: str = "ffat_windows_tpu",
                  parallelism: int = 1,
                  key_extractor: Optional[Callable] = None,
                  pane_capacity: Optional[int] = None,
@@ -116,7 +121,17 @@ class FfatWindowsTPU(Operator):
         self.lift = lift
         self.comb = comb
         self.spec = spec
+        #: None = compacted key space (withCompactedKeys): the graph
+        #: build assigns the slot bound via enable_compaction; running
+        #: without it (kill switch / no graph) fails at the first batch
+        #: with a clear message (see _ensure)
         self.max_keys = max_keys
+        if max_keys is None and key_extractor is None:
+            raise WindFlowError(
+                f"FfatWindowsTPU '{name}': a compacted key space "
+                "(withCompactedKeys) requires withKeyBy — non-keyed "
+                "windows use withMaxKeys(1)")
+        self._cstats = None
         self.P = math.gcd(spec.win_len, spec.slide)
         self.R = spec.win_len // self.P
         self.D = spec.slide // self.P
@@ -202,6 +217,19 @@ class FfatWindowsTPU(Operator):
         self._flushed = False
         self._eos_replicas = 0
 
+    def enable_compaction(self, comp) -> None:
+        """Attach a pinned KeyCompactor (graph build): arbitrary int32
+        keys map to stable dense slots through the device-resident remap
+        table, and ``max_keys`` becomes the SLOT bound — the pane rings
+        stay dense over [0, slots) exactly as under withMaxKeys.
+        Unmapped keys (host admission never saw them: device-born
+        streams before a reseed catches up) are masked invalid and
+        counted, the operator's existing out-of-range contract."""
+        self._compactor = comp
+        self._compact_keys = True
+        self.max_keys = comp.slots
+        comp.register_device_stats(lambda: self._cstats)
+
     # -- state layout --------------------------------------------------------
     def _init_state(self, agg_spec):
         if self.mesh is not None:
@@ -242,21 +270,52 @@ class FfatWindowsTPU(Operator):
                 self.lift, self.comb, self.key_extractor,
                 monoid=self.monoid, grouping=self._grouping(),
                 ingest=ingest, op_name=f"{self.name}.mesh")
+        comp = self._compactor
+        if comp is None:
+            lift, key_fn = self.lift, self.key_extractor
+        else:
+            # compacted key space: the kernel sees {"rec": record,
+            # "slot": dense id} lanes — the slot lane is resolved by the
+            # remap lookup in the wrapper below, inside this SAME program
+            user_lift = self.lift
+            lift = lambda r: user_lift(r["rec"])  # noqa: E731
+            key_fn = lambda r: r["slot"]          # noqa: E731
         if self.is_tb:
             step = make_ffat_tb_step(capacity, self.max_keys, self.P,
                                      self.R, self.D, self.NP,
-                                     self.lift, self.comb,
-                                     self.key_extractor,
+                                     lift, self.comb,
+                                     key_fn,
                                      drop_tainted=self.overflow_policy
                                      == "drop",
                                      grouping=self._grouping(),
                                      monoid=self.monoid)
         else:
             step = make_ffat_step(capacity, self.max_keys, self.P, self.R,
-                                  self.D, self.lift, self.comb,
-                                  self.key_extractor,
+                                  self.D, lift, self.comb,
+                                  key_fn,
                                   monoid=self.monoid,
                                   grouping=self._grouping())
+        if comp is not None:
+            from windflow_tpu.parallel import compaction
+            kernel = step
+            user_key = self.key_extractor
+
+            def step(state, payload, ts, valid, *rest):
+                # remap operands ride as (table_keys, table_slots, cstats)
+                # appended after the kernel's own args; cstats is the
+                # donated hit/miss/candidate state (zero extra dispatches)
+                *kargs, tk, tsl, cst = rest
+                raw = jax.vmap(user_key)(payload).astype(jnp.int32)
+                slots, hit = compaction.lookup_slots(tk, tsl, raw, valid)
+                cst = compaction.cstats_update(cst, raw, hit,
+                                               valid & ~hit)
+                outs = kernel(state, {"rec": payload, "slot": slots}, ts,
+                              valid & hit, *kargs)
+                out = dict(outs[1])
+                out["key"] = compaction.slots_to_user_keys(
+                    out["key"], tk, tsl)
+                outs = (outs[0], out) + tuple(outs[2:])
+                return (*outs, cst)
         prelude = self._fused_prelude
         if prelude is not None:
             # Whole-chain fusion (windflow_tpu/fusion): the fused
@@ -273,9 +332,13 @@ class FfatWindowsTPU(Operator):
         # State-only donation, fused or not: the ring is the program's
         # one input whose buffers an output aliases (window results have
         # their own shapes — batch-lane donation would elide nothing and
-        # XLA warns about unusable donations).
+        # XLA warns about unusable donations).  Compacted steps also
+        # donate the cstats operand (the sketch pattern).
+        donate = (0,)
+        if comp is not None:
+            donate = (0, 7 if self.is_tb else 6)
         return wf_jit(step, op_name=self._fused_name or self.name,
-                      donate_argnums=(0,))
+                      donate_argnums=donate)
 
     def _grouping(self) -> str:
         """Batch-grouping algorithm from the graph config (rank_scatter |
@@ -298,8 +361,46 @@ class FfatWindowsTPU(Operator):
     def _sidx(self, ridx: int) -> int:
         return ridx if self._per_replica_state else 0
 
+    def _run_step(self, sidx: int, payload, ts, valid, *kargs):
+        """Dispatch the compiled step, appending the compaction operands
+        (remap tables + donated cstats) when a compactor is attached;
+        updates the state (and cstats) and returns the kernel's
+        remaining outputs.  The un-compacted path pays one check."""
+        comp = self._compactor
+        if comp is None:
+            outs = self._jit_step(self._states[sidx], payload, ts, valid,
+                                  *kargs)
+            self._states[sidx] = outs[0]
+            return outs[1:]
+        if not comp.active:
+            # unlike the stateful plane there is NO lossless fallback
+            # for a compacted window (max_keys bounds the SLOT space):
+            # running on would silently mask every not-yet-admitted
+            # key's records forever, so fail loudly instead
+            raise WindFlowError(
+                f"FfatWindowsTPU '{self.name}': the compacted key space "
+                "lost its host admission path (the key extractor failed "
+                "on the staging probe, or admission errored) — declare "
+                "withMaxKeys or make the extractor batch-applicable")
+        from windflow_tpu.parallel import compaction
+        comp.on_batch()
+        if self._cstats is None:
+            self._cstats = compaction.cstats_init()
+        tk, tsl = comp.tables()
+        outs = self._jit_step(self._states[sidx], payload, ts, valid,
+                              *kargs, tk, tsl, self._cstats)
+        self._states[sidx] = outs[0]
+        self._cstats = outs[-1]
+        return outs[1:-1]
+
     def _ensure(self, batch: DeviceBatch, sidx: int):
         if self._capacity is None:
+            if self.max_keys is None:
+                raise WindFlowError(
+                    f"FfatWindowsTPU '{self.name}': compacted key space "
+                    "(withCompactedKeys) needs Config.key_compaction on "
+                    "and a graph build to assign slots; declare "
+                    "withMaxKeys to run without compaction")
             self._capacity = batch.capacity
             cap_by_mem = max(64, (1 << 23) // max(1, self.max_keys))
             # ceiling: purely the MEMORY bound on the dense [max_keys,
@@ -387,8 +488,8 @@ class FfatWindowsTPU(Operator):
             # propagated stamp: the step places every tuple of the batch
             # before firing, so the newest frontier is safe here and saves
             # one batch of firing lag (batch.py DeviceBatch.frontier).
-            self._states[sidx], out, fired, out_ts, _ = self._jit_step(
-                self._states[sidx], batch.payload, batch.ts, batch.valid,
+            out, fired, out_ts, _ = self._run_step(
+                sidx, batch.payload, batch.ts, batch.valid,
                 jnp.int64(self._wm_pane(batch.frontier)))
             # periodic host checkpoint (one sync every 32 steps, and at
             # EOS): an auto-sized ring REGROWS on overflow before the
@@ -400,8 +501,8 @@ class FfatWindowsTPU(Operator):
                 if self.overflow_policy == "error":
                     self._check_overflow()
         else:
-            self._states[sidx], out, fired, out_ts = self._jit_step(
-                self._states[sidx], batch.payload, batch.ts, batch.valid)
+            out, fired, out_ts = self._run_step(
+                sidx, batch.payload, batch.ts, batch.valid)
         # fired-window results inherit the input batch's flight-recorder
         # trace: the staged→sunk span then covers the whole window path
         return DeviceBatch(out, out_ts, fired,
@@ -417,7 +518,11 @@ class FfatWindowsTPU(Operator):
         self._flushed = True
         if self._jit_flush is None:
             self._jit_flush = self._build_flush()
-        out, fired, ts = self._jit_flush(self._states[0])
+        if self._compactor is not None:
+            out, fired, ts = self._jit_flush(self._states[0],
+                                             *self._compactor.tables())
+        else:
+            out, fired, ts = self._jit_flush(self._states[0])
         return [DeviceBatch(out, ts, fired, watermark=0, size=None)]
 
     def _flush_tb(self, ridx: int) -> list:
@@ -437,8 +542,8 @@ class FfatWindowsTPU(Operator):
         invalid = jnp.zeros(cap, bool)
         outs = []
         while True:
-            self._states[sidx], out, fired, out_ts, n_adv = self._jit_step(
-                self._states[sidx], self._payload_zero, ts0, invalid,
+            out, fired, out_ts, n_adv = self._run_step(
+                sidx, self._payload_zero, ts0, invalid,
                 jnp.int64(1 << 60))
             if bool(np.asarray(fired).any()):
                 outs.append(DeviceBatch(out, out_ts, fired, watermark=0,
@@ -684,6 +789,11 @@ class FfatWindowsTPU(Operator):
             "eos_replicas": self._eos_replicas,
             "payload_zero": (jax.tree.map(np.asarray, self._payload_zero)
                             if self._payload_zero is not None else None),
+            # compacted key space: the remap table is the key→pane-ring
+            # half of per-key state — snapshot it so a restored ring's
+            # rows keep meaning the same user keys
+            "compactor": (self._compactor.snapshot()
+                          if self._compactor is not None else None),
         }
 
     def restore_state(self, blob):
@@ -707,6 +817,9 @@ class FfatWindowsTPU(Operator):
         if blob["payload_zero"] is not None:
             self._payload_zero = jax.tree.map(jnp.asarray,
                                               blob["payload_zero"])
+        if blob.get("compactor") is not None \
+                and self._compactor is not None:
+            self._compactor.restore(blob["compactor"])
         self._capacity = blob["capacity"]
         self._jit_step = self._build_step(self._capacity)
 
@@ -750,7 +863,11 @@ class FfatWindowsTPU(Operator):
 
     def key_space(self):
         # keys-lane plumbing for the shard ledger: the dense pane state
-        # bounds the key space exactly where the compiled step does
+        # bounds the key space exactly where the compiled step does.
+        # Compacted key spaces are unbounded to ROUTING (the sketch sees
+        # raw keys; only the state is slot-dense), so they report None.
+        if self._compact_keys:
+            return None
         return self.max_keys if self.key_extractor is not None else None
 
     def num_dropped_tuples(self) -> int:
@@ -765,6 +882,8 @@ class FfatWindowsTPU(Operator):
             if self.replicas:
                 self.replicas[0].stats.inputs_ignored = n_late
         st = super().dump_stats()
+        if self._compactor is not None:
+            st["Key_compaction"] = self._compactor.summary()
         if n_late is not None:
             st["Late_tuples_dropped"] = n_late
             st["Pane_cells_evicted"] = self._tb_counter("n_evicted")
@@ -779,6 +898,19 @@ class FfatWindowsTPU(Operator):
                                            self.P, self.R, self.D,
                                            self.comb,
                                            op_name=f"{self.name}.flush")
-        return wf_jit(make_ffat_flush(self.max_keys, self.P, self.R,
-                                      self.D, self.comb),
-                      op_name=f"{self.name}.flush")
+        flush = make_ffat_flush(self.max_keys, self.P, self.R,
+                                self.D, self.comb)
+        if self._compactor is not None:
+            # compacted key space: partial-window records fired at EOS
+            # carry SLOT ids too — map them back through the same
+            # inverse table as the step's fired records
+            inner = flush
+
+            def flush(state, tk, tsl):
+                from windflow_tpu.parallel import compaction
+                out, fired, ts = inner(state)
+                out = dict(out)
+                out["key"] = compaction.slots_to_user_keys(
+                    out["key"], tk, tsl)
+                return out, fired, ts
+        return wf_jit(flush, op_name=f"{self.name}.flush")
