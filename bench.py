@@ -1416,6 +1416,83 @@ def main() -> None:
         # check_bench_keys loudly, not kill the bench artifact)
         result["shard_error"] = f"{type(e).__name__}: {e}"[:200]
 
+    # reshard-executor section (windflow_tpu/serving, guarded by
+    # tools/check_bench_keys.py + check_bench_regress.py): two legs.
+    # (1) live reshard — a seeded hash-colocated warm-key pair on a
+    # keyed host Reduce at parallelism 3 with the executor ON: the
+    # delta-window trigger fires, a move_keys plan applies through the
+    # quiesce barrier, and the leg reports the apply wall cost, the
+    # keys moved, and the post-reshard window imbalance (the number the
+    # move exists to repair).  (2) rescale restore — a chaos cell
+    # killed at 3 shards and restored at 2, timing the re-bucketing
+    # restore (durability/rebucket.py).  Both streams are
+    # deterministic: these are regression tripwires, not weather.
+    _rwork = None
+    try:
+        import dataclasses as _rdc
+        import tempfile as _tf
+
+        import windflow_tpu as wf
+        from windflow_tpu.basic import stable_hash as _sh64
+        _rn = int(os.environ.get("BENCH_RESHARD_TUPLES", "24000"))
+        _hot = [k for k in range(200) if _sh64(k) % 3 == 0][:2]
+
+        def _r_stream():
+            for i in range(_rn):
+                r = i % 20
+                k = _hot[0] if r < 5 else (
+                    _hot[1] if r < 10 else (i % 12))
+                yield {"key": k, "value": float(i % 97)}
+
+        def _r_red(item, state):
+            state["key"] = item["key"]
+            state["n"] = state.get("n", 0) + 1
+
+        _rcfg = _rdc.replace(wf.default_config)
+        _rcfg.reshard_executor = True
+        _rcfg.reshard_check_sweeps = 4
+        _rcfg.reshard_trigger_ticks = 2
+        _rcfg.reshard_ok_ticks = 2
+        _rcfg.reshard_imbalance_threshold = 1.6
+        _rcfg.punctuation_interval_usec = 10 ** 12
+        _rg = wf.PipeGraph("bench_reshard", config=_rcfg)
+        _rsrc = (wf.Source_Builder(_r_stream)
+                 .withOutputBatchSize(256).withName("rs_src").build())
+        _rred = (wf.Reduce_Builder(_r_red, dict)
+                 .withKeyBy(lambda t: t["key"]).withParallelism(3)
+                 .withName("rs_red").build())
+        _rg.add_source(_rsrc).add(_rred).add_sink(
+            wf.Sink_Builder(lambda t, ctx=None: None)
+            .withName("rs_snk").build())
+        _rg.run()
+        _rsec = _rg.stats()["Reshard"]
+        from windflow_tpu.durability import chaos as _rchaos
+        _rwork = _tf.mkdtemp(prefix="bench_reshard_")
+        _rv = _rchaos.run_rescale_ab(
+            "reduce", "mid_epoch", _rwork, shards_kill=3,
+            shards_restore=2,
+            n=int(os.environ.get("BENCH_RESCALE_TUPLES", "4096")))
+        if _rv["diff"] is not None:
+            raise RuntimeError(f"rescale cell diverged: {_rv['diff']}")
+        result["reshard"] = {
+            "plans_applied": _rsec["plans_applied"],
+            "keys_moved": _rsec["keys_moved"],
+            "plan_apply_ms": _rsec["quiesce_ms"],
+            "post_reshard_imbalance":
+                (_rsec["ops"].get("rs_red") or {}).get(
+                    "window_imbalance"),
+            "rescale_restore_ms": _rv["restore_ms"],
+            "tuples": _rn,
+        }
+    except Exception as e:  # lint: broad-except-ok (same stance as the
+        # durability/shard legs: a reshard regression must fail
+        # check_bench_keys loudly, not kill the bench artifact)
+        result["reshard_error"] = f"{type(e).__name__}: {e}"[:200]
+    finally:
+        if _rwork is not None:
+            import shutil as _sh
+            _sh.rmtree(_rwork, ignore_errors=True)
+
     # device-plane section (windflow_tpu/monitoring/jit_registry, guarded
     # by tools/check_bench_keys.py): the compile watcher's process totals
     # over every leg above — compile wall cost, recompile events (any

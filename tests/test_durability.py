@@ -110,6 +110,336 @@ def test_chaos_matrix_soak(tmp_path, family, point, fusion):
 
 
 # ---------------------------------------------------------------------------
+# kill-a-shard / restore-on-N±1 (rescale-on-restore)
+# ---------------------------------------------------------------------------
+
+def test_rescale_restore_reduce_fewer_and_more_shards(tmp_path):
+    """Kill the keyed host Reduce at 3 shards, restore at 2 AND at 4:
+    the per-replica per-key state dicts re-bucket through the new
+    placement (durability/rebucket.py) and the output stays per-key
+    record-for-record exact — chip failure and capacity change become
+    'restore on N±1'."""
+    for restore_p in (2, 4):
+        v = chaos.run_rescale_ab(
+            "reduce", "mid_epoch", str(tmp_path), shards_kill=3,
+            shards_restore=restore_p, n=4096)
+        assert v["diff"] is None, f"3->{restore_p}: {v['diff']}"
+        assert v["restored_epoch"] is not None
+        assert v["records"] == 4096
+
+
+def test_rescale_restore_window_cb_replicas(tmp_path):
+    """Keyed CB FFAT at parallelism 2 killed mid-epoch, restored at 3:
+    the shared pane-ring table is replica-independent (per-key clock
+    lanes), so the rescale is pure routing re-bucketing — fired windows
+    stay per-key exact."""
+    v = chaos.run_rescale_ab(
+        "window_cb", "mid_epoch", str(tmp_path), shards_kill=2,
+        shards_restore=3, n=4096)
+    assert v["diff"] is None, v["diff"]
+    assert v["restored_epoch"] is not None
+
+
+def test_rescale_restore_mesh_cb_fewer_chips(tmp_path):
+    """Multi-chip durable state: CB FFAT sharded over a 4-chip (virtual)
+    mesh, killed mid-epoch, restored onto a 2-chip mesh — the dense
+    key-sharded state re-places under the new key axis and every fired
+    window matches the uninterrupted 4-chip run per key.  (This is the
+    cell the old checkpoint.py mesh raise made impossible.)"""
+    from windflow_tpu.parallel.mesh import make_mesh
+    v = chaos.run_rescale_ab(
+        "window_cb", "mid_epoch", str(tmp_path), shards_kill=1,
+        shards_restore=1, mesh_kill=make_mesh(4),
+        mesh_restore=make_mesh(2), n=4096)
+    assert v["diff"] is None, v["diff"]
+    assert v["mesh"] == "1x4->1x2"
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("family,point,kill,restore", [
+    ("reduce", "mid_window", 3, 2),
+    ("reduce", "mid_window", 3, 4),
+    ("stateful", "mid_epoch", 2, 3),
+    ("stateful", "mid_window", 3, 2),
+    ("window_cb", "mid_window", 2, 3),
+    ("window_tb", "mid_epoch", 2, 3),
+    ("window_tb", "mid_epoch", 3, 2),
+])
+def test_rescale_matrix_replicas_soak(tmp_path, family, point, kill,
+                                      restore):
+    """The replica-rescale soak: every rescale family across kill
+    points and both directions (nightly leg; tools/wf_chaos.py
+    --rescale runs the same cells standalone).  window_tb exercises the
+    per-replica TB ring-clock agreement path."""
+    n = 4096 if family != "window_tb" else 6558
+    v = chaos.run_rescale_ab(family, point, str(tmp_path),
+                             shards_kill=kill, shards_restore=restore,
+                             n=n)
+    assert v["diff"] is None, v["diff"]
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("family,kk_kill,kk_restore", [
+    ("window_cb", 2, 4),
+    ("window_tb", 4, 2),
+    ("window_tb", 2, 4),
+])
+def test_rescale_matrix_mesh_soak(tmp_path, family, kk_kill, kk_restore):
+    """Mesh-shape rescale soak: CB and TB FFAT killed on one mesh and
+    restored on another (N±1 chips), TB covering the per-shard
+    scalar-clock-lane merge (durability/rebucket.py)."""
+    from windflow_tpu.parallel.mesh import make_mesh
+    n = 4096 if family != "window_tb" else 6558
+    v = chaos.run_rescale_ab(family, "mid_epoch", str(tmp_path),
+                             shards_kill=1, shards_restore=1,
+                             mesh_kill=make_mesh(kk_kill),
+                             mesh_restore=make_mesh(kk_restore), n=n)
+    assert v["diff"] is None, v["diff"]
+
+
+def test_rescale_refuses_torn_sink_fence_then_reconciles(tmp_path):
+    """The shard-count-changing exactly-once hole (the satellite
+    bugfix): a kill in the torn two-phase window leaves the broker
+    fence one epoch AHEAD of the manifest.  The fence dedupes by
+    replica-lifetime sequence — exact only while the replayed record
+    order matches, which a rescale breaks — so a shape-changing restore
+    must REFUSE with the reconciliation recipe, while the same-shape
+    restore reconciles through the seq dedupe exactly as before."""
+    cell = chaos.make_cell("reduce", str(tmp_path / "ck"), n=4096,
+                           parallelism=3)
+    with pytest.raises(WindFlowError, match="WF605.*fence"):
+        chaos.run_killed_and_restored(
+            cell["factory"],
+            chaos.default_kill("reduce", "mid_sink_flush"),
+            restore_factory=lambda: cell["factory"](parallelism=2))
+    # same cell, same-shape restore: the documented reconciliation
+    cell2 = chaos.make_cell("reduce", str(tmp_path / "ck2"), n=4096,
+                            parallelism=3)
+    g = chaos.run_killed_and_restored(
+        cell2["factory"], chaos.default_kill("reduce", "mid_sink_flush"))
+    assert g.stats()["Durability"]["dedupe_hits"] > 0
+
+
+def test_epoch_file_sink_rescale_overwrite_reconciles(tmp_path):
+    """EpochFileSink under a rescale restore: the idempotent
+    os.replace commit makes the file sink self-healing — a torn epoch
+    file is simply overwritten by the (re-interleaved) replay and the
+    committed concatenation stays per-key exact across the shard-count
+    change."""
+    import windflow_tpu as wf
+
+    from windflow_tpu.kafka.kafka_source import KafkaSource
+
+    def build(out_dir, ckpt, parallelism):
+        sink = EpochFileSink(out_dir)
+        broker = InMemoryBroker()
+        broker.create_topic("in", 1)
+        p = broker.producer()
+        for i in range(4096):
+            p.produce("in", {"key": i % 8, "value": float(i)},
+                      timestamp_usec=1_000 + i * 7)
+        p.produce("in", "EOS", timestamp_usec=1_000 + 4096 * 7)
+
+        def deser(msg, shipper):
+            if msg is None:
+                return True
+            if msg.value == "EOS":
+                return False
+            shipper.pushWithTimestamp(dict(msg.value),
+                                      msg.timestamp_usec)
+            return True
+
+        def factory(parallelism=parallelism):
+            cfg = dataclasses.replace(wf.default_config)
+            cfg.durability = ckpt
+            cfg.durability_epoch_sweeps = 3
+            cfg.punctuation_interval_usec = 10 ** 12
+            cfg.health_postmortem_on_crash = False
+
+            def red_fn(item, state):
+                state["key"] = item["key"]
+                state["n"] = state.get("n", 0) + 1
+
+            g = wf.PipeGraph("fsr", config=cfg)
+            src = KafkaSource(deser, broker, ["in"], group_id="fsr",
+                              name="ksrc", output_batch_size=256)
+            pipe = g.add_source(src)
+            pipe.add(wf.Reduce_Builder(red_fn, dict)
+                     .withKeyBy(lambda t: t["key"])
+                     .withParallelism(parallelism)
+                     .withName("red").build())
+            pipe.add_sink(wf.Sink_Builder(sink).withName("fs").build())
+            return g
+        return factory
+
+    fb = build(str(tmp_path / "out_a"), str(tmp_path / "ck_a"), 3)
+    chaos.run_baseline(fb)
+    fc = build(str(tmp_path / "out_b"), str(tmp_path / "ck_b"), 3)
+    chaos.run_killed_and_restored(
+        fc, chaos.KillSpec("mid_sink_flush", after=2),
+        restore_factory=lambda: fc(parallelism=2))
+    base = EpochFileSink.read_committed(str(tmp_path / "out_a"))
+    resc = EpochFileSink.read_committed(str(tmp_path / "out_b"))
+    assert chaos.diff_keyed_records([base], [resc]) is None
+
+
+def test_manifest_records_mesh_shape_and_placements(tmp_path):
+    """The checkpoint manifest pins the shard shape a rescale restores
+    against: mesh (None on a single chip) and the per-op override
+    placement summary."""
+    cell = chaos.make_cell("reduce", str(tmp_path / "ck"), n=2048,
+                           parallelism=2)
+    chaos.run_baseline(cell["factory"])
+    pending = load_checkpoint(str(tmp_path / "ck"))
+    assert "mesh" in pending["manifest"]
+    assert pending["manifest"]["mesh"] is None
+    assert "placements" in pending["manifest"]
+    assert pending["placements"] == {}
+
+
+def test_wf605_unrebucketable_state_refuses_rescale(tmp_path):
+    """A keyed operator checkpointing state of a kind the re-bucketer
+    does not know refuses a shape-changing restore with WF605 naming
+    the operator (static half of the rescale contract)."""
+    from windflow_tpu.analysis.preflight import manifest_rescale_plan
+
+    cell = chaos.make_cell("reduce", str(tmp_path / "ck"), n=2048,
+                           parallelism=3)
+    g = cell["factory"]()
+    # same composed graph, manifest claiming a different parallelism
+    ops = g._topo_operators()
+    red = [op for op in ops if op.name == "red"][0]
+    manifest = {"topology": [dict(s) for s in topology_signature(ops)],
+                "mesh": None}
+    manifest["topology"][ops.index(red)]["parallelism"] = 5
+    diags, rescaled = manifest_rescale_plan(g, manifest)
+    assert rescaled and not diags     # Reduce re-buckets: allowed
+
+    # an op whose class overrides snapshot_state with an unknown state
+    # kind has no re-bucketing rule — WF605, named.  (Manifest rebuilt
+    # after the swap: the type matches, only the parallelism differs.)
+    class _Custom(type(red)):
+        def snapshot_state(self):
+            return {"kind": "custom"}
+    red.__class__ = _Custom
+    manifest = {"topology": [dict(s) for s in topology_signature(ops)],
+                "mesh": None}
+    manifest["topology"][ops.index(red)]["parallelism"] = 5
+    diags, rescaled = manifest_rescale_plan(g, manifest)
+    assert rescaled
+    assert any(d.code == "WF605" for d in diags), diags
+
+
+def test_preflight_wf604_unrebucketable_keyed_op_on_mesh(tmp_path):
+    """Preflight names rescale-incompatible operators up front: a keyed
+    operator on a MESH checkpointing state of an unknown kind warns
+    WF604 at check() — before any restore ever trips over WF605."""
+    import windflow_tpu as wf
+    from windflow_tpu.ops.reduce_op import Reduce
+    from windflow_tpu.parallel.mesh import make_mesh
+
+    class _CustomReduce(Reduce):
+        def snapshot_state(self):
+            return {"kind": "custom"}
+
+    cfg = dataclasses.replace(wf.default_config)
+    cfg.durability = str(tmp_path / "ck")
+    cfg.mesh = make_mesh(2)
+    g = wf.PipeGraph("wf604", config=cfg)
+    src = wf.Source_Builder(
+        lambda: iter([{"key": i % 4, "value": 1.0} for i in range(64)])
+    ).withOutputBatchSize(32).build()
+    red = (wf.Reduce_Builder(lambda i, s: None, dict)
+           .withKeyBy(lambda t: t["key"]).withName("red").build())
+    red.__class__ = _CustomReduce
+    g.add_source(src).add(red).add_sink(
+        wf.Sink_Builder(lambda r: None).build())
+    diags = g.check()
+    assert any(d.code == "WF604" and "red" in d.message
+               for d in diags), [str(d) for d in diags]
+
+
+def test_rebucket_tb_clock_disagreement_raises():
+    """Dynamic half of the rescale contract: TB pane rings whose
+    per-shard clocks disagree at the barrier cannot merge — the
+    re-bucketer refuses with the reconciliation recipe instead of
+    re-firing or skipping windows."""
+    import numpy as np
+
+    from windflow_tpu.durability.rebucket import (RescaleError,
+                                                  rebucket_blob)
+
+    class _FakeTB:
+        name = "w"
+        max_keys = 8
+        is_tb = True
+        key_extractor = staticmethod(lambda t: t["key"])
+
+    def st(base):
+        return {"cells": np.zeros((8, 4), np.float32),
+                "cell_valid": np.zeros((8, 4), bool),
+                "horizon": np.full(8, -(1 << 60), np.int64),
+                "base": np.asarray(base, np.int64),
+                "win_next": np.asarray(0, np.int64),
+                "max_seen": np.asarray(0, np.int64),
+                "n_late": np.asarray(0, np.int64),
+                "n_evicted": np.asarray(0, np.int64),
+                "n_win_dropped": np.asarray(0, np.int64)}
+
+    blob = {"kind": "ffat_tpu", "states": {0: st(3), 1: st(7)},
+            "compactor": None}
+    with pytest.raises(RescaleError, match="clocks disagree"):
+        rebucket_blob(_FakeTB(), blob, 2, 3, None, None)
+
+
+def test_rebucket_compacted_override_translates_keys_to_slots():
+    """A live executor override is keyed by USER key (the domain the
+    emitters route by); a compacted ring's rows are SLOTS.  The
+    re-bucketer must translate through the checkpointed key→slot remap
+    so the overridden key's pane rows land on the shard its tuples
+    route to — not on ``slot % n``."""
+    import numpy as np
+
+    from windflow_tpu.durability.rebucket import rebucket_blob
+
+    class _FakeTB:
+        name = "w"
+        max_keys = 8
+        is_tb = True
+        key_extractor = staticmethod(lambda t: t["key"])
+
+    def st(mark_row=None):
+        cells = np.zeros((8, 4), np.float32)
+        valid = np.zeros((8, 4), bool)
+        if mark_row is not None:
+            cells[mark_row, 0] = 42.0
+            valid[mark_row, 0] = True
+        return {"cells": cells, "cell_valid": valid,
+                "horizon": np.full(8, -(1 << 60), np.int64),
+                "base": np.asarray(5, np.int64),
+                "win_next": np.asarray(2, np.int64),
+                "max_seen": np.asarray(9, np.int64),
+                "n_late": np.asarray(0, np.int64),
+                "n_evicted": np.asarray(0, np.int64),
+                "n_win_dropped": np.asarray(0, np.int64)}
+
+    # user key 100 compacts to slot 3; the executor had moved it to
+    # shard 2 pre-kill (its ring rows live there), then the graph
+    # rescales 3 → 4 shards with the override re-installed
+    blob = {"kind": "ffat_tpu",
+            "states": {0: st(), 1: st(), 2: st(mark_row=3)},
+            "compactor": {"key_slot": {100: 3}}}
+    out = rebucket_blob(_FakeTB(), blob, 3, 4, None, None,
+                        override={100: 2})
+    # without translation the override (user key 100) would never match
+    # row 3 and the rows would re-bucket to slot 3 % 4 == shard 3 —
+    # away from where the re-installed override routes the tuples
+    assert bool(out["states"][2]["cell_valid"][3, 0])
+    assert float(out["states"][2]["cells"][3, 0]) == 42.0
+    assert not bool(out["states"][3]["cell_valid"][3, 0])
+
+
+# ---------------------------------------------------------------------------
 # checkpoint protocol units
 # ---------------------------------------------------------------------------
 

@@ -1,0 +1,344 @@
+"""Reshard/failover executor (windflow_tpu/serving): the state machine
+that closes the shard-plane loop — BACKPRESSURED/imbalance triggers
+drive move_keys live through the quiesce→re-place→resume barrier (keyed
+state moving with the keys), split_hot_key becomes a pre-aggregating
+partial combine at the staging boundary, and when no plan helps,
+admission control throttles the sources.  Everything runs on a
+simulated (JAX_PLATFORMS=cpu) box; correctness is always asserted
+record-exactly against a pure-Python oracle — a reshard that loses or
+double-counts one record is a failed reshard, whatever its counters
+say."""
+
+import dataclasses
+
+import jax.numpy as jnp
+import pytest
+
+import windflow_tpu as wf
+from windflow_tpu.basic import stable_hash
+from windflow_tpu.durability.checkpoint import keyed_emitters_into
+
+N_SHARDS = 3
+
+
+def _cfg(**kw):
+    cfg = dataclasses.replace(wf.default_config)
+    cfg.reshard_executor = True
+    cfg.reshard_check_sweeps = 4
+    cfg.reshard_trigger_ticks = 2
+    cfg.reshard_ok_ticks = 2
+    cfg.reshard_imbalance_threshold = 1.6
+    # determinism: wall-clock punctuation moves batch boundaries
+    cfg.punctuation_interval_usec = 10 ** 12
+    for k, v in kw.items():
+        setattr(cfg, k, v)
+    return cfg
+
+
+def _colocated_keys(n_shards: int, shard: int, want: int = 2,
+                    upto: int = 200) -> list:
+    """Keys that the host keyby placement (stable_hash % n) lands on
+    ``shard`` — the seeded skew every test builds from."""
+    out = [k for k in range(upto)
+           if stable_hash(k) % n_shards == shard]
+    assert len(out) >= want
+    return out[:want]
+
+
+def _run_reduce_graph(stream_fn, cfg, parallelism=N_SHARDS):
+    """Host keyed Reduce graph: per-key running (count, sum) states —
+    the executor's move target whose state must re-home with the key."""
+    def red_fn(item, state):
+        state["key"] = item["key"]
+        state["n"] = state.get("n", 0) + 1
+        state["s"] = state.get("s", 0.0) + item["value"]
+
+    outs = []
+    g = wf.PipeGraph("reshard_t", config=cfg)
+    src = (wf.Source_Builder(stream_fn)
+           .withOutputBatchSize(256).build())
+    red = (wf.Reduce_Builder(red_fn, dict)
+           .withKeyBy(lambda t: t["key"])
+           .withParallelism(parallelism).withName("red").build())
+    snk = wf.Sink_Builder(
+        lambda r: outs.append(dict(r)) if r is not None else None).build()
+    g.add_source(src).add(red).add_sink(snk)
+    g.run()
+    return g, red, outs
+
+
+def _assert_reduce_exact(outs, stream_records):
+    per = {}
+    for t in stream_records:
+        k = t["key"]
+        n, s = per.get(k, (0, 0.0))
+        per[k] = (n + 1, s + t["value"])
+    final = {r["key"]: (r["n"], r["s"]) for r in outs}
+    for k, want in per.items():
+        assert final.get(k) == want, (k, final.get(k), want)
+
+
+# ---------------------------------------------------------------------------
+# off-path + section plumbing
+# ---------------------------------------------------------------------------
+
+def test_executor_off_by_default():
+    """Config.reshard_executor defaults OFF (the executor mutates
+    routing — opt-in, unlike the observe-only planes): no plane is
+    built and the stats section reports disabled."""
+    cfg = dataclasses.replace(wf.default_config)
+    assert cfg.reshard_executor is False
+    got = []
+    g = wf.PipeGraph("reshard_off", config=cfg)
+    src = wf.Source_Builder(
+        lambda: iter([{"key": i % 4, "value": 1.0} for i in range(512)])
+    ).withOutputBatchSize(128).build()
+    g.add_source(src).add_sink(wf.Sink_Builder(
+        lambda r: got.append(r) if r is not None else None).build())
+    g.run()
+    assert g._reshard is None
+    assert g.stats()["Reshard"] == {"enabled": False}
+    assert len(got) == 512
+
+
+# ---------------------------------------------------------------------------
+# BACKPRESSURED/imbalance -> move_keys -> recovered
+# ---------------------------------------------------------------------------
+
+def test_move_keys_separates_colocated_warm_keys():
+    """Two warm keys (25% each) hash-colocated on one shard: the
+    executor must trigger, apply a move_keys plan through the quiesce
+    barrier (re-homing the Reduce per-key state), and reach RECOVERED —
+    with every per-key aggregate exact."""
+    h1, h2 = _colocated_keys(N_SHARDS, 0)
+    N, KEYS = 24000, 12
+    records = []
+    for i in range(N):
+        r = i % 20
+        k = h1 if r < 5 else (h2 if r < 10 else (i % KEYS))
+        records.append({"key": k, "value": float(i % 97)})
+
+    g, red, outs = _run_reduce_graph(lambda: iter(records), _cfg())
+    rs = g.stats()["Reshard"]
+    assert rs["enabled"] and rs["plans_applied"] >= 1
+    assert rs["keys_moved"] >= 1
+    events = [e["event"] for e in rs["timeline"]]
+    assert "move_keys" in events
+    assert "recovered" in events
+    assert rs["recovery_ms"] is not None and rs["quiesce_ms"] is not None
+    # the override actually landed on the routing plane
+    ovs = [getattr(em, "_override", None)
+           for em in keyed_emitters_into(g, red)]
+    assert any(ovs), "no emitter carries the move override"
+    _assert_reduce_exact(outs, records)
+
+
+def test_zipf_shift_mid_run_migration():
+    """The millions-of-users regression: the hot pair MIGRATES mid-run
+    (phase 1 skews shard 0, phase 2 skews shard 1) and the executor
+    re-plans live — at least two applied plans, no process restart, and
+    the post-shift plan recovers with per-key exactness intact."""
+    p1 = _colocated_keys(N_SHARDS, 0)
+    p2 = _colocated_keys(N_SHARDS, 1)
+    N, KEYS = 40000, 12
+    records = []
+    for i in range(N):
+        hot = p1 if i < N // 2 else p2
+        r = i % 20
+        k = hot[0] if r < 5 else (hot[1] if r < 10 else (i % KEYS))
+        records.append({"key": k, "value": float(i % 89)})
+
+    g, red, outs = _run_reduce_graph(lambda: iter(records), _cfg())
+    rs = g.stats()["Reshard"]
+    assert rs["plans_applied"] >= 2, rs["timeline"]
+    moves = [e for e in rs["timeline"] if e["event"] == "move_keys"]
+    assert len(moves) >= 2
+    recovered = [e for e in rs["timeline"] if e["event"] == "recovered"]
+    assert recovered, "no recovery after the live migrations"
+    # throughput recovered: the graph ends un-throttled
+    assert rs["admission_factor"] == 1.0
+    _assert_reduce_exact(outs, records)
+
+
+# ---------------------------------------------------------------------------
+# hot key -> split -> pre-aggregating partial combine
+# ---------------------------------------------------------------------------
+
+def test_split_hot_key_partial_combine_on_monoid_reduce():
+    """A 60% hot key exceeds any shard's fair share — routing cannot
+    fix it; the executor must engage the split: a pre-aggregating
+    partial combine at the keyed staging boundary, absorbing hot-key
+    tuples into folded partials (preagg_folds) while the final per-key
+    aggregate stays exact (max monoid: idempotent, bit-exact)."""
+    N, KEYS, HOT = 24000, 8, 5
+
+    def key_of(i):
+        return HOT if i % 10 < 6 else (i % KEYS)
+
+    def v_of(i):
+        return -2.0 - ((i * 29) % 83) / 7.0
+
+    outs = []
+    g = wf.PipeGraph("split_t", config=_cfg(
+        reshard_imbalance_threshold=1.25))
+    src = wf.Source_Builder(
+        lambda: iter({"key": key_of(i), "v": v_of(i)}
+                     for i in range(N))).withOutputBatchSize(256).build()
+    red = (wf.ReduceTPU_Builder(
+        lambda a, b: {"key": jnp.maximum(a["key"], b["key"]),
+                      "v": jnp.maximum(a["v"], b["v"])})
+        .withKeyBy(lambda t: t["key"]).withMonoidCombiner("max")
+        .withParallelism(2).withMaxKeys(KEYS).withName("dred").build())
+    snk = wf.Sink_Builder(
+        lambda r: outs.append({"key": int(r["key"]), "v": float(r["v"])})
+        if r is not None else None).build()
+    g.add_source(src).add(red).add_sink(snk)
+    g.run()
+
+    rs = g.stats()["Reshard"]
+    assert rs["splits_applied"] >= 1, rs["timeline"]
+    assert rs["preagg_folds"] > 0
+    assert "split_hot_key" in [e["event"] for e in rs["timeline"]]
+    per = {}
+    for i in range(N):
+        k = key_of(i)
+        per[k] = max(per.get(k, -1e18), v_of(i))
+    got = {}
+    for r in outs:
+        got[r["key"]] = max(got.get(r["key"], -1e18), r["v"])
+    for k, v in per.items():
+        assert abs(got[k] - v) < 1e-6, (k, got.get(k), v)
+
+
+# ---------------------------------------------------------------------------
+# no plan helps -> admission control at the source
+# ---------------------------------------------------------------------------
+
+def test_no_plan_admission_control_degrades_and_holds_exactness():
+    """A dominant hot key on a HOST Reduce (no associative record
+    combiner, so the split tier is unavailable) leaves the executor no
+    applicable plan: it must degrade admission at the source (factor
+    halves, throttles counted) instead of thrashing moves — and the
+    stream still completes with exact per-key aggregates."""
+    N, KEYS, HOT = 20000, 8, 5
+    records = [{"key": HOT if i % 10 < 6 else (i % KEYS),
+                "value": float(i % 53)} for i in range(N)]
+    g, red, outs = _run_reduce_graph(
+        lambda: iter(records), _cfg(reshard_imbalance_threshold=1.25))
+    rs = g.stats()["Reshard"]
+    assert rs["admission_throttles"] >= 1, rs["timeline"]
+    admissions = [e for e in rs["timeline"] if e["event"] == "admission"]
+    assert any("throttled" in e["detail"] for e in admissions)
+    _assert_reduce_exact(outs, records)
+
+
+# ---------------------------------------------------------------------------
+# surfaces: OpenMetrics + postmortem/wf_doctor
+# ---------------------------------------------------------------------------
+
+def test_reshard_openmetrics_families_and_postmortem(tmp_path):
+    """The executor's counters ship as wf_reshard_* OpenMetrics
+    families (strict-parser clean) and as the postmortem bundle's
+    reshard.json, which wf_doctor renders and validates jax-free."""
+    import json
+    import os
+    import subprocess
+    import sys
+    h1, h2 = _colocated_keys(N_SHARDS, 0)
+    N = 16000
+    records = []
+    for i in range(N):
+        r = i % 20
+        k = h1 if r < 5 else (h2 if r < 10 else (i % 12))
+        records.append({"key": k, "value": 1.0})
+    g, red, outs = _run_reduce_graph(lambda: iter(records), _cfg())
+    stats = g.stats()
+    assert stats["Reshard"]["enabled"]
+
+    from windflow_tpu.monitoring.openmetrics import (parse_exposition,
+                                                     render_openmetrics)
+    text = render_openmetrics(stats)
+    fams = parse_exposition(text)
+    for fam in ("wf_reshard_plans_applied_total",
+                "wf_reshard_keys_moved_total",
+                "wf_reshard_admission_factor"):
+        assert fam in fams, fam
+
+    d = g.dump_postmortem(str(tmp_path / "bundle"), reason="test")
+    with open(os.path.join(d, "reshard.json")) as f:
+        rj = json.load(f)
+    assert rj["enabled"] and isinstance(rj["timeline"], list)
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    out = subprocess.run(
+        [sys.executable, os.path.join(repo, "tools", "wf_doctor.py"),
+         d, "--check"], capture_output=True, text=True)
+    assert out.returncode == 0, out.stdout + out.stderr
+    render = subprocess.run(
+        [sys.executable, os.path.join(repo, "tools", "wf_doctor.py"), d],
+        capture_output=True, text=True)
+    assert "Reshard" in render.stdout, render.stdout
+
+
+# ---------------------------------------------------------------------------
+# the state machine itself: health-BACKPRESSURED drives the transitions
+# ---------------------------------------------------------------------------
+
+def test_state_machine_backpressured_to_move_keys_to_recovered():
+    """The executor's own transitions, driven by synthetic health
+    verdicts (the trigger the ISSUE names): BACKPRESSURED ticks confirm
+    the trigger, the plan's move applies through the quiesce barrier,
+    and sustained OK closes the loop at RECOVERED→OK."""
+    import windflow_tpu.serving.executor as ex
+
+    records = [{"key": i % 6, "value": 1.0} for i in range(6000)]
+    g, red, outs = _run_reduce_graph(
+        lambda: iter(records),
+        # cadence far beyond the run: we tick by hand
+        _cfg(reshard_check_sweeps=10 ** 9))
+    x = g._reshard
+    assert x is not None and "red" in x._targets
+    move = {"kind": "move_keys",
+            "moves": [{"key": 0, "from_shard": 0, "to_shard": 1,
+                       "est_tuples": 10}]}
+    bp = {"red": {"state": "BACKPRESSURED"}}
+    plan_entry = {"op": "red", "loads": [100, 10, 10],
+                  "imbalance_ratio": 2.5, "hot_keys": [],
+                  "actions": [move]}
+    x._health_verdicts = lambda: bp
+    x._plan = lambda: {"ops": [plan_entry]}
+    tr = x._tracks["red"]
+    x.tick()
+    assert tr.state == ex.E_TRIGGERED
+    x.tick()
+    assert tr.state == ex.E_RECOVERING     # trigger_ticks=2 → applied
+    assert x.plans_applied == 1 and x.keys_moved == 1
+    x._health_verdicts = lambda: {"red": {"state": "OK"}}
+    # a finished graph's delta windows carry no signal (tri-state None
+    # holds position by design) — stub a balanced window so the
+    # recovery half of the machine is what this test exercises
+    x._delta_imbalance = lambda name, loads: 1.0
+    x.tick()
+    x.tick()
+    assert tr.state == ex.E_OK
+    assert [e["event"] for e in x.timeline][:3] == [
+        "triggered", "move_keys", "recovered"]
+
+
+# ---------------------------------------------------------------------------
+# scale-down on sustained OK
+# ---------------------------------------------------------------------------
+
+def test_scale_down_consolidates_on_sustained_ok():
+    """A balanced stream with scale-down enabled: after the sustained-OK
+    window the executor drains the least-loaded shard's known keys (or
+    records the drain candidate when none are known) — the
+    capacity-shrink half whose realization is a rescale restore."""
+    N, KEYS = 20000, 12
+    records = [{"key": i % KEYS, "value": 1.0} for i in range(N)]
+    g, red, outs = _run_reduce_graph(
+        lambda: iter(records),
+        _cfg(reshard_scale_down_ticks=3, reshard_check_sweeps=2))
+    rs = g.stats()["Reshard"]
+    assert rs["scale_down_events"] >= 1, rs["timeline"]
+    assert "scale_down" in [e["event"] for e in rs["timeline"]]
+    _assert_reduce_exact(outs, records)

@@ -114,6 +114,8 @@ SHARD_KEYS = ("imbalance_ratio", "hot_key_share", "ici_bytes_per_tuple")
 VERIFY_KEYS = ("findings", "check_ms")
 COMPACTION_KEYS = ("speedup_vs_sorted", "hit_rate", "overflow_share",
                    "churn_per_sweep")
+RESHARD_KEYS = ("plan_apply_ms", "rescale_restore_ms", "keys_moved",
+                "post_reshard_imbalance")
 
 
 def fail(msg: str) -> None:
@@ -146,7 +148,11 @@ def check_source() -> None:
             ("compaction", COMPACTION_KEYS,
              "key compaction — docs/PERF.md round 12"),
             ("durability", DURABILITY_KEYS,
-             "checkpoint/restore — docs/DURABILITY.md")):
+             "checkpoint/restore — docs/DURABILITY.md"),
+            ("reshard", RESHARD_KEYS,
+             "reshard executor + rescale restore — "
+             "docs/OBSERVABILITY.md reshard-executor / "
+             "docs/DURABILITY.md rescale-on-restore")):
         missing = [k for k in keys if f'"{k}"' not in src] \
             + ([] if f'"{section}"' in src else [section])
         if missing:
@@ -155,7 +161,7 @@ def check_source() -> None:
     print("check_bench_keys: OK (bench.py source emits "
           + ", ".join(KEYS + ("latency", "preflight", "verify", "device",
                               "health", "shard", "compaction", "fusion",
-                              "durability")) + ")")
+                              "durability", "reshard")) + ")")
 
 
 def last_json_object(path: str):
@@ -334,6 +340,28 @@ def check_output(path: str) -> None:
         # environmental failure mode — its absence IS the regression
         fail("bench durability section absent or errored "
              f"(durability_error={result.get('durability_error')!r})")
+    rsh = result.get("reshard")
+    if isinstance(rsh, dict):
+        missing = [k for k in RESHARD_KEYS if k not in rsh]
+        if missing:
+            fail(f"'reshard' section missing {missing} from bench "
+                 "output")
+        if not rsh.get("keys_moved"):
+            # the seeded colocated-warm-pair stream is deterministic:
+            # a leg that moved no keys means the trigger, the plan, or
+            # the apply path broke
+            fail("reshard leg moved no keys on the seeded "
+                 "colocated-warm-pair stream — the executor's "
+                 "trigger→plan→apply path broke")
+        pri = rsh.get("post_reshard_imbalance")
+        if isinstance(pri, (int, float)) and pri > 2.5:
+            fail(f"post_reshard_imbalance={pri} — the applied move did "
+                 "not repair the window imbalance on the seeded stream")
+    else:
+        # the reshard leg runs in-process on a seeded stream with no
+        # environmental failure mode — its absence IS the regression
+        fail("bench reshard section absent or errored "
+             f"(reshard_error={result.get('reshard_error')!r})")
     ver = result.get("verify")
     if isinstance(ver, dict):
         missing = [k for k in VERIFY_KEYS if k not in ver]

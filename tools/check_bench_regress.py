@@ -87,6 +87,15 @@ GUARDED = (
     # the SPEED.
     ("compaction.speedup_vs_sorted", True,
      "compaction.speedup_dispersion.rel_spread"),
+    # reshard executor: keys_moved is fully deterministic on the seeded
+    # colocated-warm-pair stream (trigger → advisor plan → apply), so
+    # any change is a planner/trigger regression.  plan_apply_ms /
+    # rescale_restore_ms are deliberately NOT guarded: both are short
+    # single-shot wall measurements (the apply includes a full graph
+    # quiesce, the restore an fsynced store replay) whose infra jitter
+    # exceeds the threshold — their sanity bounds live in
+    # check_bench_keys.
+    ("reshard.keys_moved", True, None),
 )
 
 
@@ -116,6 +125,10 @@ def comparable(cur: dict, prev: dict, path: str) -> bool:
         # the shard leg's skew numbers are seeded per tuple count
         # (BENCH_SHARD_TUPLES): a different stream is a different truth
         return dig(cur, "shard.tuples") == dig(prev, "shard.tuples")
+    if path.startswith("reshard."):
+        # the reshard leg's move count is seeded per tuple count
+        # (BENCH_RESHARD_TUPLES): a different stream plans differently
+        return dig(cur, "reshard.tuples") == dig(prev, "reshard.tuples")
     if path.startswith("compaction."):
         # the compaction A/B is seeded per batch width (cfg["cap"]):
         # a different stream shape shifts the hot-set/overflow split

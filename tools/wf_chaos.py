@@ -48,9 +48,35 @@ def run_cell(family: str, point: str, fusion: bool, records: int,
     return verdict
 
 
+def run_rescale_cells(families, records: int, workdir: str,
+                      with_mesh: bool) -> list:
+    """Kill-a-shard / restore-on-N±1 cells: each rescale family is
+    killed at its seeded shard count and restored on one fewer AND one
+    more shard; mesh families additionally restore across mesh shapes
+    (needs ≥4 visible devices — set
+    XLA_FLAGS=--xla_force_host_platform_device_count=8 on CPU)."""
+    from windflow_tpu.durability import chaos
+    out = []
+    for family in families:
+        for shards_restore in (2, 4):       # kill at 3: N-1 and N+1
+            out.append(chaos.run_rescale_ab(
+                family, "mid_epoch", workdir, shards_kill=3,
+                shards_restore=shards_restore, n=records))
+    if with_mesh:
+        from windflow_tpu.parallel.mesh import make_mesh
+        for family in chaos.MESH_RESCALE_FAMILIES:
+            for kk_kill, kk_restore in ((4, 2), (2, 4)):
+                out.append(chaos.run_rescale_ab(
+                    family, "mid_epoch", workdir, shards_kill=1,
+                    shards_restore=1, mesh_kill=make_mesh(kk_kill),
+                    mesh_restore=make_mesh(kk_restore), n=records))
+    return out
+
+
 def main(argv=None) -> int:
     from windflow_tpu.durability.chaos import (DETERMINISM_FAMILIES,
-                                               FAMILIES, KILL_POINTS)
+                                               FAMILIES, KILL_POINTS,
+                                               RESCALE_FAMILIES)
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--family", choices=FAMILIES + DETERMINISM_FAMILIES,
                     action="append",
@@ -63,6 +89,9 @@ def main(argv=None) -> int:
     ap.add_argument("--fusion", choices=("on", "off", "both"),
                     default="both")
     ap.add_argument("--records", type=int, default=4096)
+    ap.add_argument("--rescale", choices=("on", "off"), default="on",
+                    help="also run the kill-a-shard / restore-on-N±1 "
+                         "rescale cells (per-key record diff)")
     ap.add_argument("--workdir", default=None,
                     help="directory for checkpoint stores / sink files "
                          "(default: a fresh tempdir)")
@@ -108,11 +137,40 @@ def main(argv=None) -> int:
                           f"restored_epoch={v['restored_epoch']} "
                           f"dedupe={v['dedupe_hits']}"
                           + ("" if ok else f"\n     {v['diff']}"))
+    if args.rescale == "on":
+        import jax
+        rescale_fams = [f for f in RESCALE_FAMILIES if f in families]
+        if args.family and not rescale_fams:
+            print("wf_chaos: none of the selected families "
+                  f"({families}) has a rescale cell "
+                  f"(rescale families: {list(RESCALE_FAMILIES)})",
+                  file=sys.stderr)
+        # mesh cells ride only the FULL matrix: a named-family run is a
+        # targeted replica-rescale repro
+        with_mesh = not args.family and len(jax.devices()) >= 4
+        if not args.family and not with_mesh:
+            print("wf_chaos: <4 devices visible — skipping the mesh "
+                  "rescale cells (set XLA_FLAGS="
+                  "--xla_force_host_platform_device_count=8)",
+                  file=sys.stderr)
+        for v in run_rescale_cells(rescale_fams, args.records, workdir,
+                                   with_mesh):
+            results.append(v)
+            ok = v["diff"] is None
+            failed += 0 if ok else 1
+            if not args.json:
+                shape = v["mesh"] or v["shards"]
+                print(f"{'OK  ' if ok else 'FAIL'} "
+                      f"{v['family']:<16} {v['point']:<15} "
+                      f"rescale={shape:<8} records={v['records']:<6} "
+                      f"restored_epoch={v['restored_epoch']}"
+                      + ("" if ok else f"\n     {v['diff']}"))
     if args.json:
         json.dump(results, sys.stdout, indent=1)
         print()
     n_det = sum(1 for v in results if v.get("expected_fail_dynamic"))
-    n_eo = len(results) - n_det
+    n_rescale = sum(1 for v in results if v.get("rescale"))
+    n_eo = len(results) - n_det - n_rescale
     if failed:
         print(f"wf_chaos: FAIL — {failed}/{len(results)} cell(s) "
               "violated their contract (exactly-once cells must hold; "
@@ -120,6 +178,8 @@ def main(argv=None) -> int:
               file=sys.stderr)
         return 1
     print(f"wf_chaos: OK — {n_eo} cell(s) held exactly-once"
+          + (f", {n_rescale} rescale (kill-a-shard / restore-on-N±1) "
+             "cell(s) held per-key exact" if n_rescale else "")
           + (f", {n_det} determinism cell(s) diverged as seeded"
              if n_det else "")
           + f" (workdir {workdir})")

@@ -41,7 +41,11 @@ SECTIONS = ("stats.json", "events.json", "health.json", "device.json",
 #: sections newer writers add; validated when present, but their absence
 #: must not reject a bundle written before they existed (same schema) —
 #: this tool's job is exactly the historical crash bundle
-OPTIONAL_SECTIONS = ("sweep.json", "durability.json", "shard.json")
+OPTIONAL_SECTIONS = ("sweep.json", "durability.json", "shard.json",
+                     "reshard.json")
+#: reshard executor timeline events (windflow_tpu/serving/executor.py)
+RESHARD_EVENTS = ("triggered", "move_keys", "split_hot_key", "admission",
+                  "recovered", "scale_down", "move_skipped")
 
 
 class BundleError(Exception):
@@ -206,6 +210,28 @@ def validate(bundle: dict) -> None:
             raise BundleError(
                 f"durability.json: restored_epoch must be an integer "
                 f"or null, got {ep!r}")
+    rsh = sections.get("reshard.json") or {}
+    if rsh.get("enabled") and "error" not in rsh:
+        for key in ("plans_applied", "keys_moved", "splits_applied",
+                    "admission_throttles", "preagg_folds"):
+            v = rsh.get(key)
+            if not isinstance(v, int) or v < 0:
+                raise BundleError(
+                    f"reshard.json: {key!r} must be a non-negative "
+                    f"integer, got {v!r}")
+        af = rsh.get("admission_factor")
+        if not isinstance(af, (int, float)) or not 0 < af <= 1:
+            raise BundleError(
+                f"reshard.json: admission_factor must be in (0, 1], "
+                f"got {af!r}")
+        tl = rsh.get("timeline")
+        if not isinstance(tl, list):
+            raise BundleError("reshard.json: timeline must be a list")
+        for e in tl:
+            if not isinstance(e, dict) \
+                    or e.get("event") not in RESHARD_EVENTS:
+                raise BundleError(
+                    f"reshard.json: illegal timeline entry {e!r}")
 
 
 def diagnose(bundle: dict) -> dict:
@@ -262,10 +288,25 @@ def diagnose(bundle: dict) -> dict:
             "dedupe_hits": dur.get("dedupe_hits"),
             "dir": dur.get("dir"),
         }
+    rsh = sections.get("reshard.json") or {}
+    reshard = None
+    if rsh.get("enabled") and "error" not in rsh:
+        reshard = {
+            "plans_applied": rsh.get("plans_applied"),
+            "keys_moved": rsh.get("keys_moved"),
+            "splits_applied": rsh.get("splits_applied"),
+            "preagg_folds": rsh.get("preagg_folds"),
+            "admission_factor": rsh.get("admission_factor"),
+            "quiesce_ms": rsh.get("quiesce_ms"),
+            "recovery_ms": rsh.get("recovery_ms"),
+            "ops": rsh.get("ops") or {},
+            "timeline": rsh.get("timeline") or [],
+        }
     return {
         "app": manifest.get("app"),
         "reason": manifest.get("reason"),
         "durability": durability,
+        "reshard": reshard,
         "written_at_usec": manifest.get("written_at_usec"),
         "graph_state": health.get("graph_state"),
         "stall_events": health.get("stall_events", 0),
@@ -378,6 +419,23 @@ def render_text(d: dict) -> str:
                    "store"
                    if du["restored_epoch"] is not None else
                    "restartable with PipeGraph.restore() on this store"))
+    if d.get("reshard"):
+        r = d["reshard"]
+        lines.append(
+            f"  Reshard executor: {r['plans_applied']} plan(s) applied "
+            f"({r['keys_moved']} key(s) moved, {r['splits_applied']} "
+            f"split(s), {r['preagg_folds']} tuple(s) pre-aggregated), "
+            f"admission factor {r['admission_factor']}"
+            + (f", last quiesce {r['quiesce_ms']} ms" if r.get(
+                "quiesce_ms") is not None else "")
+            + (f", recovery {r['recovery_ms']} ms" if r.get(
+                "recovery_ms") is not None else ""))
+        if r["timeline"]:
+            lines.append("  reshard timeline:")
+            for e in r["timeline"][-10:]:
+                lines.append(
+                    f"    t={e.get('t_usec')}: {e.get('op')} "
+                    f"{e.get('event')} — {e.get('detail')}")
     if d["section_errors"]:
         lines.append(f"  degraded sections: {d['section_errors']}")
     return "\n".join(lines)

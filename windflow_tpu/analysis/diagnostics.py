@@ -80,6 +80,17 @@ CODES = {
                        "manifest topology"),
     "WF603": ("warning", "operator holds cross-batch state the "
                          "checkpoint cannot capture"),
+    # rescale-on-restore (durability/rebucket.py, docs/DURABILITY.md
+    # "Multi-chip checkpoints & rescale-on-restore"): a restore onto a
+    # different mesh shape / shard count re-buckets keyed state through
+    # the operator's declared key space or compaction remap — operators
+    # providing neither refuse the shape change
+    "WF604": ("warning", "keyed operator on a mesh checkpoints state "
+                         "with no declared key space or compaction "
+                         "remap: a shape-changing restore cannot "
+                         "re-bucket it"),
+    "WF605": ("error", "restore manifest shard shape cannot be "
+                       "re-bucketed onto the target graph"),
     # -- determinism for replay (WF61x, wfverify — analysis/tracecheck.py):
     #    kernels and callbacks of a durability-enabled graph must
     #    regenerate the committed prefix identically on replay

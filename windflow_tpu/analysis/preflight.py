@@ -229,6 +229,13 @@ def _durability_pass(graph, ops, diags) -> None:
     from windflow_tpu.io.device_source import DeviceSource
     from windflow_tpu.kafka.kafka_source import KafkaSource
     from windflow_tpu.ops.source import Source
+    on_mesh = graph.config.mesh is not None
+    # on a mesh the same gaps also block rescale-on-restore: state the
+    # checkpoint never captured (or a replay that diverges) cannot be
+    # re-bucketed onto a different shard shape either
+    mesh_tail = (" — on a mesh this also makes the operator "
+                 "rescale-incompatible (restore on N±1 shards replays "
+                 "through the checkpoint)") if on_mesh else ""
     for op in ops:
         if isinstance(op, Source):
             if isinstance(op, KafkaSource):
@@ -242,7 +249,7 @@ def _durability_pass(graph, ops, diags) -> None:
                 "after a restore (no offsets to seek, "
                 "wall-clock/ingress timestamps re-stamp on replay) — "
                 "restored runs will diverge from the checkpointed "
-                "stream position",
+                "stream position" + mesh_tail,
                 node=op.name,
                 hint="feed checkpointed graphs from a Kafka source or "
                      "an EVENT-time DeviceSource (withTimestampFn / "
@@ -252,22 +259,72 @@ def _durability_pass(graph, ops, diags) -> None:
                 "WF603",
                 f"operator '{op.name}' ({type(op).__name__}) holds "
                 "cross-batch state the checkpoint cannot capture — a "
-                "restore silently resets it",
+                "restore silently resets it" + mesh_tail,
                 node=op.name,
                 hint="use the TPU window/stateful operators "
                      "(FfatWindowsTPU, StatefulMapTPU, Reduce) for "
                      "checkpointed graphs"))
+        elif on_mesh and op.key_extractor is not None \
+                and _checkpoints_unrebucketable_state(op):
+            # rescale-on-restore re-buckets keyed state through the
+            # known state kinds (durability/rebucket.py: dense key
+            # spaces, compaction remaps, shared slot tables); a keyed
+            # operator checkpointing state of an unknown kind offers no
+            # re-bucketing rule, so a shape-changing restore will
+            # refuse with WF605
+            diags.append(Diagnostic(
+                "WF604",
+                f"keyed operator '{op.name}' ({type(op).__name__}) on "
+                "a mesh checkpoints state with no re-bucketing rule "
+                "(no declared key space or compaction remap) — a "
+                "restore onto a different mesh shape will refuse with "
+                "WF605",
+                node=op.name,
+                hint="use the built-in keyed operators (FfatWindowsTPU, "
+                     "StatefulMapTPU, ReduceTPU, Reduce) for rescalable "
+                     "checkpoints, or keep the mesh shape fixed"))
 
 
-def manifest_conflicts(graph, manifest) -> List[Diagnostic]:
+def _checkpoints_unrebucketable_state(op) -> bool:
+    """True when the operator overrides ``snapshot_state`` (it
+    checkpoints something) but is none of the kinds
+    ``durability/rebucket.py`` knows how to re-bucket."""
+    from windflow_tpu.ops.base import Operator
+    impl = type(op).snapshot_state
+    if impl is Operator.snapshot_state:
+        return False    # stateless: nothing to re-bucket
+    from windflow_tpu.ops.reduce_op import Reduce
+    from windflow_tpu.ops.tpu import ReduceTPU
+    from windflow_tpu.ops.tpu_stateful import _StatefulTPUBase
+    from windflow_tpu.windows.ffat_tpu import FfatWindowsTPU
+    # identity on the IMPLEMENTATION, not the class: a subclass that
+    # overrides snapshot_state checkpoints a kind the re-bucketer has
+    # never seen, however familiar its base class is
+    known = {Reduce.snapshot_state, ReduceTPU.snapshot_state,
+             FfatWindowsTPU.snapshot_state,
+             _StatefulTPUBase.snapshot_state}
+    return impl not in known
+
+
+def manifest_conflicts(graph, manifest,
+                       allow_rescale: bool = False) -> List[Diagnostic]:
     """WF602: named diff between a composed (possibly unbuilt) graph and
     a checkpoint manifest's topology signature — the gate
     ``PipeGraph.restore()`` runs before touching any state.  Empty list
-    means the restore may proceed."""
+    means the restore may proceed.
+
+    ``allow_rescale`` (the ``manifest_rescale_plan`` path) exempts the
+    two supported shape changes from WF602: a parallelism difference on
+    a KEYED non-terminal, non-source operator (restore on N±1 replica
+    shards) and the mesh shape recorded in the manifest (restore on
+    N±1 chips) — both re-bucket state through
+    ``durability/rebucket.py`` instead of refusing."""
     from windflow_tpu.durability.checkpoint import topology_signature
+    from windflow_tpu.ops.source import Source
     diags: List[Diagnostic] = []
     want = manifest.get("topology") or []
-    have = topology_signature(graph._topo_operators())
+    ops = graph._topo_operators()
+    have = topology_signature(ops)
     if len(want) != len(have):
         diags.append(Diagnostic(
             "WF602",
@@ -279,17 +336,75 @@ def manifest_conflicts(graph, manifest) -> List[Diagnostic]:
     for i, (w, h) in enumerate(zip(want, have)):
         for field in ("name", "type", "parallelism", "routing",
                       "is_tpu", "record_spec"):
-            if w.get(field) != h.get(field):
-                diags.append(Diagnostic(
-                    "WF602",
-                    f"operator #{i} {field} differs: checkpoint has "
-                    f"{w.get(field)!r} ('{w.get('name')}'), graph has "
-                    f"{h.get(field)!r} ('{h.get('name')}')",
-                    node=h.get("name"),
-                    hint="restore needs the same composition that wrote "
-                         "the checkpoint (names, types, parallelism, "
-                         "record specs)"))
+            if w.get(field) == h.get(field):
+                continue
+            op = ops[i]
+            if allow_rescale and field == "parallelism" \
+                    and op.key_extractor is not None \
+                    and not op.is_terminal \
+                    and not isinstance(op, Source):
+                continue    # keyed replica rescale: re-bucketable
+            hint = ("restore needs the same composition that wrote "
+                    "the checkpoint (names, types, parallelism, "
+                    "record specs)")
+            if field == "parallelism":
+                hint += ("; only keyed non-terminal operators may "
+                         "change parallelism on a rescale restore")
+            diags.append(Diagnostic(
+                "WF602",
+                f"operator #{i} {field} differs: checkpoint has "
+                f"{w.get(field)!r} ('{w.get('name')}'), graph has "
+                f"{h.get(field)!r} ('{h.get('name')}')",
+                node=h.get("name"), hint=hint))
     return diags
+
+
+def manifest_rescale_plan(graph, manifest):
+    """Restore-time validation with rescale awareness: returns
+    ``(diagnostics, rescaled)``.  Blocking diagnostics are WF602
+    (genuine topology mismatch) and WF605 (a shape change the state
+    cannot re-bucket: an operator of unknown state kind — the static
+    half; dynamic refusals like disagreeing TB ring clocks raise
+    :class:`~windflow_tpu.durability.rebucket.RescaleError` when the
+    blobs are applied).  ``rescaled`` is True when any supported shape
+    change (keyed parallelism or mesh shape) is in effect."""
+    from windflow_tpu.durability.rebucket import mesh_shape
+    diags = manifest_conflicts(graph, manifest, allow_rescale=True)
+    want = manifest.get("topology") or []
+    ops = graph._topo_operators()
+    rescaled = False
+    if len(want) == len(ops):
+        for i, (w, op) in enumerate(zip(want, ops)):
+            if w.get("parallelism") == op.parallelism:
+                continue
+            rescaled = True
+            if _checkpoints_unrebucketable_state(op):
+                diags.append(Diagnostic(
+                    "WF605",
+                    f"operator '{op.name}' ({type(op).__name__}) "
+                    f"changes parallelism "
+                    f"{w.get('parallelism')} → {op.parallelism} but "
+                    "checkpoints state with no re-bucketing rule",
+                    node=op.name,
+                    hint="restore on the checkpointed shard shape, or "
+                         "use the built-in keyed operators"))
+    old_mesh = manifest.get("mesh")
+    new_mesh = mesh_shape(graph.config.mesh)
+    if old_mesh != new_mesh:
+        rescaled = True
+        for op in ops:
+            if op.key_extractor is not None \
+                    and _checkpoints_unrebucketable_state(op):
+                diags.append(Diagnostic(
+                    "WF605",
+                    f"mesh shape changes {old_mesh} → {new_mesh} but "
+                    f"keyed operator '{op.name}' "
+                    f"({type(op).__name__}) checkpoints state with no "
+                    "re-bucketing rule",
+                    node=op.name,
+                    hint="restore on the checkpointed mesh shape, or "
+                         "use the built-in keyed operators"))
+    return diags, rescaled
 
 
 def _structural_pass(graph, ops, edges, diags) -> None:

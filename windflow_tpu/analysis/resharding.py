@@ -132,6 +132,16 @@ def _rebalance_actions(row: dict, threshold: float) -> List[dict]:
     return actions
 
 
+def rebalance_actions(row: dict, threshold: float = DEFAULT_THRESHOLD
+                      ) -> List[dict]:
+    """Public form of the per-operator action builder: given one
+    :func:`imbalance` row (loads + hot-key table), emit the
+    move_keys/split_hot_key actions — used by the reshard executor
+    (windflow_tpu/serving) when its delta-window trigger fires before
+    the cumulative ratio crosses the plan threshold."""
+    return _rebalance_actions(row, threshold)
+
+
 def plan(shard_section: dict, graph_name: Optional[str] = None,
          threshold: float = DEFAULT_THRESHOLD, top: int = 0) -> dict:
     """The reshard plan (the ``analysis/fusion.plan`` shape): keyed

@@ -324,6 +324,40 @@ class Config:
     # tombstoned (LogKV auto-compaction reclaims the log space).
     durability_keep: int = int(os.environ.get(
         "WF_TPU_DURABILITY_KEEP", "2"))
+    # Reshard/failover executor (windflow_tpu/serving, docs/OBSERVABILITY.md
+    # "Reshard executor"): closes the shard-plane loop — health-plane
+    # BACKPRESSURED verdicts / sustained imbalance drive the reshard
+    # advisor's move_keys plans live (quiesce → re-place the key→shard
+    # override → resume, keyed state moved with the keys), split_hot_key
+    # becomes a pre-aggregating partial combine at the keyed staging
+    # boundary, and when no plan can help, admission control throttles the
+    # sources instead of letting inboxes grow without bound.  Default OFF:
+    # unlike the observe-only planes, the executor MUTATES routing —
+    # opt in per deployment (WF_TPU_RESHARD=1).  Off leaves one
+    # `is not None` check per sweep (micro-asserted).
+    reshard_executor: bool = bool(int(os.environ.get(
+        "WF_TPU_RESHARD", "0")))
+    # Executor tick cadence in scheduler sweeps (each tick reads the
+    # health verdicts + shard section — cadence-rate work, never per
+    # batch) and the state-machine thresholds: consecutive bad ticks
+    # before a plan applies, consecutive good ticks before an applied
+    # plan counts as recovered (and admission control backs off).
+    reshard_check_sweeps: int = int(os.environ.get(
+        "WF_TPU_RESHARD_CHECK_SWEEPS", "32"))
+    reshard_trigger_ticks: int = int(os.environ.get(
+        "WF_TPU_RESHARD_TRIGGER_TICKS", "2"))
+    reshard_ok_ticks: int = int(os.environ.get(
+        "WF_TPU_RESHARD_OK_TICKS", "4"))
+    # Imbalance ratio (max shard load / mean) above which the executor
+    # treats an operator as degraded even without a health verdict —
+    # the advisor's own actionability threshold.
+    reshard_imbalance_threshold: float = float(os.environ.get(
+        "WF_TPU_RESHARD_IMBALANCE", "1.25"))
+    # Sustained-OK ticks before the executor consolidates keys off the
+    # least-loaded shard (scale-down via the same quiesce→re-place
+    # path).  0 (default) records scale-down candidates without acting.
+    reshard_scale_down_ticks: int = int(os.environ.get(
+        "WF_TPU_RESHARD_SCALE_DOWN_TICKS", "0"))
     # Multi-chip execution: a jax.sharding.Mesh with ("data", "key") axes
     # (see windflow_tpu.parallel.mesh.make_mesh).  When set, staging emitters
     # lay batches out data-sharded across the mesh and mesh-aware TPU
@@ -352,6 +386,17 @@ def stable_hash(key) -> int:
     if isinstance(key, bytes):
         return zlib.crc32(key)
     return hash(key)
+
+
+def int32_key(k) -> int:
+    """Wrap a numeric key to the int32 value the device state collapses
+    to (keyed device extractors cast to int32 on chip).  THE canonical
+    copy: keyed routing (parallel/emitters.py), compaction admission,
+    the reshard executor's state moves, and rescale re-bucketing
+    (durability/rebucket.py) must all collapse exactly the same keys,
+    or one logical key would straddle shards."""
+    i = int(k) & 0xFFFFFFFF
+    return i - (1 << 32) if i >= (1 << 31) else i
 
 
 def current_time_usecs() -> int:

@@ -15,15 +15,21 @@ with sinks that neither lose nor duplicate a record across the restart.
   ``PipeGraph.restore()``.
 * :mod:`windflow_tpu.durability.sinks` — :class:`EpochFileSink`, the
   stage-then-atomic-rename exactly-once file sink.
+* :mod:`windflow_tpu.durability.rebucket` — shape-changing restore:
+  re-bucket keyed state blobs between shard shapes (keyed parallelism
+  N±1, mesh N±1 chips, single-chip ↔ mesh) through the placement the
+  keys route by (docs/DURABILITY.md "rescale-on-restore").
 * :mod:`windflow_tpu.durability.chaos` — the failure-injection harness
-  (seeded kills, restore, record-for-record A/B diff) driven by
+  (seeded kills, restore — including kill-a-shard / restore-on-N±1
+  rescale cells — record-for-record A/B diff) driven by
   ``tools/wf_chaos.py`` and ``tests/test_durability.py``.
 """
 
 from windflow_tpu.durability.checkpoint import (CHECKPOINT_SCHEMA,
                                                 DurabilityPlane,
-                                                restore_graph)
+                                                quiesce, restore_graph)
+from windflow_tpu.durability.rebucket import RescaleError, rebucket_blob
 from windflow_tpu.durability.sinks import EpochFileSink
 
 __all__ = ["CHECKPOINT_SCHEMA", "DurabilityPlane", "restore_graph",
-           "EpochFileSink"]
+           "quiesce", "RescaleError", "rebucket_blob", "EpochFileSink"]

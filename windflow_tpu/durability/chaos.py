@@ -150,11 +150,18 @@ def abandon(graph) -> None:
 
 
 def run_killed_and_restored(factory: Callable[[], object],
-                            spec: KillSpec):
+                            spec: KillSpec,
+                            restore_factory: Optional[Callable] = None):
     """Start the factory's graph, arm the kill, drive to the crash,
     restore a fresh instance from the checkpoint store, and drive it to
     completion.  Returns the completed (restored) graph.  Raises if the
-    kill never fired — a chaos cell that does not kill proves nothing."""
+    kill never fired — a chaos cell that does not kill proves nothing.
+
+    ``restore_factory`` (kill-a-shard / restore-on-N±1 cells) builds
+    the RESTORED graph on a different shard shape — keyed parallelism
+    or mesh — exercising the rescale-on-restore re-bucketing
+    (durability/rebucket.py) under the same record-for-record
+    contract."""
     g = factory()
     g.start()
     arm(g, spec)
@@ -168,7 +175,7 @@ def run_killed_and_restored(factory: Callable[[], object],
         raise WindFlowError(
             f"chaos kill {spec} never fired — the run completed; "
             "lower `after` or feed more data")
-    g2 = factory()
+    g2 = (restore_factory or factory)()
     g2.restore(g2.config.durability)
     g2.wait_end()
     return g2
@@ -259,10 +266,13 @@ VICTIM = {"window_cb": "w", "window_tb": "w", "stateful": "st",
 def make_cell(family: str, ckpt_dir: str, *, fusion: bool = True,
               out_dir: Optional[str] = None, n: int = 4096,
               keys: int = 8, app: str = "chaos",
-              epoch_sweeps: int = 3) -> dict:
+              epoch_sweeps: int = 3, parallelism: int = 1,
+              mesh=None) -> dict:
     """One isolated chaos cell: its own in-memory broker pre-filled with
     a deterministic event-time stream, a graph factory (re-invocable:
-    the chaos path builds the graph twice), and an output reader.
+    the chaos path builds the graph twice; it also accepts
+    ``parallelism=``/``mesh=`` overrides so a rescale cell can restore
+    the same cell on a different shard shape), and an output reader.
     Returns ``{"factory", "read", "broker"}``.
 
     Determinism contract (docs/DURABILITY.md): EVENT-time records,
@@ -303,11 +313,12 @@ def make_cell(family: str, ckpt_dir: str, *, fusion: bool = True,
         from windflow_tpu.durability.sinks import EpochFileSink
         file_sink = EpochFileSink(out_dir)
 
-    def factory():
+    def factory(parallelism: int = parallelism, mesh=mesh):
         cfg = _dc.replace(wf.default_config)
         cfg.durability = ckpt_dir
         cfg.durability_epoch_sweeps = epoch_sweeps
         cfg.whole_chain_fusion = fusion
+        cfg.mesh = mesh
         # determinism: interval punctuation reads the wall clock, which
         # would move batch boundaries between runs
         cfg.punctuation_interval_usec = 10 ** 12
@@ -328,6 +339,7 @@ def make_cell(family: str, ckpt_dir: str, *, fusion: bool = True,
             wb = (wb.withCBWindows(16, 8) if family == "window_cb"
                   else wb.withTBWindows(70, 35))
             pipe.add(wb.withKeyBy(lambda t: t["key"])
+                     .withParallelism(parallelism)
                      .withMaxKeys(keys).withName("w").build())
             pipe.add_sink(KafkaSink(ser, broker, name="ksnk"))
         elif family == "window_compact":
@@ -358,6 +370,7 @@ def make_cell(family: str, ckpt_dir: str, *, fusion: bool = True,
             pipe.add(wf.MapTPU_Builder(st_fn)
                      .withInitialState({"n": 0, "s": 0.0})
                      .withKeyBy(lambda t: t["key"])
+                     .withParallelism(parallelism)
                      .withNumKeySlots(keys).withDenseKeys()
                      .withName("st").build())
             pipe.add_sink(KafkaSink(ser, broker, name="ksnk"))
@@ -369,6 +382,7 @@ def make_cell(family: str, ckpt_dir: str, *, fusion: bool = True,
 
             pipe.add(wf.Reduce_Builder(red_fn, dict)
                      .withKeyBy(lambda t: t["key"])
+                     .withParallelism(parallelism)
                      .withName("red").build())
             pipe.add_sink(KafkaSink(ser, broker, name="ksnk"))
         elif family == "wallclock":
@@ -414,6 +428,120 @@ def default_kill(family: str, point: str) -> KillSpec:
     if point == "mid_sink_flush":
         return KillSpec(point, after=2)
     return KillSpec(point, after=6)
+
+
+# ---------------------------------------------------------------------------
+# kill-a-shard / restore-on-N±1 (rescale) cells
+# ---------------------------------------------------------------------------
+
+#: families whose keyed operator rescales across REPLICA shard counts
+#: (kill at parallelism P, restore at P±1); stateless_chain has no
+#: keyed operator and window_compact's remap already rides the blob
+RESCALE_FAMILIES = ("reduce", "stateful", "window_cb", "window_tb")
+
+#: families that rescale across MESH shapes (kill on kk key shards,
+#: restore on a different mesh) — the multi-chip N±1 story
+MESH_RESCALE_FAMILIES = ("window_cb", "window_tb")
+
+
+def record_key(rec):
+    """The routing key of one sunk record (the cells' serializer ships
+    sorted (field, value) pair tuples)."""
+    try:
+        return dict(rec).get("key")
+    except (TypeError, ValueError):
+        return None
+
+
+def keyed_sequences(parts: List[list]) -> dict:
+    """Per-key record sequences in offset order.  Under keyed routing
+    the per-KEY subsequence is the unit of the ordering guarantee — a
+    shard-count change legitimately re-interleaves keys against each
+    other (different shard drain order), exactly as Kafka guarantees
+    order per partition, not across partitions."""
+    out: dict = {}
+    for p in parts:
+        for rec in p:
+            out.setdefault(record_key(rec), []).append(rec)
+    return out
+
+
+def diff_keyed_records(baseline, chaos) -> Optional[str]:
+    """None when every key's record sequence matches exactly; otherwise
+    the first per-key divergence.  The rescale form of
+    :func:`diff_records`: loss, duplication, or per-key reorder all
+    surface — only the cross-key interleaving (which the shard count
+    legitimately changes) is factored out."""
+    a, b = keyed_sequences(baseline), keyed_sequences(chaos)
+    for k in sorted(set(a) | set(b), key=repr):
+        if k not in a:
+            return f"key {k!r}: {len(b[k])} record(s) only in chaos run"
+        if k not in b:
+            return f"key {k!r}: {len(a[k])} record(s) only in baseline"
+        if a[k] != b[k]:
+            return _diff_seq(f"key {k!r}", a[k], b[k])
+    return None
+
+
+def run_rescale_ab(family: str, point: str, workdir: str, *,
+                   shards_kill: int, shards_restore: int,
+                   mesh_kill=None, mesh_restore=None,
+                   n: int = 4096, fusion: bool = True) -> dict:
+    """One kill-a-shard / restore-on-N±1 cell: baseline runs
+    uninterrupted on the KILL shape; the chaos twin is killed on the
+    kill shape and restored on the RESTORE shape (different keyed
+    parallelism and/or mesh).  The diff is per-key record-for-record —
+    docs/DURABILITY.md "rescale-on-restore"."""
+    import os as _os
+    tag = (f"rescale_{family}_{point}_{shards_kill}to{shards_restore}"
+           f"_{'on' if fusion else 'off'}")
+    base = make_cell(family, _os.path.join(workdir, tag, "ckpt_a"),
+                     fusion=fusion, n=n, parallelism=shards_kill,
+                     mesh=mesh_kill,
+                     out_dir=_os.path.join(workdir, tag, "out_a"))
+    chal = make_cell(family, _os.path.join(workdir, tag, "ckpt_b"),
+                     fusion=fusion, n=n, parallelism=shards_kill,
+                     mesh=mesh_kill,
+                     out_dir=_os.path.join(workdir, tag, "out_b"))
+    spec = default_kill(family, point)
+    if point == "mid_window" and shards_kill > 1 and family != "reduce":
+        # device families count BATCHES, shared across replicas: P
+        # keyed partitions stage ~P× as many (smaller) batches by the
+        # same stream position, so scale the kill to land after the
+        # first checkpoint, as the single-shard default does.  The host
+        # reduce counts RECORDS — position-invariant, no scaling.
+        spec = KillSpec(point, after=spec.after * shards_kill,
+                        op_name=spec.op_name)
+    gb = run_baseline(base["factory"])
+    gc = run_killed_and_restored(
+        chal["factory"], spec,
+        restore_factory=lambda: chal["factory"](
+            parallelism=shards_restore, mesh=mesh_restore))
+    base_out, chaos_out = base["read"](), chal["read"]()
+    dur = gc.stats()["Durability"]
+    return {
+        "family": family, "point": point, "rescale": True,
+        "shards": f"{shards_kill}->{shards_restore}",
+        "mesh": None if mesh_kill is None else
+                f"{_mesh_tag(mesh_kill)}->{_mesh_tag(mesh_restore)}",
+        "fusion": fusion,
+        "diff": diff_keyed_records(base_out, chaos_out),
+        "records": sum(len(p) for p in base_out)
+        if base_out and isinstance(base_out[0], list) else len(base_out),
+        "restored_epoch": dur.get("restored_epoch"),
+        "restore_ms": dur.get("restore_ms"),
+        "epochs_committed_baseline":
+            gb.stats()["Durability"].get("epochs_committed"),
+        "dedupe_hits": dur.get("dedupe_hits"),
+    }
+
+
+def _mesh_tag(mesh) -> str:
+    if mesh is None:
+        return "none"
+    from windflow_tpu.durability.rebucket import mesh_shape
+    s = mesh_shape(mesh)
+    return f"{s['data']}x{s['key']}"
 
 
 def run_ab(factory_baseline: Callable[[], object],

@@ -88,6 +88,99 @@ def _spec_str(spec) -> Optional[str]:
         return None
 
 
+def quiesce(graph) -> None:
+    """Drain a started graph to the aligned barrier: flush open emitter
+    batches, then drain replicas until nothing moves.  Runs on the
+    driver thread between sweeps, so no pool drain can race it.  Shared
+    by the checkpoint protocol (step 1) and the reshard executor
+    (windflow_tpu/serving) — a live reshard IS "quiesce, re-place the
+    key→shard map, resume", the same barrier with no manifest."""
+    for _ in range(_MAX_QUIESCE_ROUNDS):
+        for rep in graph._all_replicas:
+            if rep.emitter is not None and not rep.done:
+                rep.emitter.flush(rep.current_wm)
+        progressed = False
+        for rep in graph._all_replicas:
+            if rep.drain(0):
+                progressed = True
+        if not progressed:
+            if any(rep.inbox for rep in graph._all_replicas):
+                raise WindFlowError(
+                    "durability barrier could not quiesce the graph: "
+                    "a replica holds pending input but no replica "
+                    "makes progress")
+            return
+    raise WindFlowError(
+        "durability barrier exceeded the quiesce round bound — "
+        "the graph keeps generating work without source ticks")
+
+
+def keyed_emitters_into(graph, op):
+    """Every override-capable keyed emitter feeding ``op``'s replicas
+    (host KeyByEmitter and the keyed staging emitter; device keyby
+    splits route in-program and are not override targets — documented
+    executor limit).  Shared by the reshard executor (installing
+    overrides) and the checkpoint plane (recording them)."""
+    from windflow_tpu.parallel.emitters import (DeviceToHostEmitter,
+                                                KeyByEmitter,
+                                                KeyedDeviceStageEmitter,
+                                                SplittingEmitter)
+    dest_ids = {id(r) for r in op.replicas}
+    out = []
+
+    def visit(em):
+        if em is None:
+            return
+        if isinstance(em, DeviceToHostEmitter):
+            visit(em.inner)
+            return
+        if isinstance(em, SplittingEmitter):
+            for b in em.branches:
+                visit(b)
+            return
+        if isinstance(em, (KeyByEmitter, KeyedDeviceStageEmitter)) \
+                and any(id(r) in dest_ids for r, _ in em.dests):
+            out.append(em)
+
+    for rep in graph._all_replicas:
+        visit(rep.emitter)
+    return out
+
+
+def collect_overrides(graph) -> dict:
+    """Per-operator merged key→shard override maps currently installed
+    on the keyed emitters (reshard-executor moves) — the placement half
+    of the manifest, so a restore (including a rescale) routes AND
+    re-buckets through the same map the checkpointed run routed by."""
+    out = {}
+    for op in graph._operators:
+        merged = {}
+        for em in keyed_emitters_into(graph, op):
+            ov = getattr(em, "_override", None)
+            if ov:
+                merged.update(ov)
+        if merged:
+            out[op.ordinal] = merged
+    return out
+
+
+def install_overrides(graph, overrides: dict) -> None:
+    """Re-install recorded key→shard overrides onto a freshly built
+    graph's keyed emitters, dropping moves that target shards beyond
+    the new shard count (the rescale may have shrunk it)."""
+    for op in graph._operators:
+        ov = overrides.get(op.ordinal)
+        if not ov:
+            continue
+        n = op.parallelism
+        kept = {k: d for k, d in ov.items()
+                if isinstance(d, int) and 0 <= d < n}
+        if not kept:
+            continue
+        for em in keyed_emitters_into(graph, op):
+            em.set_override(dict(kept))
+
+
 class DurabilityPlane:
     """Per-graph checkpoint coordinator (built by ``PipeGraph._build``
     when ``Config.durability`` names a directory; ``None`` otherwise —
@@ -96,11 +189,6 @@ class DurabilityPlane:
     def __init__(self, graph) -> None:
         from windflow_tpu.persistent.kv import LogKV
         cfg = graph.config
-        if cfg.mesh is not None:
-            raise WindFlowError(
-                "Config.durability is not supported on a mesh yet: "
-                "sharded ring snapshots need SPMD-consistent "
-                "capture/placement (single-chip graphs only)")
         self.graph = graph
         self.dir = cfg.durability
         os.makedirs(self.dir, exist_ok=True)
@@ -163,6 +251,10 @@ class DurabilityPlane:
     def _k_reps(epoch: int) -> bytes:
         return b"ep/%d/reps" % epoch
 
+    @staticmethod
+    def _k_placements(epoch: int) -> bytes:
+        return b"ep/%d/placements" % epoch
+
     # -- sweep hook ----------------------------------------------------------
     def on_sweep(self) -> None:
         """Called once per driver sweep (PipeGraph.step).  Counts toward
@@ -189,12 +281,22 @@ class DurabilityPlane:
         self._commit_sinks(epoch)
         self._chaos("post_sink_commit")
         nbytes = self._write_snapshots(epoch)
+        from windflow_tpu.durability.rebucket import mesh_shape
         manifest = {
             "schema": CHECKPOINT_SCHEMA,
             "app": self.graph.name,
             "epoch": epoch,
             "written_at_usec": current_time_usecs(),
             "topology": topology_signature(self.graph._operators),
+            # rescale-on-restore (durability/rebucket.py): the shard
+            # shape this epoch's keyed state was bucketed under — a
+            # restore onto a different shape re-buckets through it
+            "mesh": mesh_shape(self.graph.config.mesh),
+            # keyed placement summary (which operators carry live
+            # key→shard overrides; the override maps themselves ride
+            # the pickled placements record — native key types)
+            "placements": {str(ordinal): len(ov) for ordinal, ov
+                           in collect_overrides(self.graph).items()},
         }
         self.kv.put(self._k_manifest(epoch), json.dumps(manifest).encode())
         self.kv.flush()          # the commit point: manifest + fsync
@@ -210,28 +312,7 @@ class DurabilityPlane:
         return epoch
 
     def _quiesce(self) -> None:
-        """Drain the graph to the aligned barrier: flush open emitter
-        batches, then drain replicas until nothing moves.  Runs on the
-        driver thread between sweeps, so no pool drain can race it."""
-        g = self.graph
-        for _ in range(_MAX_QUIESCE_ROUNDS):
-            for rep in g._all_replicas:
-                if rep.emitter is not None and not rep.done:
-                    rep.emitter.flush(rep.current_wm)
-            progressed = False
-            for rep in g._all_replicas:
-                if rep.drain(0):
-                    progressed = True
-            if not progressed:
-                if any(rep.inbox for rep in g._all_replicas):
-                    raise WindFlowError(
-                        "durability barrier could not quiesce the graph: "
-                        "a replica holds pending input but no replica "
-                        "makes progress")
-                return
-        raise WindFlowError(
-            "durability barrier exceeded the quiesce round bound — "
-            "the graph keeps generating work without source ticks")
+        quiesce(self.graph)
 
     def _sink_commit_hooks(self):
         """(replica, hook) pairs for every terminal replica exposing an
@@ -281,7 +362,17 @@ class DurabilityPlane:
         raw = pickle.dumps(self._replica_records(),
                            protocol=pickle.HIGHEST_PROTOCOL)
         self.kv.put(self._k_reps(epoch), raw)
-        return nbytes + len(raw)
+        nbytes += len(raw)
+        # live key→shard placement overrides (reshard executor moves):
+        # restore re-installs them so a rescale re-buckets keyed state
+        # through the SAME placement the keys will route by
+        overrides = collect_overrides(self.graph)
+        if overrides:
+            raw = pickle.dumps(overrides,
+                               protocol=pickle.HIGHEST_PROTOCOL)
+            self.kv.put(self._k_placements(epoch), raw)
+            nbytes += len(raw)
+        return nbytes
 
     def _replica_records(self) -> list:
         """Per-replica host bookkeeping: watermark frontiers, source
@@ -334,19 +425,45 @@ class DurabilityPlane:
     def apply_restore(self, pending: dict) -> None:
         """Apply stashed checkpoint state to a just-built graph — called
         by ``PipeGraph.start()`` after ``_build()`` (replicas and fusion
-        preludes exist) and before the first source tick."""
+        preludes exist) and before the first source tick.  On a rescale
+        (the manifest's shard shape differs from the graph's) every
+        keyed blob is re-bucketed first (durability/rebucket.py) and the
+        recorded key→shard overrides are re-installed, so state lands
+        exactly where the new placement will route its keys."""
         t0 = time.perf_counter()
         g = self.graph
         epoch = pending["epoch"]
+        from windflow_tpu.durability.rebucket import (mesh_shape,
+                                                      rebucket_blob)
+        old_mesh = pending["manifest"].get("mesh")
+        new_mesh = mesh_shape(g.config.mesh)
+        topo = pending["manifest"].get("topology") or []
+        placements = pending.get("placements") or {}
+        rescaled = pending.get("rescaled", False)
+        if placements:
+            install_overrides(g, placements)
         for ordinal, blob in pending["ops"].items():
-            g._operators[ordinal].restore_state(blob)
+            op = g._operators[ordinal]
+            old_p = topo[ordinal]["parallelism"] \
+                if ordinal < len(topo) else op.parallelism
+            blob = rebucket_blob(op, blob, old_p, op.parallelism,
+                                 old_mesh, new_mesh,
+                                 override=placements.get(ordinal))
+            op.restore_state(blob)
         by_key = {(r["ordinal"], r["index"]): r for r in pending["reps"]}
+        merged = self._merged_records(pending["reps"])
         from windflow_tpu.ops.source import BaseSourceReplica
         for op in g._operators:
             for rep in op.replicas:
                 r = by_key.get((op.ordinal, rep.index))
                 if r is None:
-                    continue
+                    # rescale grew this operator: the new replica has no
+                    # per-replica record — seed from the op's merged
+                    # record (min watermark = conservative frontier; the
+                    # replay advances it with the first real batches)
+                    r = merged.get(op.ordinal)
+                    if r is None:
+                        continue
                 rep.current_wm = r["wm"]
                 rep._hooked_wm = r["hooked_wm"]
                 if isinstance(rep, BaseSourceReplica):
@@ -356,10 +473,72 @@ class DurabilityPlane:
                 self._apply_kafka(rep, r)
         for _, hook in self._sink_restore_hooks():
             hook(epoch)
+        if rescaled:
+            self._check_fences_reconciled(epoch)
         self.epoch = epoch + 1
         self.restored_epoch = epoch
         self.restore_ms = round((time.perf_counter() - t0) * 1e3
                                 + pending.get("load_ms", 0.0), 3)
+
+    @staticmethod
+    def _merged_records(reps: list) -> dict:
+        """Per-ordinal fold of the replica records, for replicas a
+        rescale added: minimum watermark (never fires a window the old
+        shards had not), maximum source sequencing."""
+        out = {}
+        for r in reps:
+            m = out.get(r["ordinal"])
+            if m is None:
+                m = out[r["ordinal"]] = dict(r)
+                # group-level Kafka state reseeds through the op-level
+                # stash (_apply_kafka) from EVERY old record already;
+                # the merged record must not re-apply one replica's
+                del m["index"]
+                continue
+            m["wm"] = min(m["wm"], r["wm"])
+            m["hooked_wm"] = min(m["hooked_wm"], r["hooked_wm"])
+            for k in ("last_ts", "tid_seq", "since_punct"):
+                if k in r and k in m:
+                    m[k] = max(m[k], r[k])
+        return out
+
+    def _check_fences_reconciled(self, restored_epoch: int) -> None:
+        """Rescale fence guard (the shard-count-changing exactly-once
+        hole): the broker fence dedupes on the replica-LIFETIME message
+        sequence, which stays exact across a replay only while the
+        replayed record ORDER matches the committed one — true on the
+        checkpointed shard shape, not across a rescale (a different
+        shard count re-interleaves the replay).  If a sink fence sits
+        AHEAD of the restored manifest (the mid-sink-flush torn window:
+        epoch committed broker-side, manifest lost), a rescaled replay
+        would dedupe by position against records it regenerates in a
+        different order — refuse, and name the fix."""
+        from windflow_tpu.kafka.kafka_sink import KafkaSinkReplica
+        for op in self.graph._operators:
+            if not op.is_terminal:
+                continue
+            for rep in op.replicas:
+                if not isinstance(rep, KafkaSinkReplica) \
+                        or not rep._durable:
+                    continue
+                fence_fn = getattr(
+                    getattr(rep._producer, "_broker", None), "fence",
+                    None)
+                if fence_fn is None:
+                    continue
+                f = fence_fn(rep._fence_id)
+                if f is not None and f[0] > restored_epoch:
+                    raise WindFlowError(
+                        f"WF605 restore: sink '{op.name}' replica "
+                        f"{rep.index} committed epoch {f[0]} through its "
+                        f"fence but the last complete manifest is epoch "
+                        f"{restored_epoch} (a crash in the torn "
+                        "two-phase window) — a shard-shape-changing "
+                        "replay re-interleaves records and the fence's "
+                        "sequence dedupe would drop the wrong ones. "
+                        "Restore once on the checkpointed shape to "
+                        "reconcile the torn epoch, checkpoint, then "
+                        "rescale")
 
     @staticmethod
     def _apply_kafka(rep, r: dict) -> None:
@@ -476,10 +655,12 @@ def load_checkpoint(ckpt_dir: str) -> dict:
             if key.startswith(prefix):
                 ops[int(key[len(prefix):])] = pickle.loads(kv.get(key))
         reps = pickle.loads(kv.get(b"ep/%d/reps" % epoch))
+        raw = kv.get(b"ep/%d/placements" % epoch)
+        placements = pickle.loads(raw) if raw is not None else {}
     finally:
         kv.close()
     return {"epoch": epoch, "manifest": manifest, "ops": ops,
-            "reps": reps,
+            "reps": reps, "placements": placements,
             "load_ms": round((time.perf_counter() - t0) * 1e3, 3)}
 
 
@@ -506,14 +687,15 @@ def restore_graph(graph, ckpt_dir: Optional[str] = None):
         import dataclasses
         graph.config = dataclasses.replace(graph.config, durability=d)
     pending = load_checkpoint(d)
-    from windflow_tpu.analysis.preflight import manifest_conflicts
-    diags = manifest_conflicts(graph, pending["manifest"])
+    from windflow_tpu.analysis.preflight import manifest_rescale_plan
+    diags, rescaled = manifest_rescale_plan(graph, pending["manifest"])
     if diags:
         lines = "\n  ".join(str(dg) for dg in diags)
         raise WindFlowError(
             f"restore: graph does not match checkpoint epoch "
             f"{pending['epoch']} of app "
             f"{pending['manifest'].get('app')!r}:\n  {lines}")
+    pending["rescaled"] = rescaled
     graph._pending_restore = pending
     graph.start()
     return graph

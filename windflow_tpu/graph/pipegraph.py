@@ -117,6 +117,12 @@ class PipeGraph:
         # directory; None leaves one `is None` check per sweep (the
         # documented off-path, micro-asserted like health/ledger)
         self._durability = None
+        # reshard executor (windflow_tpu/serving): applies the shard
+        # plane's move_keys/split_hot_key plans live, built in _build
+        # when Config.reshard_executor is on (default OFF: unlike the
+        # observe-only planes, this one mutates routing); None leaves
+        # one `is not None` check per sweep + one per source tick chunk
+        self._reshard = None
         # checkpoint blobs stashed by restore() for the plane to apply
         # after _build (operator state) and before the first source tick
         self._pending_restore = None
@@ -437,6 +443,18 @@ class PipeGraph:
             from windflow_tpu.parallel.compaction import attach_compaction
             attach_compaction(self)
 
+        # 3g. reshard executor (windflow_tpu/serving): built LAST — it
+        # discovers the keyed emitters the wiring installed, reads the
+        # health plane and shard ledger at tick cadence, and mutates
+        # routing only through the quiesce barrier.  Mesh graphs are
+        # not executor targets (their reshard mechanism is the rescale
+        # restore, docs/DURABILITY.md); replica-sharded keyed operators
+        # are.
+        if getattr(cfg, "reshard_executor", False) \
+                and self.config.mesh is None:
+            from windflow_tpu.serving import ReshardExecutor
+            self._reshard = ReshardExecutor(self)
+
         # sanity: every non-sink replica must have an emitter (fused
         # members are inert by design — the segment host emits for them)
         for op in self._operators:
@@ -700,11 +718,22 @@ class PipeGraph:
             # aligned barrier and commits a checkpoint epoch.  Off-path
             # cost is exactly this one check (micro-asserted).
             self._durability.on_sweep()
+        if self._reshard is not None:
+            # executor cadence (windflow_tpu/serving): one counter
+            # compare per sweep; every Config.reshard_check_sweeps-th
+            # it reads health + the shard plan and applies what fires.
+            self._reshard.on_sweep()
         return progress
 
     def _tick_chunk(self, sr) -> int:
-        return self.config.source_tick_chunk \
+        chunk = self.config.source_tick_chunk \
             or sr.op.output_batch_size or 256
+        if self._reshard is not None:
+            # admission control (docs/OBSERVABILITY.md "Reshard
+            # executor"): when no plan can help a degraded operator,
+            # the source intake throttles instead of growing inboxes
+            chunk = self._reshard.admit_chunk(chunk)
+        return chunk
 
     def _backpressured(self) -> bool:
         """True when any replica inbox is at the in-transit cap.  Also folds
@@ -814,6 +843,20 @@ class PipeGraph:
         try:
             return self._durability.section()
         except Exception as e:  # lint: broad-except-ok (a checkpoint
+            # telemetry read must never take the pipeline or a stats
+            # dump down — same stance as every other plane section)
+            return {"enabled": True, "error": f"{type(e).__name__}: "
+                                              f"{e}"[:200]}
+
+    def _reshard_section(self) -> dict:
+        """Guarded like the health/durability sections; with
+        ``Config.reshard_executor`` off this is the whole cost: one
+        check."""
+        if self._reshard is None:
+            return {"enabled": False}
+        try:
+            return self._reshard.section()
+        except Exception as e:  # lint: broad-except-ok (an executor
             # telemetry read must never take the pipeline or a stats
             # dump down — same stance as every other plane section)
             return {"enabled": True, "error": f"{type(e).__name__}: "
@@ -1071,6 +1114,10 @@ class PipeGraph:
             # committed, checkpoint/restore wall cost + bytes, sink
             # fence dedupe hits — docs/DURABILITY.md
             "Durability": self._durability_section(),
+            # reshard executor (windflow_tpu/serving): plans applied,
+            # keys moved, quiesce/recovery wall cost, admission factor,
+            # action timeline — docs/OBSERVABILITY.md
+            "Reshard": self._reshard_section(),
             "Operators": [op.dump_stats() for op in self._operators],
         }
 
@@ -1162,6 +1209,7 @@ class PipeGraph:
         write("sweep.json", self._sweep_section)
         write("shard.json", self._shard_section)
         write("durability.json", self._durability_section)
+        write("reshard.json", self._reshard_section)
         write("preflight.json", lambda: {
             "mode": getattr(self.config, "preflight", "error"),
             "check_ms": self._preflight_ms,

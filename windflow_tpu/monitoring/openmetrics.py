@@ -340,6 +340,32 @@ def _families(stats: dict,
             "1 when this graph was rebuilt from a checkpoint epoch") \
             .add(0 if dur.get("restored_epoch") is None else 1, base)
 
+    # -- reshard executor ----------------------------------------------------
+    rsh = stats.get("Reshard") or {}
+    if rsh.get("enabled") and "error" not in rsh:
+        fam("wf_reshard_plans_applied_total", "counter",
+            "Reshard plans (move_keys/split_hot_key) applied live") \
+            .add(rsh.get("plans_applied", 0), base)
+        fam("wf_reshard_keys_moved_total", "counter",
+            "Keys re-placed by executor-applied move_keys actions") \
+            .add(rsh.get("keys_moved", 0), base)
+        fam("wf_reshard_preagg_folds_total", "counter",
+            "Hot-key tuples absorbed into pre-aggregated partials "
+            "(split_hot_key)") \
+            .add(rsh.get("preagg_folds", 0), base)
+        fam("wf_reshard_admission_factor", "gauge",
+            "Source admission factor (1.0 = no throttle; halves while "
+            "degraded with no applicable plan)") \
+            .add(rsh.get("admission_factor", 1.0), base)
+        fam("wf_reshard_quiesce_ms", "gauge",
+            "Wall cost of the last reshard quiesce-and-re-place "
+            "barrier") \
+            .add(rsh.get("quiesce_ms") or 0, base)
+        fam("wf_reshard_recovery_ms", "gauge",
+            "Wall time from the last applied plan to the first OK "
+            "verdict") \
+            .add(rsh.get("recovery_ms") or 0, base)
+
     # -- latency histograms --------------------------------------------------
     lat = stats.get("Latency") or {}
     f_svc = fam("wf_service_latency_usec", "histogram",

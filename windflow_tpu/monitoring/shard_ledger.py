@@ -179,6 +179,10 @@ class ShardSketch:
         #: "splitmix" (device/keyed-staging routing), "stable_hash"
         #: (host KeyByEmitter), "dense_range" (mesh key-axis ownership)
         self.placement = placement
+        #: reshard-executor key→shard override (windflow_tpu/serving):
+        #: set when the executor re-places keys so hot-key shard
+        #: attribution follows the LIVE routing, not the derived hash
+        self.override: Optional[dict] = None
         self.key_axis = max(1, key_axis)
         self.shard_counts = np.zeros(self.n_shards, np.int64)
         self.total = 0
@@ -341,6 +345,10 @@ class ShardSketch:
     def shard_of(self, key: int) -> int:
         from windflow_tpu.basic import stable_hash
         from windflow_tpu.parallel.emitters import splitmix64_int
+        if self.override:
+            d = self.override.get(key)
+            if isinstance(d, int) and 0 <= d < self.n_shards:
+                return d
         if self.placement == "dense_range" and self.max_keys:
             per = max(1, self.max_keys // self.key_axis)
             return min(self.key_axis - 1, max(0, int(key)) // per)

@@ -399,7 +399,18 @@ class _StatefulTPUBase(Operator):
         }
 
     def restore_state(self, blob):
-        self._state = jax.tree.map(jnp.asarray, blob["state"])
+        if self.mesh is not None:
+            # multi-chip restore: the slot table lives key-sharded (slot
+            # ranges per chip) — re-place the host blob in that layout;
+            # the table's logical content is shard-shape independent, so
+            # a rescale restore needs nothing but this placement
+            from windflow_tpu.parallel.mesh import state_sharding
+            sh = state_sharding(self.mesh)
+            self._state = jax.tree.map(
+                lambda a: jax.device_put(jnp.asarray(a), sh),
+                blob["state"])
+        else:
+            self._state = jax.tree.map(jnp.asarray, blob["state"])
         self._interner._ids = dict(blob["interner"])
         cblob = blob.get("compactor")
         if cblob is not None and self._compactor is not None:

@@ -56,7 +56,7 @@ from typing import Optional
 
 import numpy as np
 
-from windflow_tpu.basic import WindFlowError
+from windflow_tpu.basic import WindFlowError, int32_key
 
 #: table sentinel: pads the sorted key array; a REAL key equal to it is
 #: never admitted (its lanes take the overflow/sorted path)
@@ -612,8 +612,7 @@ class KeyCompactor:
         genuinely new key takes the lock."""
         if not self.active:
             return
-        i = int(k32) & 0xFFFFFFFF              # int32 wrap, numpy-free
-        k = i - (1 << 32) if i >= (1 << 31) else i
+        k = int32_key(k32)          # canonical int32 wrap, numpy-free
         if k == _SENT:
             if self.intern_fallback:
                 self.deactivate()

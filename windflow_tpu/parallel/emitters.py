@@ -34,7 +34,7 @@ import numpy as np
 
 from windflow_tpu import staging
 from windflow_tpu.analysis.hotpath import hot_path
-from windflow_tpu.basic import RoutingMode, WindFlowError
+from windflow_tpu.basic import RoutingMode, WindFlowError, int32_key
 from windflow_tpu.batch import (DeviceBatch, HostBatch, Punctuation, WM_NONE,
                                 columns_to_device, host_to_device,
                                 stage_packed, transfer_nbytes)
@@ -207,6 +207,28 @@ def _concat(arrs):
     return arrs[0] if len(arrs) == 1 else np.concatenate(arrs)
 
 
+def _log_fold(comb, rec: dict, m: int) -> dict:
+    """Fold ``m`` records held as a dict of ``[m]`` numpy columns into
+    one scalar record through an ASSOCIATIVE combiner, by repeated
+    halving — the combiner runs log2(m) times over vectorized halves
+    instead of m-1 times over scalars.  Only the grouping changes
+    (associativity; float sums carry the same rounding tolerance as the
+    dense reduce path)."""
+    while m > 1:
+        h = m // 2
+        a = {k: v[:h] for k, v in rec.items()}
+        b = {k: v[h:2 * h] for k, v in rec.items()}
+        c = comb(a, b)
+        if m - 2 * h:
+            rec = {k: np.concatenate([np.atleast_1d(np.asarray(c[k])),
+                                      np.asarray(v[2 * h:])])
+                   for k, v in rec.items()}
+        else:
+            rec = {k: np.atleast_1d(np.asarray(c[k])) for k in rec}
+        m = h + (m - 2 * h)
+    return {k: v[0] for k, v in rec.items()}
+
+
 # transfer byte accounting: the packed staging path counts its buffer's
 # exact nbytes; every other path uses the shared whole-batch definition
 _db_nbytes = transfer_nbytes
@@ -303,10 +325,25 @@ class KeyByEmitter(Emitter):
         #: the per-tuple emit path carries no sketch work at all (the
         #: flush path samples one key per shipped batch instead)
         self._sketch = None
+        #: reshard-executor key→shard override (windflow_tpu/serving):
+        #: moved keys route to their assigned shard BEFORE the hash —
+        #: the advisor's move_keys contract.  None leaves one check per
+        #: tuple (a plain attribute read, no allocation)
+        self._override = None
+
+    def set_override(self, override) -> None:
+        """Install/replace the key→destination override map (reshard
+        executor moves; restore re-installs checkpointed maps)."""
+        self._override = dict(override) if override else None
 
     @hot_path
     def emit(self, item, ts, wm, shared=False, tid=None):
-        d = stable_hash(self.key_extractor(item)) % len(self.dests)
+        key = self.key_extractor(item)
+        d = None
+        if self._override is not None:
+            d = self._override.get(key)
+        if d is None:
+            d = stable_hash(key) % len(self.dests)
         ob = self._open[d]
         ob.add(item, ts, wm, shared, tid)
         if len(ob.items) >= max(1, self.output_batch_size):
@@ -676,6 +713,57 @@ class KeyedDeviceStageEmitter(Emitter):
         #: balanced deterministically over the replicas.  None leaves
         #: one check per emit path.
         self._compactor = None
+        #: reshard-executor key→shard override (windflow_tpu/serving):
+        #: moved k32 keys route to their assigned shard BEFORE both the
+        #: compaction placement and the splitmix hash
+        self._override = None
+        #: split_hot_key pre-aggregation (the executor's partial-combine
+        #: tier): tuples of the named hot keys fold through the
+        #: consumer's associative combiner AT THIS BOUNDARY and ship as
+        #: one partial record per flush — the hot key's downstream load
+        #: drops by the fold factor while the final per-key aggregate
+        #: is unchanged (associativity; per-batch partials coarsen,
+        #: the documented split semantic).  None leaves one check per
+        #: emit path.
+        self._preagg = None         # {"keys": set, "comb": fn}
+        self._preagg_acc = {}       # k32 -> [record, max_ts, n]
+        self.preagg_folds = 0       # tuples absorbed into partials
+
+    def set_override(self, override) -> None:
+        """Install/replace the key→destination override map, keyed by
+        the int32-truncated key the device state collapses to."""
+        if not override:
+            self._override = None
+            return
+        self._override = {self._key32(k): d for k, d in override.items()}
+
+    def set_preagg(self, keys, comb) -> None:
+        """Enable the pre-aggregating partial combine for ``keys``
+        (split_hot_key executor action); ``comb`` is the consumer's
+        associative record combiner.  ``None``/empty disables."""
+        self._flush_preagg(WM_NONE)
+        if not keys or comb is None:
+            self._preagg = None
+            return
+        self._preagg = {"keys": {self._key32(k) for k in keys},
+                        "comb": comb}
+
+    def _fold_into(self, k32, item, ts):
+        acc = self._preagg_acc.get(k32)
+        if acc is None:
+            self._preagg_acc[k32] = [item, ts, 1]
+            return
+        acc[0] = self._preagg["comb"](acc[0], item)
+        acc[1] = max(acc[1], ts)
+        acc[2] += 1
+        self.preagg_folds += 1
+
+    def _flush_preagg(self, wm) -> None:
+        if not self._preagg_acc:
+            return
+        acc, self._preagg_acc = self._preagg_acc, {}
+        for k32, (item, ts, _n) in acc.items():
+            self._route_one(k32, item, ts, wm)
 
     def bind_observability(self, stats, ring, flight):
         super().bind_observability(stats, ring, flight)
@@ -687,14 +775,22 @@ class KeyedDeviceStageEmitter(Emitter):
         """Truncate a numeric key to the int32 key space the device operator
         interns (its extractor output is cast to int32 on device) — routing
         must collapse exactly the keys the state table collapses, or one
-        logical key would straddle replicas."""
-        i = int(k) & 0xFFFFFFFF
-        return i - (1 << 32) if i >= (1 << 31) else i
+        logical key would straddle replicas.  Canonical rule:
+        ``basic.int32_key`` (shared with compaction admission, the
+        reshard executor's state moves, and rescale re-bucketing)."""
+        return int32_key(k)
 
     def emit(self, item, ts, wm, shared=False, tid=None):
         # scalar splitmix64 (bit-identical to the native/columnar path) —
         # pure int ops, no per-tuple FFI or array allocation
         k32 = self._key32(self.key_extractor(item))
+        pa = self._preagg
+        if pa is not None and k32 in pa["keys"]:
+            self._fold_into(k32, item, ts)
+            return
+        self._route_one(k32, item, ts, wm)
+
+    def _route_one(self, k32, item, ts, wm):
         comp = self._compactor
         d = None
         if comp is not None:
@@ -708,6 +804,12 @@ class KeyedDeviceStageEmitter(Emitter):
                 # down — the HostKeyProbe stance)
                 comp.deactivate()
                 self._compactor = None
+        if self._override is not None:
+            # executor move wins over every derived placement: the key
+            # was moved deliberately, and state moved with it
+            o = self._override.get(k32)
+            if o is not None:
+                d = o
         if d is None:
             d = splitmix64_int(k32) % len(self.dests)
         self._inner[d].emit(item, ts, wm)
@@ -750,6 +852,20 @@ class KeyedDeviceStageEmitter(Emitter):
                 [self._key32(self.key_extractor(
                     {k: v[i].item() for k, v in cols.items()}))
                  for i in range(len(tss))], np.int64)
+        pa = self._preagg
+        if pa is not None:
+            hot = np.isin(keys, np.fromiter(pa["keys"], np.int64,
+                                            len(pa["keys"])))
+            if hot.any():
+                self._fold_columns(pa, cols, tss, keys, hot)
+                keep = ~hot
+                if not keep.any():
+                    return
+                cols = {k: np.asarray(v)[keep] for k, v in cols.items()}
+                tss = tss[keep]
+                keys = keys[keep]
+                if row_wms is not None:
+                    row_wms = row_wms[keep]
         comp = self._compactor
         if comp is not None:
             try:
@@ -769,6 +885,13 @@ class KeyedDeviceStageEmitter(Emitter):
             # native C hash+count partition (wf_host.cpp
             # wf_keyby_partition)
             dest, counts = native.keyby_partition(keys, n)
+        if self._override is not None:
+            # executor moves re-place their keys over the derived
+            # placement (a handful of entries: the advisor's move list)
+            dest = np.asarray(dest).copy()
+            for k, d_ov in self._override.items():
+                dest[keys == k] = d_ov
+            counts = np.bincount(dest, minlength=n)
         if self._sketch is not None:
             try:
                 # the key column + per-destination counts already exist
@@ -789,18 +912,35 @@ class KeyedDeviceStageEmitter(Emitter):
                     {k: v[idx] for k, v in cols.items()}, tss[idx], wm,
                     row_wms[idx] if row_wms is not None else None)
 
+    def _fold_columns(self, pa, cols, tss, keys, hot) -> None:
+        """Columnar half of the pre-aggregating partial combine: the hot
+        rows of each hot key log-fold through the consumer's combiner
+        (vectorized numpy halving — log2(n) combiner calls, associative
+        regrouping only, the dense-path contract) into the running
+        partial."""
+        comb = pa["comb"]
+        arrs = {n: np.asarray(v) for n, v in cols.items()}
+        for k in np.unique(keys[hot]):
+            idx = np.nonzero(keys == k)[0]
+            rec = {n: v[idx] for n, v in arrs.items()}
+            folded = _log_fold(comb, rec, len(idx))
+            self.preagg_folds += len(idx) - 1
+            self._fold_into(int(k), folded, int(tss[idx].max()))
+
     def emit_device_batch(self, batch):
         raise WindFlowError(
             "keyed staging emitter received a device batch; TPU→TPU keyed "
             "edges use DeviceKeyByEmitter")
 
     def flush(self, wm):
+        self._flush_preagg(wm)
         if self._sketch is not None and self._sk_buf:
             self._drain_sketch_buf()
         for e in self._inner:
             e.flush(wm)
 
     def propagate_punctuation(self, wm):
+        self._flush_preagg(wm)
         for e in self._inner:
             e.propagate_punctuation(wm)
 

@@ -812,7 +812,19 @@ class FfatWindowsTPU(Operator):
         self._flushed = blob["flushed"]
         self._eos_replicas = blob["eos_replicas"]
         self._pending_evct = None   # lazy device read: re-primed on step
-        self._states = {k: jax.tree.map(jnp.asarray, st)
+        if self.mesh is not None:
+            # multi-chip restore: re-place the host blobs in the
+            # key-sharded layout the sharded step consumes (axis 0 of
+            # every leaf is the key/shard dimension: cells, horizon,
+            # and the per-key-shard TB scalar lanes alike).  The blob
+            # was re-bucketed for THIS mesh shape by the durability
+            # plane (durability/rebucket.py) before reaching here.
+            from windflow_tpu.parallel.mesh import state_sharding
+            sh = state_sharding(self.mesh)
+            place = lambda a: jax.device_put(jnp.asarray(a), sh)
+        else:
+            place = jnp.asarray
+        self._states = {k: jax.tree.map(place, st)
                         for k, st in blob["states"].items()}
         if blob["payload_zero"] is not None:
             self._payload_zero = jax.tree.map(jnp.asarray,
