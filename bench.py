@@ -603,6 +603,94 @@ def run_bench_compaction(platform: str, cfg: dict, jax) -> dict:
     return out
 
 
+def run_bench_wire(platform: str, cfg: dict, jax) -> dict:
+    """Wire-compression A/B (windflow_tpu/wire.py, guarded by
+    tools/check_bench_keys.py + check_bench_regress.py): a SEEDED
+    EVENT-time stream over the e2e record spec (i64 id/ts cadence lane,
+    low-cardinality key lane, f32 value lane) driven through the
+    staged FFAT pipeline twice — wire compression ON vs the
+    WF_TPU_WIRE kill switch.  Reports the measured wire bytes/tuple +
+    compression ratio (deterministic: EVENT time pins the ts lane's
+    codec, so check_bench_regress can tripwire the scalar) and the
+    DECODE DISPATCH DELTA: per-staged-batch ``staging.unpack``
+    dispatches compressed minus kill-switch, which the zero-extra-
+    dispatch contract pins at exactly 0 (the decode is traced INTO the
+    unpack program, docs/OBSERVABILITY.md "Wire plane")."""
+    import dataclasses
+
+    import numpy as np
+
+    import windflow_tpu as wf
+    from windflow_tpu.monitoring.jit_registry import default_registry
+
+    CAP, K, NB = 4096, 256, 16
+    n = NB * CAP
+    rng = np.random.default_rng(5)
+    ks = rng.integers(0, K, n)
+    vs = rng.integers(0, 1024, n)
+
+    def records():
+        for i in range(n):
+            yield {"key": int(ks[i]),
+                   "v0": np.float32(vs[i] / 1024.0),
+                   "ts": 1_000 + i * 7}
+
+    reg = default_registry()
+
+    def run(wire_on: bool):
+        cfgg = dataclasses.replace(wf.default_config,
+                                   wire_compression=wire_on)
+        cfgg.punctuation_interval_usec = 10 ** 12   # determinism
+        src = (wf.Source_Builder(records)
+               .withTimestampExtractor(lambda t: t["ts"])
+               .withOutputBatchSize(CAP)
+               .withRecordSpec({"key": np.int64(0),
+                                "v0": np.float32(0.0),
+                                "ts": np.int64(0)}).build())
+        w = (wf.Ffat_WindowsTPU_Builder(lambda t: t["v0"],
+                                        lambda a, b: a + b)
+             .withCBWindows(cfg["win"], cfg["slide"])
+             .withKeyBy(lambda t: t["key"]).withMaxKeys(K).build())
+        g = wf.PipeGraph("bench_wire", wf.ExecutionMode.DEFAULT,
+                         wf.TimePolicy.EVENT, config=cfgg)
+        g.add_source(src).add(w).add_sink(
+            wf.Sink_Builder(lambda r: None)
+            .withColumnarSink(defer=4).build())
+        base = reg.dispatch_counts().get("staging.unpack", 0)
+        t0 = time.perf_counter()
+        g.run()
+        wall = time.perf_counter() - t0
+        disp = reg.dispatch_counts().get("staging.unpack", 0) - base
+        st = g.stats()
+        return (st["Staging"]["Wire"], disp,
+                st["Bytes_H2D_total"], st["Bytes_H2D_logical_total"],
+                wall)
+
+    ws_on, d_on, h2d_on, log_on, wall_on = run(True)
+    ws_off, d_off, h2d_off, _log_off, wall_off = run(False)
+    batches = max(1, ws_on["batches"] + ws_on["raw_batches"])
+    return {
+        # wire_bytes_per_tuple from the H2D total: raw-shipped batches
+        # (if any) count at their full size, so the number is the real
+        # transfer cost per tuple, not just the compressed batches'
+        "wire_bytes_per_tuple": round(h2d_on / n, 3),
+        "logical_bytes_per_tuple": round(log_on / n, 3),
+        "compression_ratio": round(log_on / h2d_on, 4) if h2d_on
+        else None,
+        "decode_dispatch_delta": round((d_on - d_off) / batches, 4),
+        "unpack_dispatches_on": d_on,
+        "unpack_dispatches_off": d_off,
+        "raw_batches": ws_on["raw_batches"],
+        "fallback_lanes": ws_on["fallback_lanes"],
+        "encode_usec": ws_on["encode_usec"],
+        "killswitch_h2d_bytes": h2d_off,
+        "wall_on_s": round(wall_on, 3),
+        "wall_off_s": round(wall_off, 3),
+        "codecs": ws_on["codecs"],
+        "tuples": n,
+    }
+
+
 def _e2e_graph(cfg: dict, n_tuples: int, chunks, lat_sink):
     """Build the whole-framework pipeline (VERDICT r2 item 3: benchmark what
     ``PipeGraph.run()`` sustains, not the raw kernel): columnar byte ingest →
@@ -670,11 +758,16 @@ def _measure_e2e_graph(graph_factory, n_tuples: int, CAP: int,
     # into roofline.per_hop so the 8x bytes/tuple excess is named hop by
     # hop in bench_history.json
     try:
-        sweep = g.stats().get("Sweep")
+        _st = g.stats()
+        sweep = _st.get("Sweep")
+        # wire plane (windflow_tpu/wire.py): the staged run's measured
+        # compression — main() folds it into the guarded `wire` section
+        wire_stats = (_st.get("Staging") or {}).get("Wire")
     except Exception:  # lint: broad-except-ok (a ledger read must not
         # cost the bench its artifact; the missing roofline.per_hop key
         # fails check_bench_keys loudly instead)
         sweep = None
+        wire_stats = None
     # steady-state window: from the first sink result (compilation and
     # first-batch warmup done) to the end; the first batch's tuples are out
     # of the window.  The total number is reported alongside.  The steady
@@ -714,6 +807,7 @@ def _measure_e2e_graph(graph_factory, n_tuples: int, CAP: int,
         "tuples": n_tuples,
         "elapsed_s": round(elapsed, 3),
         "sweep": sweep,
+        "wire_stats": wire_stats,
     }
 
 
@@ -1117,10 +1211,18 @@ def main() -> None:
             # measured link bandwidth.  On host-attached TPU (PCIe/ICI,
             # tens of GB/s) the same path is compute-bound.
             if platform == "tpu":
+                # wire-honest MB/s: use the run's MEASURED wire
+                # bytes/tuple when the wire stats carry one (equating
+                # staged bytes with the 16-B logical payload would
+                # overstate the link share under compression)
+                _ws = e2e.get("wire_stats") or {}
+                _bpt = (_ws["wire_bytes"] / max(1, e2e["tuples"])
+                        if _ws.get("wire_bytes") else 16)
                 e2e["gap_diagnosis"] = (
                     "link-bound: staging "
-                    f"{e2e['tuples_per_sec'] * 16 / 1e6:.0f}"
-                    " MB/s ~= tunnel bandwidth; kernel reads pre-staged HBM")
+                    f"{e2e['tuples_per_sec'] * _bpt / 1e6:.0f}"
+                    f" MB/s at {_bpt:.1f} wire B/tuple ~= tunnel "
+                    "bandwidth; kernel reads pre-staged HBM")
             else:
                 e2e["gap_diagnosis"] = (
                     "cpu fallback: kernel and pipeline share host cores; "
@@ -1156,6 +1258,37 @@ def main() -> None:
         result["e2e_device_source"] = e2e_dev
     except Exception as e:
         result["e2e_device_source_error"] = f"{type(e).__name__}: {e}"[:400]
+
+    # wire section (windflow_tpu/wire.py, guarded by
+    # tools/check_bench_keys.py + check_bench_regress.py): the seeded
+    # compression A/B over the e2e record spec — wire bytes/tuple,
+    # compression ratio (hard floor 1.5x), and the decode dispatch
+    # delta (hard-pinned 0: the decode rides the existing unpack
+    # program).  staging_share re-reports the staged-vs-device-source
+    # decomposition next to the wire numbers it exists to shrink, and
+    # the staged e2e run's own measured compression rides along.
+    try:
+        wire_sec = run_bench_wire(platform, CONFIGS[platform], jax)
+        dev = result.get("e2e_device_source")
+        wire_sec["staging_share"] = (
+            (dev.get("decomposition") or {}).get(
+                "staging_share_of_staged_run")
+            if isinstance(dev, dict) else None)
+        e2e_ws = None
+        if isinstance(result.get("e2e"), dict):
+            e2e_ws = result["e2e"].pop("wire_stats", None)
+        if isinstance(result.get("e2e_device_source"), dict):
+            result["e2e_device_source"].pop("wire_stats", None)
+        if isinstance(e2e_ws, dict) and e2e_ws.get("wire_bytes"):
+            wire_sec["e2e_compression_ratio"] = \
+                e2e_ws.get("compression_ratio")
+            wire_sec["e2e_wire_bytes_per_tuple"] = round(
+                e2e_ws["wire_bytes"] / max(1, result["e2e"]["tuples"]), 3)
+        result["wire"] = wire_sec
+    except Exception as e:  # lint: broad-except-ok (same stance as the
+        # other guarded legs: a wire regression must fail
+        # check_bench_keys loudly, not kill the bench artifact)
+        result["wire_error"] = f"{type(e).__name__}: {e}"[:300]
 
     # roofline decomposition (sweep ledger, guarded by
     # tools/check_bench_keys.py): the staged e2e run's per-hop ledger
@@ -1573,6 +1706,7 @@ def main() -> None:
                  "device": result.get("device"),
                  "health": result.get("health"),
                  "shard": result.get("shard"),
+                 "wire": result.get("wire"),
                  "durability": result.get("durability"),
                  "e2e": result.get("e2e"),
                  "e2e_device_source": result.get("e2e_device_source"),

@@ -20,6 +20,7 @@ python tools/wf_lint.py
 # asserts it IS flagged.
 python tools/wf_verify.py --strict \
     tools.verify_targets:bench_e2e \
+    tools.verify_targets:wire_ingest \
     tools.verify_targets:chaos_window_cb \
     tools.verify_targets:chaos_window_tb \
     tools.verify_targets:chaos_reduce \
@@ -55,7 +56,8 @@ python -m pytest tests/test_staging.py tests/test_observability.py \
     tests/test_health.py tests/test_sweep_ledger.py \
     tests/test_fusion.py tests/test_durability.py \
     tests/test_shard_plane.py tests/test_tracecheck.py \
-    tests/test_key_compaction.py tests/test_reshard.py -q -m 'not slow'
+    tests/test_key_compaction.py tests/test_reshard.py \
+    tests/test_wire.py -q -m 'not slow'
 python -m pytest tests/ -q -m 'not slow'
 python __graft_entry__.py 8
 BENCH_PLATFORM=cpu BENCH_E2E_TUPLES=131072 python bench.py | tee bench_ci_out.txt

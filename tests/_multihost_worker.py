@@ -76,13 +76,19 @@ def main() -> None:
     key_fn = lambda t: t["k"]
     step = meshmod.make_sharded_ffat_step(mesh, CAPf, Kf, Pn, R, D,
                                           lift, comb, key_fn)
-    state = meshmod.make_sharded_ffat_state(jnp.zeros(()), Kf, R, mesh)
+    # float32 agg seed matching the f32 value lane: an x64-default f64
+    # seed made one state leaf flip f64→f32 after the first step, so
+    # both processes ran TWO compiled program versions whose collectives
+    # could interleave across the Gloo pairs — an intermittent
+    # preamble-size abort (112 vs 56 B = f64 vs f32) this pins away
+    state = meshmod.make_sharded_ffat_state(
+        jnp.zeros((), jnp.float32), Kf, R, mesh)
 
     from windflow_tpu.windows.ffat_kernels import (make_ffat_state,
                                                    make_ffat_step)
     ref_step = jax.jit(make_ffat_step(CAPf, Kf, Pn, R, D, lift, comb,
                                       key_fn))
-    ref_state = make_ffat_state(jnp.zeros(()), Kf, R)
+    ref_state = make_ffat_state(jnp.zeros((), jnp.float32), Kf, R)
 
     from jax.sharding import NamedSharding, PartitionSpec
     bsh = NamedSharding(mesh, PartitionSpec(meshmod.DATA_AXIS))
@@ -220,6 +226,25 @@ def main() -> None:
     print(f"proc {proc_id}: whole PipeGraph.run() across {nproc} "
           f"processes OK ({len(got)} windows on local key shards)",
           flush=True)
+
+    # -- per-host wire/H2D attribution (wire round, sweep ledger) ----------
+    # each host packs and stages only its LOCAL chips' shard, and the
+    # ledger's wire subsection must say so: this process's staged bytes
+    # are 1/nproc of the global lanes, not the global batch re-counted
+    # per host.  Record lanes here: k/v/ts payload (int64+float64+int64)
+    # + ts lane (int64) + valid (bool) = 33 B per global lane.
+    wsec = (g.stats().get("Sweep") or {}).get("wire") or {}
+    assert wsec.get("process_index") == proc_id, wsec
+    assert wsec.get("process_count") == nproc, wsec
+    expected_local = 33 * OBS * NBATCH // nproc
+    assert wsec.get("wire_bytes") == expected_local, \
+        (wsec, expected_local)
+    # mesh staging is per-shard assembly, never the packed wire path:
+    # wire and logical bytes agree on this leg
+    assert wsec.get("logical_bytes") == wsec.get("wire_bytes"), wsec
+    print(f"proc {proc_id}: per-host wire ledger OK "
+          f"({wsec['wire_bytes']} B local of "
+          f"{wsec['wire_bytes'] * nproc} B global)", flush=True)
     print(f"proc {proc_id}: DCN_WORKER_OK", flush=True)
 
 

@@ -266,10 +266,11 @@ def test_chain_into_parallel_keyby_counts_once(tmp_path):
 # mesh: per-key-shard load + the ICI model
 # ---------------------------------------------------------------------------
 
-def _mesh_graph(n_keys=16):
+def _mesh_graph(n_keys=16, aligned=True):
     from windflow_tpu.parallel import mesh as M
     mesh = M.make_mesh(8, data=2)
-    cfg = dataclasses.replace(default_config, mesh=mesh)
+    cfg = dataclasses.replace(default_config, mesh=mesh,
+                              key_aligned_ingest=aligned)
     ks = _zipf_keys(n=8 * 128, n_keys=n_keys, hot=3, share=0.5)
     src = (wf.Source_Builder(lambda: iter(
         {"key": int(k), "v": float(i)} for i, k in enumerate(ks)))
@@ -298,12 +299,21 @@ def test_mesh_key_shard_attribution_and_ici_model():
     assert load["hot_shard"] == 0                 # key 3 lives on shard 0
     assert load["hot_keys"][0]["key"] == 3
     assert load["hot_keys"][0]["shard"] == 0
-    # ICI model: key-sharded FFAT all_gathers the data-sharded batch
+    # ICI model: this host-fed window takes KEY-ALIGNED ingest by
+    # default since the wire round — only the within-column data-axis
+    # hop remains, and the model names it
     ici = entry["ici"]
-    assert ici["collective"] == "all_gather(data)"
+    assert ici["collective"] == "all_gather(data|key-aligned)"
     assert ici["mesh"] == {"data": 2, "key": 4}
     assert ici["ici_bytes_per_tuple"] > 0
     assert g.stats()["Shard"]["totals"]["ici_bytes_per_tuple"] > 0
+    # kill switch restores the data-sharded ingest + full all_gather,
+    # with MORE modeled ICI bytes than the aligned path
+    g2, _ = _mesh_graph(aligned=False)
+    g2.run()
+    ici2 = g2.stats()["Shard"]["per_op"]["mwin"]["ici"]
+    assert ici2["collective"] == "all_gather(data)"
+    assert ici2["ici_bytes_per_tuple"] > ici["ici_bytes_per_tuple"]
 
 
 def test_mesh_arbitrary_keys_mod_placement():

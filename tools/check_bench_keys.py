@@ -112,6 +112,8 @@ DURABILITY_KEYS = ("checkpoint_ms", "restore_ms", "checkpoint_bytes",
                    "overhead_pct")
 SHARD_KEYS = ("imbalance_ratio", "hot_key_share", "ici_bytes_per_tuple")
 VERIFY_KEYS = ("findings", "check_ms")
+WIRE_KEYS = ("wire_bytes_per_tuple", "compression_ratio",
+             "staging_share", "decode_dispatch_delta")
 COMPACTION_KEYS = ("speedup_vs_sorted", "hit_rate", "overflow_share",
                    "churn_per_sweep")
 RESHARD_KEYS = ("plan_apply_ms", "rescale_restore_ms", "keys_moved",
@@ -147,6 +149,9 @@ def check_source() -> None:
              "shard plane — docs/OBSERVABILITY.md shard-plane"),
             ("compaction", COMPACTION_KEYS,
              "key compaction — docs/PERF.md round 12"),
+            ("wire", WIRE_KEYS,
+             "wire compression — docs/PERF.md round 13 / "
+             "docs/OBSERVABILITY.md wire plane"),
             ("durability", DURABILITY_KEYS,
              "checkpoint/restore — docs/DURABILITY.md"),
             ("reshard", RESHARD_KEYS,
@@ -316,6 +321,31 @@ def check_output(path: str) -> None:
         # environmental failure mode — its absence IS the regression
         fail("bench compaction section absent or errored "
              f"(compaction_error={result.get('compaction_error')!r})")
+    wr = result.get("wire")
+    if isinstance(wr, dict):
+        missing = [k for k in WIRE_KEYS if k not in wr]
+        if missing:
+            fail(f"'wire' section missing {missing} from bench output")
+        cr = wr.get("compression_ratio")
+        if not isinstance(cr, (int, float)) or cr < 1.5:
+            # the seeded leg runs the e2e record spec (dict key lane,
+            # raw f32 value, cadence ts): under 1.5x means a codec,
+            # the selection, or the encoder broke — the wire round's
+            # whole claim (docs/PERF.md round 13)
+            fail(f"wire compression_ratio={cr!r} below the 1.5x floor "
+                 "on the e2e record spec")
+        dd = wr.get("decode_dispatch_delta")
+        if dd:
+            # the decode is traced INTO the existing unpack program;
+            # ANY nonzero per-batch dispatch delta means it grew its
+            # own dispatch — the zero-extra-dispatch contract broke
+            fail(f"wire decode_dispatch_delta={dd} — decompression "
+                 "added device dispatches (must ride staging.unpack)")
+    else:
+        # the wire leg is an in-process seeded A/B with no
+        # environmental failure mode — its absence IS the regression
+        fail("bench wire section absent or errored "
+             f"(wire_error={result.get('wire_error')!r})")
     dura = result.get("durability")
     if isinstance(dura, dict):
         missing = [k for k in DURABILITY_KEYS if k not in dura]

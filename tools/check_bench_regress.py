@@ -87,6 +87,12 @@ GUARDED = (
     # the SPEED.
     ("compaction.speedup_vs_sorted", True,
      "compaction.speedup_dispersion.rel_spread"),
+    # wire plane: the leg's stream is SEEDED and EVENT-timed, so the
+    # measured wire bytes/tuple is deterministic — a >10% rise means a
+    # codec stopped engaging (selection, fit check, or the dict union
+    # broke), not weather.  LOWER is better.  compression_ratio's hard
+    # 1.5x floor lives in check_bench_keys; this guards the trend.
+    ("wire.wire_bytes_per_tuple", False, None),
     # reshard executor: keys_moved is fully deterministic on the seeded
     # colocated-warm-pair stream (trigger → advisor plan → apply), so
     # any change is a planner/trigger regression.  plan_apply_ms /
@@ -125,6 +131,10 @@ def comparable(cur: dict, prev: dict, path: str) -> bool:
         # the shard leg's skew numbers are seeded per tuple count
         # (BENCH_SHARD_TUPLES): a different stream is a different truth
         return dig(cur, "shard.tuples") == dig(prev, "shard.tuples")
+    if path.startswith("wire."):
+        # the wire leg is seeded per tuple count AND window spec (codec
+        # choice sees the spec's lanes): only identical streams compare
+        return dig(cur, "wire.tuples") == dig(prev, "wire.tuples")
     if path.startswith("reshard."):
         # the reshard leg's move count is seeded per tuple count
         # (BENCH_RESHARD_TUPLES): a different stream plans differently

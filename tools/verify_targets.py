@@ -49,6 +49,37 @@ def bench_e2e():
     return g
 
 
+def wire_ingest():
+    """Compressed-ingest shape (windflow_tpu/wire.py): a declared-spec
+    source staging wire-compressed batches — monotone ts/id lanes, a
+    low-cardinality dict lane, a raw float lane — into a keyed reduce.
+    Verifies the wire plane's decode-bearing graph composes clean under
+    wfverify (the decode itself is framework code inside the unpack
+    program; this pins the USER kernels around a compressed edge)."""
+    import numpy as np
+
+    import windflow_tpu as wf
+    src = (wf.Source_Builder(lambda: iter(()))
+           .withOutputBatchSize(4096)
+           .withRecordSpec({"id": np.int64(0), "key": np.int32(0),
+                            "v": np.float32(0.0)}).build())
+    red = (wf.ReduceTPU_Builder(
+        lambda a, b: {"id": jnp_max(a["id"], b["id"]),
+                      "key": jnp_max(a["key"], b["key"]),
+                      "v": jnp_max(a["v"], b["v"])})
+        .withKeyBy(lambda t: t["key"]).withMaxKeys(64)
+        .withMonoidCombiner("max").build())
+    g = wf.PipeGraph("verify_wire_ingest")
+    g.add_source(src).add(red).add_sink(
+        wf.Sink_Builder(lambda r: None).build())
+    return g
+
+
+def jnp_max(a, b):
+    import jax.numpy as jnp
+    return jnp.maximum(a, b)
+
+
 def _chaos(family: str):
     from windflow_tpu.durability.chaos import make_cell
     ckpt = tempfile.mkdtemp(prefix=f"wfverify_{family}_ck_")

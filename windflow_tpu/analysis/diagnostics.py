@@ -91,6 +91,14 @@ CODES = {
                          "re-bucket it"),
     "WF605": ("error", "restore manifest shard shape cannot be "
                        "re-bucketed onto the target graph"),
+    # wire plane (windflow_tpu/wire.py, docs/OBSERVABILITY.md "Wire
+    # plane"): codec choice needs the lane semantics only a
+    # declared/inferred record spec provides — a spec-less staging edge
+    # under Config.wire_compression downgrades to raw passthrough, and
+    # that downgrade is NAMED here instead of happening silently
+    "WF606": ("warning", "wire compression downgraded to raw "
+                         "passthrough: the staging edge has no "
+                         "declared/inferred record spec"),
     # -- determinism for replay (WF61x, wfverify — analysis/tracecheck.py):
     #    kernels and callbacks of a durability-enabled graph must
     #    regenerate the committed prefix identically on replay

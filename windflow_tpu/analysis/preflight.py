@@ -192,8 +192,83 @@ def check_graph(graph) -> List[Diagnostic]:
     _watermark_pass(graph, ops, upstreams, diags)
     _durability_pass(graph, ops, diags)
     _kernel_pass(graph, ops, edges, upstreams, diags)
+    _wire_pass(graph, ops, edges, upstreams, diags)
     _tracecheck_pass(graph, diags)
     return diags
+
+
+def _wire_pass(graph, ops, edges, upstreams, diags) -> None:
+    """WF606: wire compression (windflow_tpu/wire.py) engages only on
+    staging edges whose record spec is declared/inferred — codec choice
+    needs the lane semantics.  With ``Config.wire_compression`` on, a
+    spec-less host→TPU edge gets a NAMED warning and the documented
+    raw-passthrough downgrade instead of a silent one.  Mesh graphs are
+    exempt: their staging is per-shard assembly, never the packed wire
+    path."""
+    from windflow_tpu.wire import wire_enabled
+    if not wire_enabled(graph.config) or graph.config.mesh is not None:
+        return
+    try:
+        in_specs, _ = propagate_specs(graph, ops=ops, edges=edges,
+                                      upstreams=upstreams)
+    except Exception:  # noqa: BLE001 - lint: broad-except-ok (abstract
+        # eval of arbitrary user kernels; an internal failure must not
+        # add spurious WF606s on top of the kernel pass's real findings)
+        return
+    seen = set()
+
+    def specless_source_upstream(op, visited) -> bool:
+        """True when some SOURCE feeding ``op`` declares/infers no
+        record spec — the WF606 case.  A spec that is merely ambiguous
+        (merge structure drift) is WF106's finding, not a new one."""
+        if id(op) in visited:
+            return False
+        visited.add(id(op))
+        ups = upstreams.get(id(op))
+        if ups is None or not ups[1]:   # a root: source-like
+            return source_spec(op) is _UNKNOWN
+        return any(specless_source_upstream(u, visited) for u in ups[1])
+
+    def source_spec(op):
+        if getattr(op, "record_spec", None) is not None:
+            return object()     # declared (well-formedness is WF101's)
+        from windflow_tpu.io.device_source import DeviceSource
+        if isinstance(op, DeviceSource) and op.batch_fn is not None:
+            return object()     # inferred from batch_fn
+        return _UNKNOWN
+
+    def note(a, b) -> None:
+        spec = in_specs.get(id(b))
+        if spec is not None and spec is not _UNKNOWN:
+            return
+        if not specless_source_upstream(b, set()):
+            return
+        if (id(a), id(b)) in seen:
+            return
+        seen.add((id(a), id(b)))
+        diags.append(Diagnostic(
+            "WF606",
+            f"staging edge '{a.name}' → '{b.name}' has no "
+            "declared/inferred record spec: wire compression "
+            "(Config.wire_compression) downgrades to raw passthrough "
+            "on this edge",
+            node=b.name,
+            hint="declare the stream's record shape with "
+                 "Source_Builder.withRecordSpec(example); DeviceSource "
+                 "infers its spec from batch_fn"))
+
+    for edge in edges:
+        if edge[0] == "op":
+            _, a, b = edge
+            if b.is_tpu and not a.is_tpu:
+                note(a, b)
+        else:
+            _, mp = edge
+            src = mp.operators[-1]
+            for child in mp.split_children:
+                if child.operators and child.operators[0].is_tpu \
+                        and not src.is_tpu:
+                    note(src, child.operators[0])
 
 
 def _tracecheck_pass(graph, diags) -> None:

@@ -285,6 +285,39 @@ class Config:
     # pays, at this cadence.
     key_compaction_reseed: int = int(os.environ.get(
         "WF_TPU_KEY_COMPACTION_RESEED", "64"))
+    # Wire compression (windflow_tpu/wire.py, docs/PERF.md round 13 /
+    # docs/OBSERVABILITY.md "Wire plane"): staged batches' packed
+    # buffers are re-encoded lane by lane (delta/delta-of-delta for
+    # monotone ts/id lanes, dictionary for low-cardinality int lanes,
+    # constant collapse, bit-packing; raw passthrough fallback) before
+    # the ONE fused host→device transfer, and the inverse decode is
+    # traced INTO the existing unpack program — zero extra dispatches.
+    # Engages only on edges with a declared/inferred record spec
+    # (Source_Builder.withRecordSpec / DeviceSource inference); a
+    # spec-less source downgrades to raw passthrough with a WF606
+    # preflight warning.  Per-lane codec choice re-evaluates on the
+    # key_compaction_reseed cadence and surfaces in
+    # stats()["Staging"]["Wire"].  Default "auto": ON whenever the
+    # default backend is a real accelerator (the wire is a slow link
+    # worth shrinking — the tentpole case) and OFF on the CPU fallback,
+    # where host and "device" share memory and encode/decode would be
+    # pure overhead on the staged path.  WF_TPU_WIRE=1 forces on
+    # anywhere (the bench wire leg and the A/B tests do), =0 is the
+    # kill switch: no encoder attaches and each staged batch keeps one
+    # flag check.  Typed loosely: True/False/"auto"/"1"/"0" all work
+    # (wire.wire_enabled resolves it).
+    wire_compression: object = os.environ.get("WF_TPU_WIRE", "auto")
+    # Key-aligned mesh ingest (parallel/emitters.AlignedMeshStageEmitter
+    # + mesh.py ingest="aligned", docs/OBSERVABILITY.md "Wire plane"):
+    # host-fed key-sharded FFAT consumers take their batches PRE-PLACED
+    # on the owning key shard (the dense-range owner the sharded step
+    # compiles; executor key moves deliberately do not apply — mesh
+    # reshard routes through rescale-on-restore), killing the data-axis
+    # all_gather the ICI model names dominant.  Off
+    # (WF_TPU_KEY_ALIGNED=0) keeps the data-sharded ingest +
+    # in-program gather everywhere.
+    key_aligned_ingest: bool = bool(int(os.environ.get(
+        "WF_TPU_KEY_ALIGNED", "1")))
     # Whole-chain fusion (windflow_tpu/fusion, docs/PERF.md round 10):
     # at graph build, maximal fusible runs of adjacent TPU operators
     # (the fusion advisor's plan — analysis/fusion.py) lower into ONE

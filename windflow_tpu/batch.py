@@ -229,7 +229,7 @@ _words = staging.lane_words
 _packable_dtype = staging.packable_dtype
 
 
-def _get_unpack(treedef, dtypes, capacity: int):
+def _get_unpack(treedef, dtypes, capacity: int, wire=None):
     """Cached device program re-typing one packed uint32 staging buffer
     into payload columns + ts lane + validity mask (derived on device from
     the trailing fill-count word — never transferred separately, and cached
@@ -237,27 +237,45 @@ def _get_unpack(treedef, dtypes, capacity: int):
     pool's recycling GATE: it depends on the transferred buffer like every
     other output, but it is never handed to a consumer, so no downstream
     ``donate_argnums`` (ops/chained.py, windflow_tpu/fusion) can delete it
-    out from under ``StagingPool.acquire``'s readiness sync."""
-    key = (treedef, dtypes, capacity)
+    out from under ``StagingPool.acquire``'s readiness sync.
+
+    ``wire`` (a ``wire.WireFormat``) switches the program to the wire-
+    compressed layout: the columnar decode (``wire.build_wire_decode``)
+    is inlined AHEAD of the mask derivation inside this SAME program —
+    decompression costs zero extra dispatches, and each distinct wire
+    descriptor keys its own cached program (a fresh compile, never a
+    re-trace of an existing one)."""
+    key = (treedef, dtypes, capacity, wire)
     unpack = _UNPACK_CACHE.get(key)
     if unpack is None:
-        def unpack_fn(b):
-            cols, off = [], 0
-            for dt in dtypes + ("int64",):
-                d = np.dtype(dt)
-                if d.itemsize == 8:
-                    seg = b[off:off + 2 * capacity]
-                    lo = seg[0::2].astype(jnp.int64)
-                    hi = seg[1::2].astype(jnp.int64)
-                    cols.append(((hi << 32) | lo).astype(d))
-                    off += 2 * capacity
-                else:
-                    cols.append(jax.lax.bitcast_convert_type(
-                        b[off:off + capacity], d))
-                    off += capacity
-            n_valid = b[-1].astype(jnp.int32)
-            return cols[:-1], cols[-1], \
-                jnp.arange(capacity, dtype=jnp.int32) < n_valid, n_valid
+        if wire is not None:
+            from windflow_tpu.wire import build_wire_decode
+            decode = build_wire_decode(wire, dtypes, capacity)
+
+            def unpack_fn(b):
+                cols = decode(b)
+                n_valid = b[-1].astype(jnp.int32)
+                return cols[:-1], cols[-1], \
+                    jnp.arange(capacity, dtype=jnp.int32) < n_valid, \
+                    n_valid
+        else:
+            def unpack_fn(b):
+                cols, off = [], 0
+                for dt in dtypes + ("int64",):
+                    d = np.dtype(dt)
+                    if d.itemsize == 8:
+                        seg = b[off:off + 2 * capacity]
+                        lo = seg[0::2].astype(jnp.int64)
+                        hi = seg[1::2].astype(jnp.int64)
+                        cols.append(((hi << 32) | lo).astype(d))
+                        off += 2 * capacity
+                    else:
+                        cols.append(jax.lax.bitcast_convert_type(
+                            b[off:off + capacity], d))
+                        off += capacity
+                n_valid = b[-1].astype(jnp.int32)
+                return cols[:-1], cols[-1], \
+                    jnp.arange(capacity, dtype=jnp.int32) < n_valid, n_valid
         unpack = wf_jit(unpack_fn, op_name="staging.unpack")
         _UNPACK_CACHE[key] = unpack
     return unpack
@@ -267,19 +285,26 @@ def stage_packed(buf: np.ndarray, treedef, dtypes, capacity: int, n: int,
                  watermark: int = WM_NONE, device=None,
                  frontier: Optional[int] = None,
                  ts_max: Optional[int] = None, ts_min: Optional[int] = None,
-                 pool=None, trace: Optional[tuple] = None) -> DeviceBatch:
+                 pool=None, trace: Optional[tuple] = None,
+                 wire=None, logical_nbytes: Optional[int] = None
+                 ) -> DeviceBatch:
     """ONE host→device transfer of a packed staging buffer (built by
     ``staging.PackedBatchBuilder`` or the inline pack in ``_stage_soa``)
     into a DeviceBatch.  When ``pool`` is given, ``buf`` is recycled with
     the unpack output as its gate — the device owns the buffer until the
     unpack has executed, so reuse can never race the (asynchronous)
-    transfer (staging.StagingPool)."""
-    unpack = _get_unpack(treedef, dtypes, capacity)
+    transfer (staging.StagingPool).  ``wire`` marks ``buf`` as a wire-
+    compressed buffer (windflow_tpu/wire.py): the matching columnar
+    decode is inlined into the unpack program itself, and
+    ``logical_nbytes`` keeps the byte accounting honest (wire bytes =
+    the transfer, logical bytes = the decoded lanes)."""
+    unpack = _get_unpack(treedef, dtypes, capacity, wire=wire)
     dbuf = jnp.asarray(buf) if device is None \
         else jax.device_put(buf, device)
     # device-plane accounting (monitoring/device_metrics): every fused
-    # staging transfer credits the process-wide staged-byte gauge
-    staging.device_bytes.note(buf.nbytes)
+    # staging transfer credits the process-wide staged-byte gauge —
+    # wire bytes as shipped, logical bytes as decoded
+    staging.device_bytes.note(buf.nbytes, logical_nbytes)
     cols, ts, valid, gate = unpack(dbuf)
     if pool is not None:
         # gate on the unpack's private scalar output, NOT a lane the

@@ -303,7 +303,16 @@ def make_cell(family: str, ckpt_dir: str, *, fusion: bool = True,
             return True
         if msg.value == "EOS":
             return False
-        shipper.pushWithTimestamp(dict(msg.value), msg.timestamp_usec)
+        # float32 value lane (exact here: the stream holds small
+        # integers, and every family's arithmetic stays < 2^24) so the
+        # staged records pack — the chaos A/B therefore exercises the
+        # WIRE-COMPRESSED staging path end to end (windflow_tpu/wire.py;
+        # a float64 lane would silently fall back to per-lane transfers
+        # and prove nothing about the decode)
+        import numpy as _np
+        r = dict(msg.value)
+        r["value"] = _np.float32(r["value"])
+        shipper.pushWithTimestamp(r, msg.timestamp_usec)
         return True
 
     file_sink = None
@@ -325,6 +334,10 @@ def make_cell(family: str, ckpt_dir: str, *, fusion: bool = True,
         cfg.health_postmortem_on_crash = False
         src = KafkaSource(deser, broker, ["in"], group_id="chaos",
                           name="ksrc", output_batch_size=256)
+        # declared record spec: lets the wire plane compress this edge
+        # (WF606 contract) — and the A/B diff then pins the decode
+        import numpy as _np
+        src.record_spec = {"key": _np.int64(0), "value": _np.float32(0.0)}
         g = wf.PipeGraph(app, config=cfg)
         pipe = g.add_source(src)
         ser = (lambda r: KafkaSinkMessage(

@@ -101,7 +101,15 @@ def build_prelude(members):
     """One traced ``(payload, valid) -> (payload, valid)`` body applying
     every stateless member's record transform in chain order — the
     generalization of ``ChainedTPU``'s spec loop that stateful tails
-    inline ahead of their own step.  Returns ``(prelude, has_filter)``."""
+    inline ahead of their own step.  Returns ``(prelude, has_filter)``.
+
+    Wire-compressed staging (windflow_tpu/wire.py) composes AHEAD of
+    this prelude at zero dispatch cost: ``batch.stage_packed`` inlines
+    the traced ``wire.build_wire_decode`` stage into the unpack program
+    the staged path already dispatches, so by the time a fused segment's
+    program (prelude + tail) sees the batch, its lanes are decoded —
+    the per-batch dispatch sequence stays exactly ``unpack → fused
+    program``, compressed or not (pinned by tests/test_wire.py)."""
     from windflow_tpu.ops.chained import _tpu_specs
     specs = []
     for op in members:

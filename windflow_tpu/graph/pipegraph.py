@@ -255,6 +255,15 @@ class PipeGraph:
             # only an "off" run needs the original hard build-time check
             self._check_fixed_capacity_ops()
 
+        # 1a. key-aligned mesh ingest (ROADMAP item 4b): stamp eligible
+        # host-fed key-sharded FFAT consumers BEFORE wiring — the
+        # emitter dispatch (create_emitter) and the op's sharded step
+        # factory both read the stamp (parallel/mesh.mark_aligned_ingest)
+        if self.config.mesh is not None \
+                and getattr(self.config, "key_aligned_ingest", True):
+            from windflow_tpu.parallel.mesh import mark_aligned_ingest
+            mark_aligned_ingest(self)
+
         # 1b. whole-chain fusion (windflow_tpu/fusion): executable fused
         # segments lower into ONE program per batch — installed BEFORE
         # wiring so the redirect below can route each segment as one hop.
@@ -442,6 +451,16 @@ class PipeGraph:
         if getattr(cfg, "key_compaction", True):
             from windflow_tpu.parallel.compaction import attach_compaction
             attach_compaction(self)
+
+        # 3f'. wire plane (windflow_tpu/wire.py): enable columnar wire
+        # compression on the staging emitters whose feeding edge has a
+        # declared/inferred record spec — AFTER wiring (the emitters
+        # exist) and before anything stages.  Spec-less edges stay raw
+        # passthrough (preflight named them as WF606); off/auto-on-CPU
+        # attaches no encoder anywhere.
+        from windflow_tpu.wire import attach_wire, wire_enabled
+        if wire_enabled(cfg):
+            attach_wire(self)
 
         # 3g. reshard executor (windflow_tpu/serving): built LAST — it
         # discovers the keyed emitters the wiring installed, reads the
@@ -1063,6 +1082,10 @@ class PipeGraph:
             # staging plane (windflow_tpu/staging): host-buffer recycling
             # pool counters + lookahead tick count
             "Staging_pool": _staging_pool_stats(),
+            # wire plane (windflow_tpu/wire.py): per-lane codec table +
+            # wire-vs-logical byte counters of this graph's staging
+            # emitters (docs/OBSERVABILITY.md "Wire plane")
+            "Staging": {"Wire": self._wire_section()},
             "Stage_prefetch_depth": self.config.stage_prefetch_depth,
             "Stage_prefetch_ticks": self._prefetch_ticks,
             "Dropped_tuples": self.get_num_dropped_tuples(),
@@ -1071,9 +1094,16 @@ class PipeGraph:
                                + (1 if self._monitor is not None else 0),
             "rss_size_kb": _rss_kb(),
             # graph-level transfer totals (reference per-replica H2D/D2H
-            # counters, stats_record.hpp:152-160, summed here)
+            # counters, stats_record.hpp:152-160, summed here).
+            # Bytes_H2D_total is the WIRE total (bytes actually moved);
+            # the logical total is what the decoded lanes occupy — the
+            # two diverge exactly by the wire plane's compression, and
+            # equating them would let compression silently inflate every
+            # bytes-derived ratio (wire-round honesty fix)
             "Bytes_H2D_total": sum(r.stats.h2d_bytes
                                    for r in self._all_replicas),
+            "Bytes_H2D_logical_total": sum(r.stats.h2d_logical_bytes
+                                           for r in self._all_replicas),
             "Bytes_D2H_total": sum(r.stats.d2h_bytes
                                    for r in self._all_replicas),
             # flight-recorder layer (monitoring/recorder.py): latency
@@ -1120,6 +1150,19 @@ class PipeGraph:
             "Reshard": self._reshard_section(),
             "Operators": [op.dump_stats() for op in self._operators],
         }
+
+    def _wire_section(self) -> dict:
+        """Guarded like every other plane section; with
+        ``Config.wire_compression`` off the emitters carry no encoders
+        and the section reports enabled=False with zero counters."""
+        try:
+            from windflow_tpu.wire import wire_section
+            return wire_section(self)
+        except Exception as e:  # lint: broad-except-ok (a telemetry
+            # read must never take the pipeline or a stats dump down —
+            # same stance as every other plane section)
+            return {"enabled": None, "error": f"{type(e).__name__}: "
+                                              f"{e}"[:200]}
 
     def _device_section(self) -> dict:
         """Guarded: a metrics read must never take the pipeline down

@@ -12,6 +12,16 @@ carries the hot path.  Uses:
 * replay of device-resident datasets (arrays already in HBM);
 * load generators for soak tests.
 
+Device-born batches never touch the wire plane (windflow_tpu/wire.py):
+there is no host→device transfer to compress, which is exactly why the
+bench's ``e2e_device_source`` leg anchors the staging-share
+decomposition the wire round's ``staging_share`` number is read
+against.  ``batch_fn`` still matters to the wire plane indirectly: the
+preflight spec walk infers this source's record spec from it
+(``analysis/preflight.propagate_specs``), so a DeviceSource feeding a
+host stage that later re-stages to a device edge keeps that edge
+spec-known (no WF606 downgrade).
+
 Contract: ``batch_fn(i)`` is JAX-traceable, maps the int32 batch index to
 a payload pytree whose leaves have leading dimension ``capacity``; it is
 jitted once and executed per tick.  Timestamps: INGRESS stamps the whole

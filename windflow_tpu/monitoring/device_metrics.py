@@ -94,6 +94,10 @@ def device_section(graph: Optional[object] = None) -> dict:
                 staging.default_pool().stats()["held_bytes"],
             "staged_device_bytes_total":
                 staging.device_bytes.staged_bytes_total,
+            # decoded bytes behind the transfers — diverges from the
+            # wire total exactly by the wire plane's compression
+            "staged_logical_bytes_total":
+                staging.device_bytes.logical_bytes_total,
             "staged_device_batches_total":
                 staging.device_bytes.staged_batches_total,
         },

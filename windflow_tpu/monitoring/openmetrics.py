@@ -279,6 +279,33 @@ def _families(stats: dict,
                 "fusion (windflow_tpu/fusion)") \
                 .add(fusion["dispatches_saved_per_batch"], base)
 
+    # -- wire plane ----------------------------------------------------------
+    wire = (stats.get("Staging") or {}).get("Wire") or {}
+    if wire.get("enabled") and isinstance(wire.get("wire_bytes"),
+                                          (int, float)):
+        fam("wf_wire_bytes_total", "counter",
+            "Bytes actually transferred host->device by wire-compressed "
+            "staging (windflow_tpu/wire.py)") \
+            .add(wire["wire_bytes"], base)
+        fam("wf_wire_logical_bytes_total", "counter",
+            "Decoded (pre-compression) bytes behind the wire transfers") \
+            .add(wire.get("logical_bytes", 0), base)
+        fam("wf_wire_batches_total", "counter",
+            "Staged batches shipped wire-compressed") \
+            .add(wire.get("batches", 0), base)
+        fam("wf_wire_raw_batches_total", "counter",
+            "Staged batches where compression lost and the logical "
+            "buffer shipped unchanged") \
+            .add(wire.get("raw_batches", 0), base)
+        fam("wf_wire_fallback_lanes_total", "counter",
+            "Per-batch lane codec misfits degraded to raw") \
+            .add(wire.get("fallback_lanes", 0), base)
+        if isinstance(wire.get("compression_ratio"), (int, float)):
+            fam("wf_wire_compression_ratio", "gauge",
+                "Logical over wire bytes of the graph's compressed "
+                "staging (docs/OBSERVABILITY.md wire plane)") \
+                .add(wire["compression_ratio"], base)
+
     # -- shard plane ---------------------------------------------------------
     shard = stats.get("Shard") or {}
     if shard.get("enabled"):

@@ -596,7 +596,8 @@ class ShardLedger:
         return sk
 
     def _attach(self) -> None:
-        from windflow_tpu.parallel.emitters import (DeviceKeyByEmitter,
+        from windflow_tpu.parallel.emitters import (AlignedMeshStageEmitter,
+                                                    DeviceKeyByEmitter,
                                                     DeviceStageEmitter,
                                                     DeviceToHostEmitter,
                                                     KeyByEmitter,
@@ -617,7 +618,17 @@ class ShardLedger:
             if not em.dests:
                 return
             consumer = em.dests[0][0].op
-            if isinstance(em, KeyedDeviceStageEmitter):
+            if isinstance(em, AlignedMeshStageEmitter):
+                # key-aligned mesh ingest: the keys are host-visible at
+                # this boundary (the emitter routed by them), so the
+                # probe sees exactly the placement the columns realize
+                # (dense_range ownership — _sketch_for detects the mesh)
+                kx = consumer.key_extractor
+                if consumer.is_keyed and kx is not None:
+                    sk = self._sketch_for(consumer, consumer.parallelism,
+                                          "splitmix")
+                    em._shard_probe = HostKeyProbe(sk, kx)
+            elif isinstance(em, KeyedDeviceStageEmitter):
                 em._sketch = self._sketch_for(consumer, len(em.dests),
                                               "splitmix")
             elif isinstance(em, DeviceKeyByEmitter):
@@ -731,6 +742,15 @@ class ShardLedger:
                 # hash-sharded all_to_all: (n-1)/n of the lanes cross ICI
                 total = cap * bpt * (n - 1) / n
                 kind = "all_to_all(lanes)"
+        elif getattr(op, "_ingest_mode", None) == "aligned":
+            # key-aligned ingest (parallel/emitters.
+            # AlignedMeshStageEmitter): the host pre-placed each tuple
+            # on its key-owner column, so only the within-column
+            # data-axis gather remains — each key shard re-assembles
+            # its OWN cap/kk lanes, zero key-axis traffic (identity on
+            # a 1-wide data axis)
+            total = cap * bpt * (dd - 1)
+            kind = "all_gather(data|key-aligned)"
         else:
             # key-sharded state (FFAT / stateful): every key shard
             # all_gathers the data-sharded batch — each of the kk*dd

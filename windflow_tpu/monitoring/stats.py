@@ -41,6 +41,12 @@ class StatsRecord:
     # by the TPU→host boundary (DeviceToHostEmitter) and columnar sinks.
     device_programs_launched: int = 0
     h2d_bytes: int = 0
+    #: decoded (pre-compression) bytes behind h2d_bytes: the wire plane
+    #: (windflow_tpu/wire.py) makes the two diverge — h2d_bytes is the
+    #: actual transfer, this is what the decoded lanes occupy.  Counting
+    #: only one of them would let compression silently inflate every
+    #: bytes-derived ratio (roofline attributed_fraction, MB/s legs).
+    h2d_logical_bytes: int = 0
     d2h_bytes: int = 0
     #: actual replica termination state (reference Stats_Record terminated
     #: flag); set by Replica._terminate — live dashboard reports show the
@@ -90,6 +96,7 @@ class StatsRecord:
             "Is_terminated": self.is_terminated,
             "Device_programs_launched": self.device_programs_launched,
             "Bytes_H2D": self.h2d_bytes,
+            "Bytes_H2D_logical": self.h2d_logical_bytes,
             "Bytes_D2H": self.d2h_bytes,
         }
         if self.e2e_hist.count:

@@ -337,10 +337,29 @@ class SweepLedger:
                 fusion_dsaved += n_members - dpb
             fusion_bsaved += bsum
             fusion_chains.append(entry)
+        # wire plane (windflow_tpu/wire.py): THIS HOST's share of the
+        # graph's staged traffic, wire vs logical — on a multi-host DCN
+        # feed each process packs and stages only its local chips'
+        # shard, and this is where that per-host attribution surfaces
+        # (per-replica splits live in the replica stats' Bytes_H2D /
+        # Bytes_H2D_logical pair)
+        import jax as _jax
+        wire_h2d = sum(r.stats.h2d_bytes for r in g._all_replicas)
+        logical_h2d = sum(r.stats.h2d_logical_bytes
+                          for r in g._all_replicas)
+        wire_host = {
+            "process_index": _jax.process_index(),
+            "process_count": _jax.process_count(),
+            "wire_bytes": wire_h2d,
+            "logical_bytes": logical_h2d,
+            "compression_ratio": round(logical_h2d / wire_h2d, 4)
+            if wire_h2d else None,
+        }
         return {
             "enabled": True,
             "per_hop": per_hop,
             "non_hop": non_hop,
+            "wire": wire_host,
             "fusion": {
                 "enabled": bool(fusion_chains),
                 "fused_chains": [c["name"] for c in fusion_chains],

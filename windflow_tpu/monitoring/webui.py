@@ -105,10 +105,18 @@ async function render(id) {
     ? `health=${health.graph_state || "?"} ` +
       `stalls=${health.stall_events ?? 0}`
     : "health=off") + (last.Aborted ? "  ABORTED" : "");
+  // wire plane: compression ratio of the staged ingest (logical over
+  // wire bytes) — "off"/"raw" make the no-compression cases explicit
+  const wire = (last.Staging || {}).Wire || {};
+  const wLine = wire.enabled
+    ? (wire.compression_ratio != null
+       ? `wire=${wire.compression_ratio}x` : "wire=raw")
+    : "wire=off";
   document.getElementById("meta").textContent =
     `mode=${last.Mode}  operators=${last.Operator_number}  ` +
     `dropped=${last.Dropped_tuples}  rss=${last.rss_size_kb} kB  ` +
-    `throttle_events=${last.Backpressure_throttle_events}  ${hLine}\n` +
+    `throttle_events=${last.Backpressure_throttle_events}  ` +
+    `${wLine}  ${hLine}\n` +
     `device: compiles=${jt.compiles ?? "?"} ` +
     `recompiles=${jt.recompiles ?? "?"} ` +
     `compile_ms=${jt.compile_ms_total ?? "?"}  ` +
@@ -181,7 +189,7 @@ async function render(id) {
     const ici = (sh.ici || {}).ici_bytes_per_tuple;
     const open = (window._openShards || new Set()).has(i);
     return `<tr id="shard_${i}" style="display:${open ? "" : "none"}">` +
-           `<td colspan="12">` +
+           `<td colspan="13">` +
            `<table><tr><th>shard</th><th>queue</th><th>wm lag</th>` +
            `<th>tuples</th><th>p50</th><th>p99</th><th>disp</th>` +
            `<th>HBM B</th></tr>${rows}</table>` +
@@ -204,7 +212,7 @@ async function render(id) {
     `<table><tr><th>operator</th><th>health</th><th>replicas</th>` +
     `<th>outputs</th>` +
     `<th>ignored</th><th>p50</th><th>p95</th><th>p99</th>` +
-    `<th>disp/batch</th><th>B/tuple</th>` +
+    `<th>disp/batch</th><th>B/tuple</th><th>wire</th>` +
     `<th>wm lag</th><th>throughput (tuples/report)</th></tr>` +
     lastOps.map(op => {
       const name = op.Operator_name || op.Name || "?";
@@ -230,6 +238,15 @@ async function render(id) {
         ? `⇒ ${esc(hop.fused_into)}`
         : (hop.dispatches_per_batch == null ? "–"
            : hop.dispatches_per_batch);
+      // wire plane: per-op compression ratio of the staged transfers
+      // this op's replicas shipped (Bytes_H2D_logical over Bytes_H2D —
+      // "raw" when the op stages uncompressed, "–" when it stages
+      // nothing)
+      const wSent = reps.reduce((s, r) => s + (r.Bytes_H2D || 0), 0);
+      const wLog = reps.reduce(
+        (s, r) => s + (r.Bytes_H2D_logical || 0), 0);
+      const wCell = !wSent ? "–"
+        : (wLog > wSent ? `${(wLog / wSent).toFixed(2)}x` : "raw");
       const idx = lastOps.indexOf(op);
       const sub = shardRow(name, idx);
       const nameCell = sub
@@ -241,7 +258,7 @@ async function render(id) {
              `<td>${outs}</td><td>${ign}</td>` +
              `<td>${fmtUs(q.p50)}</td><td>${fmtUs(q.p95)}</td>` +
              `<td>${fmtUs(q.p99)}</td>` +
-             `<td>${dpb}</td><td>${bpt}</td>` +
+             `<td>${dpb}</td><td>${bpt}</td><td>${wCell}</td>` +
              `<td>${spark(lh.slice(-60), 80, 26)} ${fmtUs(lag)}</td>` +
              `<td>${spark(h.slice(-60), 160, 26)} ${cur}</td></tr>` + sub;
     }).join("") + "</table>";

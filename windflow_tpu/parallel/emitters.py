@@ -471,6 +471,15 @@ class DeviceStageEmitter(Emitter):
         # extractor host-side at batch granularity; None leaves one
         # check per columnar chunk / per shipped record batch
         self._shard_probe = None
+        # wire plane (windflow_tpu/wire.py): enabled by wire.attach_wire
+        # at graph build when the feeding edge has a declared/inferred
+        # record spec — finished packed buffers are re-encoded lane by
+        # lane (delta/dict/const/bit-pack) into a pooled wire buffer and
+        # the inverse decode rides the SAME unpack dispatch on device.
+        # Off/downgraded leaves exactly one flag check per finalize.
+        self._wire_on = False
+        self._wire_reseed = 64
+        self._wire_encoders = {}
         # Multi-chip: lay staged batch lanes out data-sharded over the mesh
         # so downstream sharded programs consume them without a reshard
         # (parallel/mesh.py batch_sharding).
@@ -502,6 +511,36 @@ class DeviceStageEmitter(Emitter):
     def _advance_frontier(self, wm):
         if wm != WM_NONE and wm > self._frontier:
             self._frontier = wm
+
+    def _local_share(self, nbytes: int) -> int:
+        """This PROCESS's share of a staged batch's bytes: on a
+        multi-host mesh each host packs and ships only its local chips'
+        shard (batch.py ``_stage_soa``), so crediting the GLOBAL batch
+        size on every host would multiply the H2D ledger by the process
+        count (the per-host attribution the sweep ledger's wire
+        subsection surfaces)."""
+        if self._stage_target is not None and jax.process_count() > 1:
+            return nbytes // jax.process_count()
+        return nbytes
+
+    def enable_wire(self, reseed_every: int = 64) -> None:
+        """Turn on columnar wire compression for this emitter's packed
+        staging (called by ``wire.attach_wire`` at graph build — only
+        for edges whose record spec is declared/inferred, the WF606
+        contract).  Mesh-sharded targets ignore the flag: their
+        transfers are assembled per shard, not packed."""
+        self._wire_on = self._stage_target is None
+        self._wire_reseed = max(1, reseed_every)
+
+    def _wire_encoder(self, dtypes, capacity: int):
+        key = (dtypes, capacity)
+        enc = self._wire_encoders.get(key)
+        if enc is None:
+            from windflow_tpu.wire import WireEncoder
+            enc = WireEncoder(dtypes, capacity,
+                              reseed_every=self._wire_reseed)
+            self._wire_encoders[key] = enc
+        return enc
 
     def emit(self, item, ts, wm, shared=False, tid=None):
         # `shared` is irrelevant here: staging materializes new device
@@ -590,14 +629,26 @@ class DeviceStageEmitter(Emitter):
         wm = self._b_wm if self._b_wm != WM_NONE else fallback_wm
         self._advance_frontier(wm)
         buf = b.finish()
+        logical_nbytes = buf.nbytes
+        fmt = None
+        if self._wire_on:
+            # wire plane (windflow_tpu/wire.py): lane-wise re-encode of
+            # the finished logical buffer; a batch compression cannot
+            # shrink ships the logical buffer unchanged (fmt None)
+            enc = self._wire_encoder(self._b_dtypes, b.capacity)
+            buf, fmt = enc.encode(buf, pool=b.pool)
         if self.stats is not None:
-            # the packed path's H2D transfer is exactly this buffer
+            # the packed path's H2D transfer is exactly this buffer;
+            # the logical counter keeps compression from silently
+            # inflating bytes-derived ratios (wire-round honesty fix)
             self.stats.h2d_bytes += buf.nbytes
+            self.stats.h2d_logical_bytes += logical_nbytes
         db = stage_packed(buf, self._b_treedef, self._b_dtypes,
                           b.capacity, b.n, watermark=wm, device=None,
                           frontier=self._frontier,
                           ts_max=self._b_ts_max, ts_min=self._b_ts_min,
-                          pool=b.pool, trace=self._new_trace(flightrec.STAGED))
+                          pool=b.pool, trace=self._new_trace(flightrec.STAGED),
+                          wire=fmt, logical_nbytes=logical_nbytes)
         d = self._next
         self._next = (self._next + 1) % len(self.dests)
         self._send(d, db)
@@ -642,7 +693,9 @@ class DeviceStageEmitter(Emitter):
                                frontier=self._frontier,
                                trace=self._new_trace(flightrec.STAGED))
         if self.stats is not None:
-            self.stats.h2d_bytes += _db_nbytes(db)
+            self.stats.h2d_bytes += self._local_share(_db_nbytes(db))
+            self.stats.h2d_logical_bytes += \
+                self._local_share(_db_nbytes(db))
         d = self._next
         self._next = (self._next + 1) % len(self.dests)
         self._send(d, db)
@@ -667,13 +720,49 @@ class DeviceStageEmitter(Emitter):
             return
         if self._shard_probe is not None:
             self._shard_probe.items(self._ob.items)
+        if self._wire_on:
+            # record-path wire route: stack the open batch to SoA and
+            # ship through the packed/wire pipeline.  Stamping is kept
+            # EXACTLY the record path's (the open batch's min-folded
+            # watermark, nothing newer), so wire on/off runs stay
+            # record-for-record identical.
+            from windflow_tpu.batch import _stack_records
+            leaves = treedef = None
+            try:
+                soa = _stack_records(self._ob.items)
+                leaves, treedef = jax.tree.flatten(soa)
+                ok = all(getattr(l, "ndim", 0) == 1
+                         and staging.packable_dtype(l.dtype)
+                         for l in leaves)
+            except Exception:  # lint: broad-except-ok (arbitrary user
+                # records may not stack to SoA columns — ANY failure
+                # means "take the uncompressed record path below")
+                ok = False
+            if ok:
+                ob, self._ob = self._ob, _OpenBatch()
+                tss = np.ascontiguousarray(ob.tss, np.int64)
+                # stamp THIS batch with the open batch's min-folded wm
+                # (exact record-path parity), then restore the running
+                # row-frontier max: on a mixed record+columnar emitter
+                # a later columnar batch must never stamp LOWER than
+                # the wire-off run would (the frontier only rises)
+                prev_wm = self._b_wm
+                self._b_wm = ob.wm
+                self._emit_columns_packed(leaves, treedef, tss,
+                                          WM_NONE, None)
+                self._b_wm = ob.wm
+                self._finalize_builder()
+                self._b_wm = max(prev_wm, ob.wm)
+                return
         hb = HostBatch(self._ob.items, self._ob.tss, self._ob.wm)
         db = host_to_device(hb, capacity=self.output_batch_size,
                             device=self._stage_target,
                             frontier=self._frontier,
                             trace=self._new_trace(flightrec.STAGED))
         if self.stats is not None:
-            self.stats.h2d_bytes += _db_nbytes(db)
+            self.stats.h2d_bytes += self._local_share(_db_nbytes(db))
+            self.stats.h2d_logical_bytes += \
+                self._local_share(_db_nbytes(db))
         d = self._next
         self._next = (self._next + 1) % len(self.dests)
         self._send(d, db)
@@ -945,6 +1034,263 @@ class KeyedDeviceStageEmitter(Emitter):
             e.propagate_punctuation(wm)
 
 
+class AlignedMeshStageEmitter(Emitter):
+    """Host→mesh staging with KEY-ALIGNED placement (ROADMAP item 4b):
+    each record is staged directly into the block of the ``(data,
+    key)``-sharded batch owned by the key shard that owns its key, so
+    the consumer's sharded program skips the data-axis ``all_gather``
+    the ICI model names dominant (~232 modeled B/tuple vs ~17 B
+    payload, docs/PERF.md r11) — the consuming FFAT step compiles its
+    ``ingest="aligned"`` variant (parallel/mesh.py) whose gather is the
+    identity on a 1-wide data axis and a kk-times-smaller within-column
+    gather otherwise.
+
+    Placement is the STRUCTURAL dense-range owner ``key // K_local`` —
+    exactly the ownership ``mesh._ffat_shard_layout``'s ``key_base_fn``
+    rebases by, so a tuple can never land on a shard that would drop
+    it.  Reshard-executor key moves deliberately do NOT apply here
+    (``set_override`` refuses loudly): the consumer's ownership is
+    compiled into the sharded program, so an emitter-side move would
+    stage a key onto a column whose shard masks it out-of-range and
+    silently drops it — a mesh graph's reshard mechanism is the
+    rescale-on-restore path (docs/DURABILITY.md), matching the PR-12
+    executor limits.  Batches
+    assemble per-column with per-block prefix validity computed on host
+    (alignment breaks the single-fill-count derivation), and a shipped
+    batch's watermark is capped at the minimum data timestamp of any
+    row still buffered — a skew-retained row must never become late
+    against its own channel's stamp.  Skewed streams reduce batch
+    occupancy (a hot column fills while cold columns idle); that cost
+    is visible in ``stats()`` occupancy and is the reshard advisor's
+    problem, not a correctness risk."""
+
+    def __init__(self, dests, output_batch_size, key_extractor, mesh,
+                 max_keys: int):
+        super().__init__(dests, output_batch_size)
+        from jax.sharding import NamedSharding, PartitionSpec as _P
+
+        from windflow_tpu.parallel.mesh import DATA_AXIS, KEY_AXIS
+        kk = mesh.shape[KEY_AXIS]
+        dd = mesh.shape[DATA_AXIS]
+        if output_batch_size % (kk * dd):
+            raise WindFlowError(
+                f"output batch size {output_batch_size} not divisible by "
+                f"the mesh's {kk * dd} devices (key-aligned ingest)")
+        if max_keys % kk:
+            raise WindFlowError(
+                f"max_keys {max_keys} not divisible by the key axis {kk}")
+        if jax.process_count() > 1:
+            raise WindFlowError(
+                "key-aligned ingest is single-process (multi-host meshes "
+                "stage fully-sharded local lanes)")
+        self.key_extractor = key_extractor
+        self._kk, self._dd = kk, dd
+        self._K_local = max_keys // kk
+        self._col_cap = output_batch_size // kk
+        self._blk = output_batch_size // (kk * dd)
+        self._sharding = NamedSharding(mesh, _P((DATA_AXIS, KEY_AXIS)))
+        # per-key-shard-column buffers: columnar chunks + record items
+        self._chunks = [[] for _ in range(kk)]   # [(cols dict, tss)]
+        self._items = [_OpenBatch() for _ in range(kk)]
+        self._rows = [0] * kk
+        self._wm = WM_NONE              # running max of received stamps
+        #: shard-plane key probe (monitoring/shard_ledger.HostKeyProbe):
+        #: keys are host-visible at this boundary, so the ledger probes
+        #: them here; None leaves one check per chunk / materialize
+        self._shard_probe = None
+        self.batches_shipped = 0
+        self.rows_shipped = 0
+
+    def set_override(self, override) -> None:
+        """Refused: the aligned consumer's key ownership is COMPILED
+        into its sharded program (``key // K_local``), so an
+        emitter-side move would stage the key onto a column whose shard
+        masks it out-of-range — a silent drop, never a move.  Mesh
+        reshard routes through rescale-on-restore (docs/DURABILITY.md);
+        raising here keeps that boundary loud if a future executor ever
+        discovers this emitter."""
+        if override:
+            raise WindFlowError(
+                "key-aligned mesh ingest cannot apply executor key "
+                "moves: ownership is compiled into the sharded step "
+                "(reshard a mesh graph via rescale-on-restore, "
+                "docs/DURABILITY.md)")
+
+    # -- placement -----------------------------------------------------------
+    def _owner_np(self, k32: np.ndarray) -> np.ndarray:
+        return np.clip(k32 // self._K_local, 0,
+                       self._kk - 1).astype(np.int64)
+
+    def _note_wm(self, wm) -> None:
+        if wm != WM_NONE and wm > self._wm:
+            self._wm = wm
+
+    # -- ingest --------------------------------------------------------------
+    def emit(self, item, ts, wm, shared=False, tid=None):
+        self._note_wm(wm)
+        k32 = int32_key(self.key_extractor(item))
+        c = min(max(k32 // self._K_local, 0), self._kk - 1)
+        self._items[c].add(item, ts, wm)
+        self._rows[c] += 1
+        if self._rows[c] >= self._col_cap:
+            self._ship_one()
+
+    def emit_columns(self, cols, tss, wm, row_wms=None):
+        self._note_wm(int(np.max(row_wms)) if row_wms is not None
+                      and len(row_wms) else wm)
+        if self._shard_probe is not None:
+            self._shard_probe.columns(cols, len(tss))
+        keys = None
+        try:
+            k = np.asarray(self.key_extractor(cols))
+            if k.shape == (len(tss),):
+                keys = k.astype(np.int64).astype(np.int32) \
+                    .astype(np.int64)
+        except Exception:   # lint: broad-except-ok (speculative
+            # vectorization probe of an arbitrary user extractor — ANY
+            # failure means "not elementwise", per-row fallback below)
+            pass
+        if keys is None:
+            keys = np.array(
+                [int32_key(self.key_extractor(
+                    {n: v[i].item() for n, v in cols.items()}))
+                 for i in range(len(tss))], np.int64)
+        own = self._owner_np(keys)
+        tss = np.ascontiguousarray(tss, np.int64)
+        arrs = {n: np.asarray(v) for n, v in cols.items()}
+        for c in range(self._kk):
+            idx = np.nonzero(own == c)[0]
+            if not len(idx):
+                continue
+            self._chunks[c].append(
+                ({n: v[idx] for n, v in arrs.items()}, tss[idx]))
+            self._rows[c] += len(idx)
+        while any(r >= self._col_cap for r in self._rows):
+            self._ship_one()
+
+    def emit_device_batch(self, batch):
+        raise WindFlowError(
+            "key-aligned staging emitter received a device batch; "
+            "TPU-fed mesh consumers keep the data-sharded ingest")
+
+    # -- assembly ------------------------------------------------------------
+    def _col_take(self, c: int):
+        """Materialize and take up to ``col_cap`` rows of column ``c``
+        (record items stack to SoA first); the remainder stays
+        buffered."""
+        from windflow_tpu.batch import _stack_records
+        ob = self._items[c]
+        if ob.items:
+            if self._shard_probe is not None:
+                self._shard_probe.items(ob.items)
+            soa = _stack_records(ob.items)
+            if not isinstance(soa, dict):
+                raise WindFlowError(
+                    "key-aligned ingest stages dict-shaped records "
+                    f"(got {type(ob.items[0]).__name__}); disable "
+                    "Config.key_aligned_ingest for this graph")
+            self._chunks[c].append(
+                ({n: np.asarray(v) for n, v in soa.items()},
+                 np.asarray(ob.tss, np.int64)))
+            self._items[c] = _OpenBatch()
+        if not self._chunks[c]:
+            return None
+        names = list(self._chunks[c][0][0])
+        cat = {n: _concat([ch[0][n] for ch in self._chunks[c]])
+               for n in names}
+        tcat = _concat([ch[1] for ch in self._chunks[c]])
+        m = len(tcat)
+        take = min(m, self._col_cap)
+        if take < m:
+            self._chunks[c] = [({n: a[take:] for n, a in cat.items()},
+                                tcat[take:])]
+            self._rows[c] = m - take
+        else:
+            self._chunks[c] = []
+            self._rows[c] = 0
+        return {n: a[:take] for n, a in cat.items()}, tcat[:take]
+
+    def _pending_min_ts(self):
+        lo = None
+        for c in range(self._kk):
+            for ch in self._chunks[c]:
+                if len(ch[1]):
+                    m = int(ch[1].min())
+                    lo = m if lo is None else min(lo, m)
+            if self._items[c].tss:
+                m = min(self._items[c].tss)
+                lo = m if lo is None else min(lo, m)
+        return lo
+
+    def _ship_one(self) -> None:
+        takes = [self._col_take(c) for c in range(self._kk)]
+        if not any(t is not None for t in takes):
+            return
+        cap, kk, dd, blk = (self.output_batch_size, self._kk, self._dd,
+                            self._blk)
+        first = next(t for t in takes if t is not None)
+        lanes = {n: np.zeros((cap,) + a.shape[1:], a.dtype)
+                 for n, a in first[0].items()}
+        ts = np.zeros(cap, np.int64)
+        valid = np.zeros(cap, bool)
+        total = 0
+        for c, t in enumerate(takes):
+            if t is None:
+                continue
+            colv, colt = t
+            m = len(colt)
+            total += m
+            # column rows split row-major over the dd data blocks: row r
+            # lands at block r//blk of column c — exactly the order the
+            # aligned step's data-axis gather reconstructs
+            for d in range(dd):
+                lo = d * blk
+                hi = min(m, lo + blk)
+                if hi <= lo:
+                    break
+                g0 = (d * kk + c) * blk
+                seg = slice(g0, g0 + (hi - lo))
+                for n, a in colv.items():
+                    lanes[n][seg] = a[lo:hi]
+                ts[seg] = colt[lo:hi]
+                valid[seg] = True
+        if total == 0:
+            return
+        # watermark capped at the minimum buffered data timestamp: a
+        # skew-retained row must never become late against this
+        # channel's own stamp (frontier capped identically — the
+        # place-then-fire shortcut must not outrun retained rows)
+        wm = self._wm
+        pend = self._pending_min_ts()
+        if wm != WM_NONE and pend is not None:
+            wm = min(wm, pend)
+        on = ts[valid]
+        ts_lo, ts_hi = int(on.min()), int(on.max())
+        payload = {n: jax.device_put(a, self._sharding)
+                   for n, a in lanes.items()}
+        db = DeviceBatch(payload, jax.device_put(ts, self._sharding),
+                         jax.device_put(valid, self._sharding),
+                         watermark=wm, size=total, frontier=wm,
+                         ts_max=ts_hi, ts_min=ts_lo,
+                         trace=self._new_trace(flightrec.STAGED))
+        if self.stats is not None:
+            nb = _db_nbytes(db)
+            self.stats.h2d_bytes += nb
+            self.stats.h2d_logical_bytes += nb
+        staging.device_bytes.note(_db_nbytes(db))
+        self.batches_shipped += 1
+        self.rows_shipped += total
+        self._send(0, db)
+
+    def flush(self, wm):
+        self._note_wm(wm)
+        while any(self._rows) or any(ob.items for ob in self._items):
+            before = (self.batches_shipped, self.rows_shipped)
+            self._ship_one()
+            if (self.batches_shipped, self.rows_shipped) == before:
+                break   # defensive: never spin on an empty remainder
+
+
 class DeviceKeyByEmitter(Emitter):
     """TPU→TPU KEYBY edge (reference GPU→GPU ``KeyBy_Emitter_GPU``,
     ``keyby_emitter_gpu.hpp:519-583``): one compiled program splits the batch
@@ -1144,6 +1490,18 @@ def create_emitter(routing: RoutingMode,
     """Pick the emitter for an edge from (routing, src-on-TPU, dst-on-TPU),
     mirroring the reference's dispatch (``multipipe.hpp:236-350``)."""
     if dst_is_tpu:
+        dst_op = dests[0][0].op if dests else None
+        if mesh is not None and not src_is_tpu \
+                and routing == RoutingMode.KEYBY \
+                and key_extractor is not None \
+                and getattr(dst_op, "_ingest_mode", None) == "aligned":
+            # key-aligned mesh ingest (ROADMAP item 4b): the graph build
+            # marked this key-sharded consumer aligned (host-fed only),
+            # so each record stages straight to its owning key shard and
+            # the sharded step skips the data-axis all_gather
+            return AlignedMeshStageEmitter(dests, output_batch_size,
+                                           key_extractor, mesh,
+                                           dst_op.max_keys)
         if routing == RoutingMode.KEYBY and len(dests) > 1 \
                 and key_extractor is not None:
             # Key-partitioned delivery: each key's tuples always reach the

@@ -115,6 +115,61 @@ def stage_batch(hb: HostBatch, capacity: int, mesh: Mesh) -> DeviceBatch:
         watermark=db.watermark, size=db.known_size)
 
 
+def mark_aligned_ingest(graph) -> None:
+    """Mark the mesh consumers eligible for KEY-ALIGNED ingest (ROADMAP
+    item 4b; ``Config.key_aligned_ingest`` / ``WF_TPU_KEY_ALIGNED=0``
+    kill switch): a key-sharded FfatWindowsTPU with a declared dense key
+    space, fed EXCLUSIVELY by host staging edges under KEYBY routing, is
+    stamped ``_ingest_mode="aligned"`` — the graph wiring then installs
+    :class:`~windflow_tpu.parallel.emitters.AlignedMeshStageEmitter` on
+    those edges and ``_build_step`` compiles the no-all_gather variant
+    (:func:`_ffat_shard_layout` ``"aligned"``).  Device-fed windows keep
+    the data-sharded ingest (a TPU→TPU edge has no host boundary to
+    align at), as do compacted key spaces (their admission runs at the
+    keyed staging boundary of a REPLICA-sharded consumer) and
+    multi-process graphs (each process stages only its local lanes).
+
+    Called by ``PipeGraph._build`` after replica construction, before
+    edge wiring — the emitter dispatch reads the stamp."""
+    cfg = graph.config
+    mesh = cfg.mesh
+    if mesh is None or jax.process_count() > 1:
+        return
+    from windflow_tpu.basic import RoutingMode
+    from windflow_tpu.windows.ffat_tpu import FfatWindowsTPU
+    kk = mesh.shape[KEY_AXIS]
+    dd = mesh.shape[DATA_AXIS]
+    ups = {}
+    for edge in graph._edges():
+        if edge[0] == "op":
+            _, a, b = edge
+            ups.setdefault(id(b), []).append(a)
+        else:
+            _, mp = edge
+            src = mp.operators[-1]
+            for child in mp.split_children:
+                if child.operators:
+                    ups.setdefault(id(child.operators[0]),
+                                   []).append(src)
+    for op in graph._topo_operators():
+        if not isinstance(op, FfatWindowsTPU):
+            continue
+        if op.max_keys is None or op.key_extractor is None \
+                or op.routing != RoutingMode.KEYBY \
+                or op.parallelism != 1 \
+                or getattr(op, "_compact_keys", False):
+            continue
+        if op.max_keys % kk:
+            continue        # WF402 territory: the mesh pass reports it
+        feeds = ups.get(id(op), [])
+        if not feeds or any(u.is_tpu for u in feeds):
+            continue        # device-fed: no host boundary to align at
+        if any((u.output_batch_size or 0) % (kk * dd)
+               for u in feeds):
+            continue        # indivisible staging capacity: keep default
+        op._ingest_mode = "aligned"
+
+
 # ---------------------------------------------------------------------------
 # Keyed reduce over the mesh (reference Reduce_GPU + cross-replica merge;
 # BASELINE.json: "keyby-sharded Reduce … linear scaling to 8 chips").
@@ -325,7 +380,9 @@ def make_sharded_keyed_reduce(mesh: Mesh, capacity: int, K: int,
 def _ffat_shard_layout(mesh: Mesh, capacity: int, K: int,
                        ingest: str = "data"):
     """Shared guards + layout for key-sharded FFAT variants: returns
-    ``(K_local, key_base_fn, gather, batch_spec)``.
+    ``(K_local, key_base_fn, gather, batch_spec, step_cap)`` where
+    ``step_cap`` is the lane count each key shard's local step actually
+    sees after ``gather``.
 
     ``ingest`` picks the staged-batch layout the step consumes:
 
@@ -338,10 +395,16 @@ def _ffat_shard_layout(mesh: Mesh, capacity: int, K: int,
       lanes IT ingested (batch.py ``_stage_soa``) — and ``gather``
       reconstructs the logical lane order with an all_gather over
       ``key`` then ``data`` (data-major block order = the logical
-      P((data, key)) order).  The key-axis hop crosses DCN; when ingest
-      can be key-aligned upstream (e.g. Kafka partition assignment per
-      host), prefer routing tuples to their key's owner and the
-      ``data`` layout instead."""
+      P((data, key)) order).  The key-axis hop crosses DCN.
+    * ``"aligned"`` (key-aligned ingest, ROADMAP item 4b): lanes fully
+      sharded over ``(data, key)`` with the HOST having already placed
+      every tuple in its key-owner's column
+      (parallel/emitters.AlignedMeshStageEmitter — the same
+      ``key // K_local`` ownership ``key_base_fn`` rebases by).  The
+      gather collapses to the within-column data-axis hop — identity on
+      a 1-wide data axis — killing the all_gather that dominates the
+      modeled ICI bytes/tuple (docs/PERF.md r11): each key shard
+      processes only its own ``capacity/kk`` lanes."""
     kk = mesh.shape[KEY_AXIS]
     dd = mesh.shape[DATA_AXIS]
     if K % kk:
@@ -349,17 +412,18 @@ def _ffat_shard_layout(mesh: Mesh, capacity: int, K: int,
     if capacity % dd:
         raise WindFlowError(
             f"capacity {capacity} not divisible by data axis {dd}")
-    if ingest not in ("data", "flat"):
+    if ingest not in ("data", "flat", "aligned"):
         raise WindFlowError(f"unknown ffat ingest layout '{ingest}'")
     K_local = K // kk
     key_base_fn = lambda: jax.lax.axis_index(KEY_AXIS) * K_local
 
-    if ingest == "flat":
+    if ingest in ("flat", "aligned"):
         if capacity % (dd * kk):
             raise WindFlowError(
                 f"capacity {capacity} not divisible by the mesh's "
                 f"{dd * kk} devices")
 
+    if ingest == "flat":
         def gather(payload, ts, valid):
             def ag(a):
                 a = jax.lax.all_gather(a, KEY_AXIS, axis=0, tiled=True)
@@ -369,7 +433,22 @@ def _ffat_shard_layout(mesh: Mesh, capacity: int, K: int,
                 return a
             return jax.tree.map(ag, payload), ag(ts), ag(valid)
 
-        return K_local, key_base_fn, gather, P((DATA_AXIS, KEY_AXIS))
+        return (K_local, key_base_fn, gather, P((DATA_AXIS, KEY_AXIS)),
+                capacity)
+
+    if ingest == "aligned":
+        def gather(payload, ts, valid):
+            if dd == 1:
+                return payload, ts, valid
+            # within-column hop only: each key shard re-assembles its
+            # OWN column's rows (d-major block order = the aligned
+            # emitter's row order); no key-axis traffic at all
+            ag = lambda a: jax.lax.all_gather(a, DATA_AXIS, axis=0,
+                                              tiled=True)
+            return jax.tree.map(ag, payload), ag(ts), ag(valid)
+
+        return (K_local, key_base_fn, gather, P((DATA_AXIS, KEY_AXIS)),
+                capacity // kk)
 
     def gather(payload, ts, valid):
         if dd == 1:
@@ -377,7 +456,7 @@ def _ffat_shard_layout(mesh: Mesh, capacity: int, K: int,
         ag = lambda a: jax.lax.all_gather(a, DATA_AXIS, axis=0, tiled=True)
         return jax.tree.map(ag, payload), ag(ts), ag(valid)
 
-    return K_local, key_base_fn, gather, P(DATA_AXIS)
+    return K_local, key_base_fn, gather, P(DATA_AXIS), capacity
 
 
 def make_sharded_ffat_step(mesh: Mesh, capacity: int, K: int, Pn: int, R: int,
@@ -395,9 +474,9 @@ def make_sharded_ffat_step(mesh: Mesh, capacity: int, K: int, Pn: int, R: int,
     ``all_gather``-ed across ``data`` inside the program so every key shard
     sees every tuple exactly once over ICI.  Fired-window outputs come back
     key-sharded, one row block per chip."""
-    K_local, key_base_fn, gather, bspec = _ffat_shard_layout(
+    K_local, key_base_fn, gather, bspec, step_cap = _ffat_shard_layout(
         mesh, capacity, K, ingest)
-    step_local = make_ffat_step(capacity, K_local, Pn, R, D, lift, comb,
+    step_local = make_ffat_step(step_cap, K_local, Pn, R, D, lift, comb,
                                 key_fn, key_base_fn=key_base_fn,
                                 sum_like=sum_like, grouping=grouping,
                                 monoid=monoid)
@@ -568,9 +647,9 @@ def make_sharded_ffat_tb_step(mesh: Mesh, capacity: int, K: int, P_usec: int,
     watermark pane frontier passed replicated (it is host metadata, identical
     on every chip).  Reference: ``Ffat_Windows_GPU`` TB replicas each owning
     a key subset with quantum panes, ``ffat_replica_gpu.hpp:92-216,438-514``."""
-    K_local, key_base_fn, gather, bspec = _ffat_shard_layout(
+    K_local, key_base_fn, gather, bspec, step_cap = _ffat_shard_layout(
         mesh, capacity, K, ingest)
-    step_local = make_ffat_tb_step(capacity, K_local, P_usec, R, D, NP,
+    step_local = make_ffat_tb_step(step_cap, K_local, P_usec, R, D, NP,
                                    lift, comb, key_fn,
                                    key_base_fn=key_base_fn,
                                    drop_tainted=drop_tainted,

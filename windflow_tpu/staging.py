@@ -78,6 +78,23 @@ def packable_dtype(dt) -> bool:
                                         np.dtype(np.uint64))
 
 
+def size_class(nwords: int) -> int:
+    """Pool size class of a data-dependent buffer size: round up to 1/8
+    granularity of the enclosing power of two (256-word floor).  Wire-
+    compressed staging buffers (windflow_tpu/wire.py) vary in size with
+    the data, so the pool MUST key on the class, not the exact size —
+    codec-choice churn across reseeds would otherwise mint a fresh slot
+    per batch and thrash the pool (hit/miss counters in
+    ``stats()["Staging_pool"]`` prove reuse either way).  Bounded waste:
+    the step is 1/8 of the enclosing power of two, so padding stays
+    under 25% of the transfer in the worst case (just past a power of
+    two) and under 12.5% on average."""
+    if nwords <= 256:
+        return 256
+    step = 1 << max(0, (nwords - 1).bit_length() - 3)
+    return ((nwords + step - 1) // step) * step
+
+
 class StagingPool:
     """Size-keyed recycling pool of host ``uint32`` staging buffers.
 
@@ -217,23 +234,31 @@ class _DeviceBytes:
     """Staging-attributed device-byte accounting (monitoring
     ``stats()["Device"]["staging"]``): cumulative packed bytes shipped
     host→device and the batch count behind them, noted by
-    ``batch.stage_packed`` at every fused transfer.  Plain int adds —
-    concurrent pool-thread updates may lose a tick, the same telemetry
-    tolerance as the graph's lock-free backpressure reads."""
+    ``batch.stage_packed`` at every fused transfer.  Since the wire
+    round the WIRE bytes (actual transfer) and the LOGICAL bytes (what
+    the decoded lanes occupy) are counted separately — equating them
+    let compression silently inflate every bytes-derived ratio.  Plain
+    int adds — concurrent pool-thread updates may lose a tick, the same
+    telemetry tolerance as the graph's lock-free backpressure reads."""
 
-    __slots__ = ("staged_bytes_total", "staged_batches_total")
+    __slots__ = ("staged_bytes_total", "staged_batches_total",
+                 "logical_bytes_total")
 
     def __init__(self) -> None:
-        self.staged_bytes_total = 0
+        self.staged_bytes_total = 0     # wire bytes: actual transfers
         self.staged_batches_total = 0
+        self.logical_bytes_total = 0    # decoded (pre-compression) bytes
 
-    def note(self, nbytes: int) -> None:
+    def note(self, nbytes: int, logical_nbytes: Optional[int] = None) -> None:
         self.staged_bytes_total += nbytes
+        self.logical_bytes_total += (logical_nbytes if logical_nbytes
+                                     is not None else nbytes)
         self.staged_batches_total += 1
 
     def reset(self) -> None:
         self.staged_bytes_total = 0
         self.staged_batches_total = 0
+        self.logical_bytes_total = 0
 
 
 #: process-wide staged-transfer accounting (shared like the default pool)

@@ -255,8 +255,15 @@ class FfatWindowsTPU(Operator):
             # multi-process graphs stage batches fully sharded over
             # (data, key) — the only layout each process can assemble from
             # the lanes IT ingested — so the step gathers over both axes
-            # (mesh.py _ffat_shard_layout "flat")
-            ingest = "flat" if jax.process_count() > 1 else "data"
+            # (mesh.py _ffat_shard_layout "flat").  "aligned" is set by
+            # the graph build (Config.key_aligned_ingest) when every
+            # feeding edge is a host staging edge routed through the
+            # key-aligned emitter: the host pre-places each tuple on its
+            # key-owner column, so the step skips the all_gather that
+            # dominates the modeled ICI bytes (parallel/emitters.
+            # AlignedMeshStageEmitter; docs/OBSERVABILITY.md wire plane).
+            ingest = getattr(self, "_ingest_mode", None) \
+                or ("flat" if jax.process_count() > 1 else "data")
             if self.is_tb:
                 return make_sharded_ffat_tb_step(
                     self.mesh, capacity, self.max_keys, self.P, self.R,
