@@ -1090,6 +1090,135 @@ def run_bench_scaling(jax, max_devices: Optional[int] = None) -> dict:
             "series": series}
 
 
+def run_bench_pallas(platform: str, cfg: dict, jax) -> dict:
+    """Pallas kernel section (windflow_tpu/kernels, docs/PERF.md round
+    14): the fused FFAT step built with the hand-written kernels
+    (segmented grouping + MXU pane combine) A/B'd against the pure-lax
+    build of the SAME program, plus the grouping kernel standalone and
+    a record-mismatch canary the CI hard-fails on.
+
+    ``interpret_mode`` is the honesty flag: on the CPU fallback the
+    kernels run under the Pallas interpreter — a tier-1 correctness
+    vehicle, expected SLOWER than lax (the section then runs reduced
+    shapes so CI stays fast) — real speedups are compiled-TPU numbers,
+    where the ≥1.3x grouping-region target applies."""
+    import dataclasses
+
+    import jax.numpy as jnp
+    import numpy as np
+
+    import windflow_tpu as wf
+    from windflow_tpu import kernels as pk
+    from windflow_tpu.windows.ffat_kernels import (make_ffat_state,
+                                                   make_ffat_step)
+    from windflow_tpu.windows.grouping import order_and_hist
+
+    mode = pk.resolve_pallas(
+        dataclasses.replace(wf.default_config, pallas_kernels="auto"))
+    sec = {
+        "kernels_active": 0,
+        "interpret_mode": None,
+        "ffat_step_speedup_vs_lax": 0.0,
+        "grouping_speedup": 0.0,
+        "record_mismatch": 0,
+    }
+    if mode is None:
+        sec["note"] = "no kernel lowering on this backend (lax path)"
+        return sec
+    sec["interpret_mode"] = bool(mode.interpret)
+    sec["kernels_active"] = 3   # grouping, pane combine, dense table
+    if mode.interpret:
+        CAP, K, steps = 8192, 256, 3
+    else:
+        CAP, K, steps = cfg["cap"], cfg["keys"], cfg["steps"]
+    Pn = math.gcd(cfg["win"], cfg["slide"])
+    R, D = cfg["win"] // Pn, cfg["slide"] // Pn
+
+    rng = np.random.default_rng(0)
+    dev = jax.devices()[0]
+    # integer-valued f32 so the MXU banded-matmul sum is EXACT and the
+    # record canary can demand bitwise equality
+    payload = {
+        "k": jax.device_put(
+            jnp.asarray(rng.integers(0, K, CAP), jnp.int32), dev),
+        "v": jax.device_put(
+            jnp.asarray(rng.integers(0, 97, CAP).astype(np.float32)),
+            dev),
+    }
+    ts = jax.device_put(jnp.arange(CAP, dtype=jnp.int64), dev)
+    valid = jax.device_put(jnp.ones(CAP, bool), dev)
+
+    lift = lambda x: x["v"]          # noqa: E731
+    comb = lambda a, b: a + b        # noqa: E731
+    key_fn = lambda x: x["k"]        # noqa: E731
+
+    def timed(pallas):
+        step = jax.jit(make_ffat_step(CAP, K, Pn, R, D, lift, comb,
+                                      key_fn, monoid="sum",
+                                      pallas=pallas))
+        st = jax.device_put(
+            make_ffat_state(jnp.zeros((), jnp.float32), K, R), dev)
+        st, out, fired, ots = step(st, payload, ts, valid)
+        jax.block_until_ready(st)
+        first = (st, out, fired, ots)
+        rates = []
+        for _ in range(5):
+            t0 = time.perf_counter()
+            s = st
+            for _ in range(steps):
+                s, out, fired, _ = step(s, payload, ts, valid)
+            jax.block_until_ready(s)
+            rates.append(steps * CAP / (time.perf_counter() - t0))
+        rates.sort()
+        return rates[len(rates) // 2], first
+
+    tps_lax, ref = timed(None)
+    tps_pal, got = timed(mode)
+    sec["ffat_step_speedup_vs_lax"] = round(tps_pal / tps_lax, 4)
+    sec["ffat_step_tps_pallas"] = round(tps_pal, 1)
+    sec["ffat_step_tps_lax"] = round(tps_lax, 1)
+
+    # record-mismatch canary: the kernel build's FIRST step (state +
+    # fired windows) must be bit-identical to the lax build's
+    mismatch = 0
+    for a, b in zip(jax.tree_util.tree_leaves(ref),
+                    jax.tree_util.tree_leaves(got)):
+        if not np.array_equal(np.asarray(a), np.asarray(b)):
+            mismatch = 1
+            break
+    # ...and the dense segmented-reduce kernel against the scatter —
+    # int32 lanes, inside the COMPILED dtype gate (table_leaf_ok), so
+    # this canary runs the same path on a real TPU as on CPU tier-1
+    row = jnp.asarray(rng.integers(0, K, CAP), jnp.int32)
+    v32 = jnp.asarray(rng.integers(0, 1000, CAP), jnp.int32)
+    tab_pk = pk.dense_monoid_table(row, [v32], ["sum"], [0], K,
+                                   mode.interpret)[0]
+    tab_lax = jnp.zeros(K + 1, jnp.int32).at[row].add(v32)[:K]
+    if not np.array_equal(np.asarray(tab_pk), np.asarray(tab_lax)):
+        mismatch = 1
+    sec["record_mismatch"] = mismatch
+
+    # grouping kernel standalone (the profile's dominant region)
+    ids = payload["k"]
+    jl = jax.jit(lambda i: order_and_hist(i, K + 1))
+    jp = jax.jit(lambda i: pk.order_hist(i, K + 1, mode.interpret))
+    for fn in (jl, jp):
+        jax.block_until_ready(fn(ids))
+    ticks = {}
+    for name, fn in (("lax", jl), ("pallas", jp)):
+        rates = []
+        for _ in range(5):
+            t0 = time.perf_counter()
+            for _ in range(max(3, steps)):
+                out = fn(ids)
+            jax.block_until_ready(out)
+            rates.append((time.perf_counter() - t0) / max(3, steps))
+        rates.sort()
+        ticks[name] = rates[len(rates) // 2]
+    sec["grouping_speedup"] = round(ticks["lax"] / ticks["pallas"], 4)
+    return sec
+
+
 def load_history() -> dict:
     try:
         with open(HISTORY_PATH) as f:
@@ -1156,6 +1285,14 @@ def main() -> None:
         # startup, so force CPU through the config API before backend init.
         import jax
         jax.config.update("jax_platforms", "cpu")
+        # Pallas kernels resolve to interpret=True on CPU (the tier-1
+        # correctness vehicle — docs/PERF.md round 14); the legacy
+        # sections pin the lax build so their recorded history stays
+        # methodology-comparable, and the `pallas` section below
+        # measures the kernels explicitly.  On a real TPU the auto
+        # default keeps the compiled kernels on everywhere.
+        import windflow_tpu as _wf
+        _wf.default_config.pallas_kernels = "0"
     else:
         import jax
 
@@ -1195,6 +1332,12 @@ def main() -> None:
             platform, CONFIGS[platform], jax)
     except Exception as e:
         result["compaction_error"] = f"{type(e).__name__}: {e}"[:300]
+
+    try:
+        result["pallas"] = run_bench_pallas(platform, CONFIGS[platform],
+                                            jax)
+    except Exception as e:
+        result["pallas_error"] = f"{type(e).__name__}: {e}"[:300]
 
     try:
         e2e = run_bench_e2e(platform, CONFIGS[platform], jax,

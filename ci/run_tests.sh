@@ -21,6 +21,7 @@ python tools/wf_lint.py
 python tools/wf_verify.py --strict \
     tools.verify_targets:bench_e2e \
     tools.verify_targets:wire_ingest \
+    tools.verify_targets:pallas_window \
     tools.verify_targets:chaos_window_cb \
     tools.verify_targets:chaos_window_tb \
     tools.verify_targets:chaos_reduce \
@@ -41,7 +42,10 @@ python tools/wf_verify.py --strict \
 # the key-compaction contracts (record-for-record compacted vs sorted
 # vs declared-dense A/B, overflow-to-sorted under adversarial streams,
 # zero-extra-dispatch pin, churn/hit-rate surfacing, remap chaos
-# restore), and the durability contracts (one chaos kill->restore->record-diff cell
+# restore), the pallas-kernel contracts (kernel-vs-lax record A/B
+# across window/reduce families incl. regrow + EOS edges, bit-equality
+# of the kernel bodies, zero-dispatch-delta pin, WF607, aligned-ingest
+# extension, kill-switch off-path), and the durability contracts (one chaos kill->restore->record-diff cell
 # per mechanism, checkpoint store layout/GC, WF602 restore validation,
 # sink EOS fence, off-path budget — the full family x kill point x
 # fusion soak matrix is slow-marked for the nightly leg) fail
@@ -57,7 +61,7 @@ python -m pytest tests/test_staging.py tests/test_observability.py \
     tests/test_fusion.py tests/test_durability.py \
     tests/test_shard_plane.py tests/test_tracecheck.py \
     tests/test_key_compaction.py tests/test_reshard.py \
-    tests/test_wire.py -q -m 'not slow'
+    tests/test_wire.py tests/test_pallas_kernels.py -q -m 'not slow'
 python -m pytest tests/ -q -m 'not slow'
 python __graft_entry__.py 8
 BENCH_PLATFORM=cpu BENCH_E2E_TUPLES=131072 python bench.py | tee bench_ci_out.txt

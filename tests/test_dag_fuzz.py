@@ -104,11 +104,15 @@ def _run_dag(seed, config_rnd):
     # sweeps of the same topology must be record-for-record identical —
     # and so is key compaction (windflow_tpu/parallel/compaction.py):
     # compacted and legacy paths of the same keyed consumers must be too
+    # — and so are the Pallas kernels (windflow_tpu/kernels): the
+    # kernel-backed and lax builds of the same programs must be too
     cfg = wf.Config(host_worker_threads=config_rnd.choice([0, 0, 2, 4]),
                     whole_chain_fusion=config_rnd.choice([True, True,
                                                           False]),
                     key_compaction=config_rnd.choice([True, True,
-                                                      False]))
+                                                      False]),
+                    pallas_kernels=config_rnd.choice(["auto", "auto",
+                                                      "0"]))
     g = wf.PipeGraph("fuzz", mode, wf.TimePolicy.EVENT, config=cfg)
     src_batch = config_rnd.randint(1, 64)
     mp = g.add_source(

@@ -175,7 +175,13 @@ def test_keyed_reduce_tpu_on_mesh_pmax():
 def test_keyed_reduce_tpu_on_mesh_psum():
     """psum cross-chip combine: every payload lane must be zero-absorbing
     sum-like, so the key rides only the extractor (derived from the raw
-    value lane, pre-combine); output rows arrive in dense key order."""
+    value lane, pre-combine); output rows arrive in dense key order.
+
+    Pins the DATA-SHARDED ingest explicitly: a declared dense mesh
+    reduce defaults to key-aligned ingest since the pallas round
+    (mesh.mark_aligned_ingest), whose column-fill batching changes the
+    per-batch record cadence this test counts — the aligned twin lives
+    in tests/test_pallas_kernels.py."""
     got = []
     src = (wf.Source_Builder(lambda: iter({"value": i}
                                           for i in range(LENGTH)))
@@ -186,7 +192,9 @@ def test_keyed_reduce_tpu_on_mesh_psum():
     snk = wf.Sink_Builder(
         lambda r: got.append(int(r["value"])) if r is not None else None) \
         .build()
-    g = wf.PipeGraph("red_mesh_psum", config=_mesh_cfg())
+    g = wf.PipeGraph("red_mesh_psum",
+                     config=dataclasses.replace(
+                         _mesh_cfg(), key_aligned_ingest=False))
     g.add_source(src).add(op).add_sink(snk)
     g.run()
 

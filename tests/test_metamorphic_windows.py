@@ -78,8 +78,12 @@ def _run(family, wt, mode, rnd):
     snk = (wf.Sink_Builder(on_result)
            .withParallelism(rnd.randint(1, 3)).build())
     # whole-chain fusion is a CONFIG dimension (windflow_tpu/fusion):
-    # fused and unfused sweeps must reproduce the oracle exactly
-    cfg = wf.Config(whole_chain_fusion=rnd.random() < 0.7)
+    # fused and unfused sweeps must reproduce the oracle exactly — and
+    # so are the Pallas kernels (windflow_tpu/kernels): kernel-backed
+    # and lax builds of the same window programs must too
+    cfg = wf.Config(whole_chain_fusion=rnd.random() < 0.7,
+                    pallas_kernels="auto" if rnd.random() < 0.7
+                    else "0")
     g = wf.PipeGraph(f"meta_{family}_{wt}", mode, wf.TimePolicy.EVENT,
                      config=cfg)
     g.add_source(src).add(op).add_sink(snk)

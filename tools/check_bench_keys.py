@@ -88,6 +88,16 @@ object-level kernel verifier over the representative pipeline.
 any nonzero unsuppressed count is a verifier false positive or a real
 kernel regression — both block.  Guarded here identically.
 
+Since the pallas round the bench also publishes a ``pallas`` section
+(``kernels_active``, ``ffat_step_speedup_vs_lax``, ``grouping_speedup``,
+``interpret_mode``, ``record_mismatch`` — docs/PERF.md round 14) from a
+seeded kernel-vs-lax A/B of the fused FFAT step.  ``record_mismatch``
+hard-fails: the kernel-backed step must be bit-identical to the lax
+build on the integer-valued seed stream.  ``interpret_mode`` is the
+honesty flag — CPU runs emulate the kernels (slower by design), so the
+speedup keys are only comparable across runs with the same flag
+(``check_bench_regress.py`` gates on it).  Guarded here identically.
+
 Since the fusion round the bench also publishes a ``fusion`` section
 (``fused_chains``, ``dispatches_saved``, ``bytes_saved_per_batch`` —
 docs/PERF.md round 10) from the staged e2e run's sweep ledger: the
@@ -118,6 +128,8 @@ COMPACTION_KEYS = ("speedup_vs_sorted", "hit_rate", "overflow_share",
                    "churn_per_sweep")
 RESHARD_KEYS = ("plan_apply_ms", "rescale_restore_ms", "keys_moved",
                 "post_reshard_imbalance")
+PALLAS_KEYS = ("kernels_active", "ffat_step_speedup_vs_lax",
+               "grouping_speedup", "interpret_mode", "record_mismatch")
 
 
 def fail(msg: str) -> None:
@@ -157,7 +169,9 @@ def check_source() -> None:
             ("reshard", RESHARD_KEYS,
              "reshard executor + rescale restore — "
              "docs/OBSERVABILITY.md reshard-executor / "
-             "docs/DURABILITY.md rescale-on-restore")):
+             "docs/DURABILITY.md rescale-on-restore"),
+            ("pallas", PALLAS_KEYS,
+             "Pallas kernels — docs/PERF.md round 14")):
         missing = [k for k in keys if f'"{k}"' not in src] \
             + ([] if f'"{section}"' in src else [section])
         if missing:
@@ -166,7 +180,7 @@ def check_source() -> None:
     print("check_bench_keys: OK (bench.py source emits "
           + ", ".join(KEYS + ("latency", "preflight", "verify", "device",
                               "health", "shard", "compaction", "fusion",
-                              "durability", "reshard")) + ")")
+                              "durability", "reshard", "pallas")) + ")")
 
 
 def last_json_object(path: str):
@@ -392,6 +406,27 @@ def check_output(path: str) -> None:
         # environmental failure mode — its absence IS the regression
         fail("bench reshard section absent or errored "
              f"(reshard_error={result.get('reshard_error')!r})")
+    pal = result.get("pallas")
+    if isinstance(pal, dict):
+        missing = [k for k in PALLAS_KEYS if k not in pal]
+        if missing:
+            fail(f"'pallas' section missing {missing} from bench output")
+        if pal.get("record_mismatch"):
+            # the canary: the kernel-backed step's first batch must be
+            # BIT-IDENTICAL to the lax build's on the integer-valued
+            # seed stream — any mismatch is a kernel correctness
+            # regression, not a perf question (docs/PERF.md round 14)
+            fail("pallas record-mismatch canary tripped: the "
+                 "kernel-backed FFAT step diverged from the lax path")
+        if pal.get("kernels_active") and pal.get("interpret_mode") is None:
+            fail("pallas section reports active kernels without an "
+                 "interpret_mode flag — the speedup numbers are "
+                 "uninterpretable without it")
+    else:
+        # the pallas leg is an in-process kernel A/B with no
+        # environmental failure mode — its absence IS the regression
+        fail("bench pallas section absent or errored "
+             f"(pallas_error={result.get('pallas_error')!r})")
     ver = result.get("verify")
     if isinstance(ver, dict):
         missing = [k for k in VERIFY_KEYS if k not in ver]

@@ -102,6 +102,14 @@ GUARDED = (
     # exceeds the threshold — their sanity bounds live in
     # check_bench_keys.
     ("reshard.keys_moved", True, None),
+    # pallas kernels: the fused-step kernel-vs-lax ratio is the round's
+    # headline (docs/PERF.md round 14).  Comparable only between runs
+    # with the SAME interpret_mode (a compiled-TPU speedup and a
+    # CPU-interpreter emulation measure different things — the
+    # comparable() gate below); correctness has its own hard guard
+    # (record_mismatch, check_bench_keys).
+    ("pallas.ffat_step_speedup_vs_lax", True, None),
+    ("pallas.grouping_speedup", True, None),
 )
 
 
@@ -139,6 +147,11 @@ def comparable(cur: dict, prev: dict, path: str) -> bool:
         # the reshard leg's move count is seeded per tuple count
         # (BENCH_RESHARD_TUPLES): a different stream plans differently
         return dig(cur, "reshard.tuples") == dig(prev, "reshard.tuples")
+    if path.startswith("pallas."):
+        # interpret-mode (CPU emulated) and compiled-TPU kernel numbers
+        # are different experiments; only like compares with like
+        return dig(cur, "pallas.interpret_mode") == \
+            dig(prev, "pallas.interpret_mode")
     if path.startswith("compaction."):
         # the compaction A/B is seeded per batch width (cfg["cap"]):
         # a different stream shape shifts the hot-set/overflow split

@@ -21,6 +21,26 @@ pre-staged device batches (the bench.py methodology).  Components overlap
 inside the fused step (XLA may fuse/elide across them), so shares are
 indicative, not additive — the point is the ORDER and the dominant term.
 
+**In-fused-step ablation** (``ablation_ms``; the PROFILE_r05 honesty
+fix): standalone component times over-count what a region costs INSIDE
+the fused step, where XLA overlaps and fuses across regions (the r05
+components each "cost" ~100-120% of the whole step).  The ablation mode
+instead swaps ONE region for an identity stub (same shapes, no work) via
+the module seams the step builder calls through, re-times the WHOLE
+step, and attributes ``full_ms - ablated_ms`` to the region — a real
+fused-step delta, the number a Pallas win must be judged against.
+Regions: ``grouping`` (order+hist), ``pane_scan`` (segmented scan),
+``sliding_fold`` (window fold).
+
+**Pallas comparison** (``pallas_compare``; docs/PERF.md round 14): the
+full fused step timed with the Pallas kernels selected
+(windflow_tpu/kernels, Config.pallas_kernels resolution) against the
+pure-lax build of the SAME step, for both the generic-combiner path and
+the declared-monoid path — the bench ``pallas`` section's
+methodology, at profile shapes.  On CPU the kernels run under the
+Pallas interpreter (``interpret_mode: true``): a correctness vehicle,
+expected SLOWER than lax — real speedups are TPU numbers.
+
 Usage:  python tools/profile_ffat.py [--cpu] [--json out.json]
 """
 
@@ -138,6 +158,69 @@ def build_components(jax, jnp, CAP, K, Pn, R):
         "sliding_fold_cumsum": sliding_fold_cumsum,
         "firing_compact": firing_compact,
     }, NP1
+
+
+def _identity_stubs(region: str):
+    """(module attr name -> stub) map swapping ONE step region for an
+    identity of the same output shapes — covers both the lax bodies
+    (windows/ffat_kernels) and the Pallas twins (windflow_tpu/kernels)
+    so the ablation composes with either build."""
+    import jax.numpy as jnp
+
+    from windflow_tpu import kernels as pk
+    from windflow_tpu.windows import ffat_kernels as fk
+    if region == "grouping":
+        def order_hist_stub(ids, nb, grouping=None, pallas=None):
+            n = ids.shape[0]
+            return (jnp.arange(n, dtype=jnp.int32),
+                    jnp.zeros(nb, jnp.int32).at[ids].add(1))
+
+        def rank_hist_stub(ids, nb, interpret):
+            n = ids.shape[0]
+            z = jnp.zeros(n, jnp.int32)
+            return z, z, jnp.zeros(nb, jnp.int32).at[ids].add(1)
+
+        def dense_rank_stub(ids, nb):
+            n = ids.shape[0]
+            z = jnp.zeros(n, jnp.int32)
+            return (z, jnp.zeros(nb, jnp.int32).at[ids].add(1)[:nb],
+                    ids, jnp.arange(n, dtype=jnp.int32))
+
+        return {(fk, "_group_order_hist"): order_hist_stub,
+                (fk, "_group_order"):
+                    lambda ids, nb, g, pallas=None:
+                        jnp.arange(ids.shape[0], dtype=jnp.int32),
+                (fk, "dense_rank"): dense_rank_stub,
+                (pk, "grouping_rank_hist"): rank_hist_stub,
+                (pk, "order_hist"):
+                    lambda ids, nb, interpret:
+                        order_hist_stub(ids, nb)}
+    if region == "pane_scan":
+        return {(fk, "_seg_scan"): lambda comb, flags, values: values}
+    if region == "sliding_fold":
+        return {(fk, "_sliding_reduce"):
+                    lambda comb, flags, values, R, axis: (flags, values),
+                (fk, "_sliding_reduce_plain"):
+                    lambda comb, flags, values, R, axis, monoid: values,
+                (pk, "sliding_fold"):
+                    lambda values, valid, R, monoid, interpret: values}
+    raise ValueError(region)
+
+
+def _time_step(jax, step, state, payload, ts, valid, steps):
+    st, out, fired, _ = step(state, payload, ts, valid)
+    jax.block_until_ready(st)
+    import time as _time
+    rates = []
+    for _ in range(5):
+        t0 = _time.perf_counter()
+        s = st
+        for _ in range(steps):
+            s, out, fired, _ = step(s, payload, ts, valid)
+        jax.block_until_ready(s)
+        rates.append((_time.perf_counter() - t0) / steps)
+    rates.sort()
+    return rates[len(rates) // 2]
 
 
 def main():
@@ -264,6 +347,72 @@ def main():
             "ms": round(t * 1e3, 4),
             "pct_of_full": round(100 * t / full_s, 1),
         }
+
+    # -- in-fused-step ablation (the r05 "shares are indicative" honesty
+    # fix): swap ONE region for an identity stub, re-time the WHOLE
+    # step; full - ablated is the region's REAL fused-step share --------
+    def build_and_time(monoid=None, pallas=None, stubs=None, steps=None):
+        saved = {}
+        if stubs:
+            for key, fn in stubs.items():
+                saved[key] = getattr(key[0], key[1])
+                setattr(key[0], key[1], fn)
+        try:
+            # stubs must stay live through the first dispatch: the jit
+            # traces the module seams lazily, so timing happens inside
+            # the patch window
+            step = jax.jit(make_ffat_step(
+                CAP, K, Pn, R, D, lambda x: x["v"], lambda a, b: a + b,
+                lambda x: x["k"], monoid=monoid, pallas=pallas))
+            state = jax.device_put(
+                make_ffat_state(jnp.zeros((), jnp.float32), K, R), dev)
+            return _time_step(jax, step, state, payload, ts, valid,
+                              steps or args.steps)
+        finally:
+            for key, fn in saved.items():
+                setattr(key[0], key[1], fn)
+
+    result["ablation_ms"] = {}
+    for region in ("grouping", "pane_scan", "sliding_fold"):
+        t = build_and_time(stubs=_identity_stubs(region))
+        result["ablation_ms"][region] = {
+            "ablated_step_ms": round(t * 1e3, 4),
+            "attributed_ms": round((full_s - t) * 1e3, 4),
+            "attributed_pct_of_full": round(100 * (full_s - t) / full_s,
+                                            1),
+        }
+    result["ablation_note"] = (
+        "attributed_ms = full_step_ms - step_ms with the region swapped "
+        "for an identity stub INSIDE the fused step — the real "
+        "fused-step share a kernel win is judged against (standalone "
+        "components_ms over-count by the XLA overlap)")
+
+    # -- Pallas comparison block (docs/PERF.md round 14) ----------------
+    from windflow_tpu.basic import Config as _Config
+    from windflow_tpu.kernels import resolve_pallas
+    pmode = resolve_pallas(_Config())
+    pcomp = {
+        "backend": platform,
+        "kernels_selected": pmode is not None,
+        "interpret_mode": (bool(pmode.interpret) if pmode is not None
+                           else None),
+        "note": ("interpret_mode=true means the kernels run under the "
+                 "Pallas interpreter (CPU tier-1 correctness vehicle) — "
+                 "expected SLOWER than lax; real speedups are compiled "
+                 "TPU numbers"),
+    }
+    if pmode is not None:
+        psteps = min(args.steps, 5) if pmode.interpret else args.steps
+        for label, monoid in (("generic", None), ("monoid_sum", "sum")):
+            t_lax = build_and_time(monoid=monoid, steps=psteps)
+            t_pal = build_and_time(monoid=monoid, pallas=pmode,
+                                   steps=psteps)
+            pcomp[label] = {
+                "lax_step_ms": round(t_lax * 1e3, 4),
+                "pallas_step_ms": round(t_pal * 1e3, 4),
+                "ffat_step_speedup_vs_lax": round(t_lax / t_pal, 4),
+            }
+    result["pallas_compare"] = pcomp
     line = json.dumps(result, indent=2)
     print(line)
     if args.json:

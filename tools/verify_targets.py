@@ -80,6 +80,39 @@ def jnp_max(a, b):
     return jnp.maximum(a, b)
 
 
+def pallas_window():
+    """Pallas-kernel-enabled window shape (windflow_tpu/kernels): a
+    declared-monoid CB window + a declared-dense reduce with the
+    kernels FORCED on — the grouping, pane-combine, and segmented-
+    reduce kernel bodies all trace into the verified programs, so
+    wfverify pins the kernel-bearing builds trace-safe/deterministic
+    exactly like the lax ones."""
+    import numpy as np
+
+    import windflow_tpu as wf
+    src = (wf.Source_Builder(lambda: iter(()))
+           .withOutputBatchSize(4096)
+           .withRecordSpec({"key": np.int32(0),
+                            "v0": np.float32(0.0)}).build())
+    w = (wf.Ffat_WindowsTPU_Builder(lambda t: t["v0"],
+                                    lambda a, b: a + b)
+         .withCBWindows(64, 16)
+         .withKeyBy(lambda t: t["key"]).withMaxKeys(64)
+         .withSumCombiner().build())
+    red = (wf.ReduceTPU_Builder(
+            lambda a, b: {"key": jnp_max(a["key"], b["key"]),
+                          "v0": jnp_max(a["v0"], b["v0"])})
+           .withKeyBy(lambda t: t["key"]).withMaxKeys(64)
+           .withMonoidCombiner("max").build())
+    g = wf.PipeGraph("verify_pallas_window",
+                     config=wf.Config(pallas_kernels="1"))
+    pipe = g.add_source(src)
+    pipe.add(w)
+    pipe.add(red)
+    pipe.add_sink(wf.Sink_Builder(lambda r: None).build())
+    return g
+
+
 def _chaos(family: str):
     from windflow_tpu.durability.chaos import make_cell
     ckpt = tempfile.mkdtemp(prefix=f"wfverify_{family}_ck_")

@@ -99,6 +99,20 @@ CODES = {
     "WF606": ("warning", "wire compression downgraded to raw "
                          "passthrough: the staging edge has no "
                          "declared/inferred record spec"),
+    # Pallas kernels (windflow_tpu/kernels, docs/PERF.md round 14):
+    # ``WF_TPU_PALLAS=1`` forces the hand-written FFAT kernels on, but
+    # three downgrades are built in — a backend with no lowering
+    # (neither TPU Mosaic nor the CPU interpreter) keeps the lax path,
+    # a MESH graph keeps it too (the shard_map step factories compose
+    # lax bodies this round), and a window whose combiner is a GENERIC
+    # traced function (no declared sum/max/min monoid) keeps the lax
+    # sliding fold (only declared monoids ride the MXU pane combine).
+    # Forcing makes those downgrades NAMED instead of silent, mirroring
+    # WF606's raw-passthrough contract; "auto" picks silently.
+    "WF607": ("warning", "Pallas kernels forced on but downgraded to "
+                         "the lax path (unsupported backend, mesh "
+                         "graph, or a generic combiner on the MXU "
+                         "pane-combine path)"),
     # -- determinism for replay (WF61x, wfverify — analysis/tracecheck.py):
     #    kernels and callbacks of a durability-enabled graph must
     #    regenerate the committed prefix identically on replay

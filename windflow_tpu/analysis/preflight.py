@@ -193,8 +193,64 @@ def check_graph(graph) -> List[Diagnostic]:
     _durability_pass(graph, ops, diags)
     _kernel_pass(graph, ops, edges, upstreams, diags)
     _wire_pass(graph, ops, edges, upstreams, diags)
+    _pallas_pass(graph, ops, diags)
     _tracecheck_pass(graph, diags)
     return diags
+
+
+def _pallas_pass(graph, ops, diags) -> None:
+    """WF607: forced Pallas kernels (``WF_TPU_PALLAS=1``) name their
+    downgrades instead of taking them silently — the WF606 contract
+    applied to the kernel plane.  Two cases:
+
+    * the runtime backend has no kernel lowering (neither TPU Mosaic
+      nor the CPU interpreter): the whole plane downgrades to lax;
+    * a MESH graph: the sharded program factories (parallel/mesh.py)
+      compose their steps inside shard_map, which keeps the lax bodies
+      this round — forcing the kernels there does nothing;
+    * an FFAT window with a GENERIC traced combiner (no declared
+      sum/max/min monoid): the MXU pane-combine path only exists for
+      declared monoids, so the sliding fold keeps the lax body (the
+      grouping kernel still applies).
+
+    ``auto`` mode picks per backend silently and never warns."""
+    from windflow_tpu.kernels import pallas_forced, resolve_pallas
+    if not pallas_forced(graph.config):
+        return
+    if graph.config.mesh is not None:
+        diags.append(Diagnostic(
+            "WF607",
+            "WF_TPU_PALLAS=1 forced on a mesh graph: sharded programs "
+            "(shard_map step factories) keep the lax bodies this "
+            "round, so no kernels build",
+            hint="single-chip graphs take the kernels; kernels inside "
+                 "shard_map are a future round (docs/PERF.md round "
+                 "14)"))
+        return
+    mode = resolve_pallas(graph.config)
+    if mode is None:
+        import jax as _jax
+        diags.append(Diagnostic(
+            "WF607",
+            "WF_TPU_PALLAS=1 forced but backend "
+            f"'{_jax.default_backend()}' has no kernel lowering "
+            "(TPU compiles Mosaic, CPU runs interpret=True): the lax "
+            "path runs instead",
+            hint="unset WF_TPU_PALLAS (auto picks per backend) or run "
+                 "on a TPU/CPU backend"))
+        return
+    from windflow_tpu.windows.ffat_tpu import FfatWindowsTPU
+    for op in ops:
+        if isinstance(op, FfatWindowsTPU) and op.monoid is None:
+            diags.append(Diagnostic(
+                "WF607",
+                f"window '{op.name}' has a generic traced combiner: "
+                "the MXU pane-combine kernel only exists for declared "
+                "sum/max/min monoids, so its sliding fold keeps the "
+                "lax body (the grouping kernel still applies)",
+                node=op.name,
+                hint="declare the combiner with withMonoidCombiner/"
+                     "withSumCombiner if it is a leafwise monoid"))
 
 
 def _wire_pass(graph, ops, edges, upstreams, diags) -> None:

@@ -307,6 +307,23 @@ class Config:
     # flag check.  Typed loosely: True/False/"auto"/"1"/"0" all work
     # (wire.wire_enabled resolves it).
     wire_compression: object = os.environ.get("WF_TPU_WIRE", "auto")
+    # Pallas TPU kernels for the FFAT hot loop (windflow_tpu/kernels,
+    # docs/PERF.md round 14): hand-written kernels for segmented
+    # grouping, the pane-level sliding fold, and the dense segmented
+    # reduce drop into the hottest regions of the SAME wf_jit programs
+    # the lax compositions occupied — zero dispatch-count change,
+    # record-for-record identical output.  Default "auto": compiled
+    # Mosaic kernels on TPU backends, interpret=True on the CPU
+    # fallback so tier-1 executes the real kernel bodies (the
+    # interpreter emulation is a correctness vehicle, not a perf path —
+    # bench's legacy sections pin =0 on CPU to keep their history
+    # comparable).  =1 forces (downgrades get a WF607 preflight
+    # warning: non-TPU/CPU backends have no lowering, and windows with
+    # GENERIC traced combiners keep the lax fold — only declared
+    # sum/max/min monoids ride the MXU pane combine); =0 is the kill
+    # switch restoring the lax path verbatim (no kernel builds, one
+    # resolve per program build).
+    pallas_kernels: object = os.environ.get("WF_TPU_PALLAS", "auto")
     # Key-aligned mesh ingest (parallel/emitters.AlignedMeshStageEmitter
     # + mesh.py ingest="aligned", docs/OBSERVABILITY.md "Wire plane"):
     # host-fed key-sharded FFAT consumers take their batches PRE-PLACED

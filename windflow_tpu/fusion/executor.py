@@ -109,7 +109,17 @@ def build_prelude(members):
     the staged path already dispatches, so by the time a fused segment's
     program (prelude + tail) sees the batch, its lanes are decoded —
     the per-batch dispatch sequence stays exactly ``unpack → fused
-    program``, compressed or not (pinned by tests/test_wire.py)."""
+    program``, compressed or not (pinned by tests/test_wire.py).
+
+    Pallas kernels (windflow_tpu/kernels) compose BEHIND it the same
+    way: the tail builders that inline this prelude
+    (``ffat_tpu._build_step``, ``ReduceTPU._get_dense_step`` /
+    ``_get_compacted_step``) resolve ``Config.pallas_kernels`` at
+    program-build time, so a fused chain's single program carries
+    prelude + Pallas kernel bodies + tail state machine in ONE
+    dispatch — the kill switch (``WF_TPU_PALLAS=0``) swaps the kernel
+    regions back to lax without touching the fusion plan (pinned by
+    tests/test_pallas_kernels.py's zero-dispatch-delta test)."""
     from windflow_tpu.ops.chained import _tpu_specs
     specs = []
     for op in members:

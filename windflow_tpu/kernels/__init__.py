@@ -1,0 +1,31 @@
+"""Hand-written Pallas TPU kernels for the FFAT hot loop.
+
+The first subsystem where windflow_tpu emits its own TPU machine code
+instead of leaning on XLA fusion (ROADMAP item 3): the hottest regions
+of the fused FFAT/reduce programs — segmented grouping, the pane-level
+sliding fold, and the dense segmented reduce — as Pallas kernels that
+drop into the SAME wf_jit programs the lax compositions occupied
+(zero dispatch-count change; ``Config.pallas_kernels`` /
+``WF_TPU_PALLAS`` gates, lax path restored verbatim under ``=0``).
+"""
+
+from windflow_tpu.kernels.pallas_ffat import (PallasMode, dense_monoid_table,
+                                              fold_supported,
+                                              grouping_rank_hist,
+                                              grouping_supported,
+                                              monoid_identity_py, order_hist,
+                                              pallas_build_count,
+                                              pallas_forced, resolve_pallas,
+                                              resolve_pallas_for,
+                                              routed_monoid_tables,
+                                              sliding_fold, table_leaf_ok,
+                                              table_supported)
+
+__all__ = [
+    "PallasMode", "resolve_pallas", "resolve_pallas_for",
+    "pallas_forced", "pallas_build_count",
+    "grouping_supported", "grouping_rank_hist", "order_hist",
+    "fold_supported", "sliding_fold",
+    "table_supported", "table_leaf_ok", "dense_monoid_table",
+    "routed_monoid_tables", "monoid_identity_py",
+]

@@ -730,7 +730,17 @@ class ShardLedger:
         kk = mesh.shape[KEY_AXIS]
         n = dd * kk
         from windflow_tpu.ops.tpu import ReduceTPU
-        if isinstance(op, ReduceTPU):
+        if getattr(op, "_ingest_mode", None) == "aligned":
+            # key-aligned ingest (parallel/emitters.
+            # AlignedMeshStageEmitter): the host pre-placed each tuple
+            # on its key-owner column; only the within-column data-axis
+            # gather remains, for EVERY aligned consumer kind — FFAT
+            # windows, dense ReduceTPU (whose [K]-table psum/all_gather
+            # vanishes entirely), dense-key stateful (whose psum lane
+            # merge vanishes too)
+            total = cap * bpt * (dd - 1)
+            kind = "all_gather(data|key-aligned)"
+        elif isinstance(op, ReduceTPU):
             if op.max_keys is not None:
                 k = op.max_keys if op.key_extractor is not None else 1
                 table = k * bpt
@@ -742,15 +752,6 @@ class ShardLedger:
                 # hash-sharded all_to_all: (n-1)/n of the lanes cross ICI
                 total = cap * bpt * (n - 1) / n
                 kind = "all_to_all(lanes)"
-        elif getattr(op, "_ingest_mode", None) == "aligned":
-            # key-aligned ingest (parallel/emitters.
-            # AlignedMeshStageEmitter): the host pre-placed each tuple
-            # on its key-owner column, so only the within-column
-            # data-axis gather remains — each key shard re-assembles
-            # its OWN cap/kk lanes, zero key-axis traffic (identity on
-            # a 1-wide data axis)
-            total = cap * bpt * (dd - 1)
-            kind = "all_gather(data|key-aligned)"
         else:
             # key-sharded state (FFAT / stateful): every key shard
             # all_gathers the data-sharded batch — each of the kk*dd

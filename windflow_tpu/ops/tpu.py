@@ -380,6 +380,7 @@ class ReduceTPU(Operator):
         keys)."""
         step = self._jit_steps.get(("dense", capacity))
         if step is None:
+            from windflow_tpu.kernels import resolve_pallas_for
             from windflow_tpu.windows.ffat_kernels import (_monoid_identity,
                                                            _monoid_scatter)
             # non-keyed: one global segment, K=1 (the mesh contract,
@@ -388,6 +389,12 @@ class ReduceTPU(Operator):
             monoid = self.monoid
             key_fn = self.key_extractor
             prelude = self._fused_prelude
+            # Pallas segmented reduce (windflow_tpu/kernels): the dense
+            # slot tables build in one tiled masked-fold kernel traced
+            # into this same program; leaves outside the kernel's
+            # shape/dtype gates keep the lax scatter (per-leaf routing
+            # — values identical either way)
+            pallas = resolve_pallas_for(self)
 
             def step(keys, payload, ts, valid):
                 if prelude is not None:
@@ -410,10 +417,25 @@ class ReduceTPU(Operator):
                                    leaf.dtype)
                     return _monoid_scatter(buf.at[row], monoid)(
                         jnp.where(_bshape(ok, leaf), leaf, ident))[:K]
-                table = jax.tree.map(scat, payload)
-                ts_t = jnp.full(K + 1, -1, jnp.int64).at[row].max(
-                    jnp.where(ok, ts, jnp.int64(-1)))[:K]
-                has = jnp.zeros(K + 1, bool).at[row].set(True)[:K]
+
+                def lax_ts():
+                    return jnp.full(K + 1, -1, jnp.int64).at[row].max(
+                        jnp.where(ok, ts, jnp.int64(-1)))[:K]
+
+                routed = None
+                if pallas is not None:
+                    from windflow_tpu import kernels as pk
+                    routed = pk.routed_monoid_tables(
+                        row, payload, monoid, K, pallas.interpret,
+                        lax_leaf=scat, ts=ts, ts_init=-1,
+                        lax_ts=lax_ts, want_count=True)
+                if routed is not None:
+                    table, ts_t, cnt = routed
+                    has = cnt > 0
+                else:
+                    table = jax.tree.map(scat, payload)
+                    ts_t = lax_ts()
+                    has = jnp.zeros(K + 1, bool).at[row].set(True)[:K]
                 return table, ts_t, has, n_drop
 
             step = wf_jit(step,
@@ -431,13 +453,15 @@ class ReduceTPU(Operator):
         path."""
         step = self._jit_steps.get(("compact", capacity))
         if step is None:
+            from windflow_tpu.kernels import resolve_pallas_for
             from windflow_tpu.parallel import compaction
             bounded = self.max_keys is not None
             step = compaction.make_compacted_reduce(
                 capacity,
                 self.max_keys if bounded else self._compactor.slots,
                 self.monoid, self.comb, self.key_extractor,
-                self._fused_prelude, bounded)
+                self._fused_prelude, bounded,
+                pallas=resolve_pallas_for(self))
             # the donated operand is the cstats state (last arg); the
             # remap tables are read-only operands shared across steps
             donate = (4,) if bounded else (6,)
@@ -469,9 +493,15 @@ class ReduceTPU(Operator):
                     # so the cache key needs no variant tag
                     remap=self._compactor is not None)
             else:
+                # key-aligned ingest (mesh.mark_aligned_ingest): host
+                # pre-placed lanes let each key shard build only its
+                # own table rows — the cross-chip table collective
+                # disappears (parallel/mesh.py)
                 step = make_sharded_reduce_step(
                     self.mesh, capacity, K, self.comb, self.key_extractor,
-                    monoid=self.monoid, op_name=f"{self.name}.mesh")
+                    monoid=self.monoid,
+                    ingest=getattr(self, "_ingest_mode", None) or "data",
+                    op_name=f"{self.name}.mesh")
             self._jit_steps[("mesh", capacity)] = step
         return step
 

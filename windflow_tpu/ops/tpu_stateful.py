@@ -301,7 +301,12 @@ class _StatefulTPUBase(Operator):
             step = make_sharded_stateful_step(
                 self.mesh, capacity, self.num_key_slots,
                 self._body_factory(), self.key_extractor, self.dense_keys,
-                self._is_filter, op_name=f"{self.name}.mesh")
+                self._is_filter,
+                # key-aligned ingest (mesh.mark_aligned_ingest): lanes
+                # arrive pre-placed on their slot-owner's column — no
+                # data-axis all_gather, no psum lane merge
+                ingest=getattr(self, "_ingest_mode", None) or "data",
+                op_name=f"{self.name}.mesh")
             # shard the state table along the key axis on first use
             self._state = jax.device_put(self._state,
                                          state_sharding(self.mesh))

@@ -209,7 +209,8 @@ def _dec64(c, dtype):
 
 
 def make_compacted_reduce(capacity: int, table_size: int, monoid: str,
-                          comb, key_fn, prelude, bounded: bool):
+                          comb, key_fn, prelude, bounded: bool,
+                          pallas=None):
     """Build the compacted keyed-reduce program body.
 
     ``(keys, payload, ts, valid[, table_keys, table_slots], cstats) ->
@@ -228,7 +229,14 @@ def make_compacted_reduce(capacity: int, table_size: int, monoid: str,
     ``bounded`` is the declared-``withMaxKeys`` variant: the remap is
     the identity over ``[0, max_keys)`` (no table operands) and
     out-of-range keys ride the overflow lane instead of being dropped —
-    the retirement of the PR 1 silent-drop/RuntimeWarning path."""
+    the retirement of the PR 1 silent-drop/RuntimeWarning path.
+
+    ``pallas`` (a resolved :class:`windflow_tpu.kernels.PallasMode`):
+    the dense half's one-scatter combine re-tiles through the Pallas
+    segmented-reduce kernel where its gates hold — the packed int64
+    carrier rides as one multi-column leaf, per-leaf scatters route
+    per leaf — traced into this same program, bit-identical output
+    (all-integer folds on the packed path)."""
     import jax
     import jax.numpy as jnp
 
@@ -294,8 +302,22 @@ def make_compacted_reduce(capacity: int, table_size: int, monoid: str,
             widths = [int(c.shape[1]) for c in cols]
             upd = jnp.concatenate(cols + [tcol[:, None]], axis=1)
             ident = I64MIN if monoid == "max" else I64MAX
-            buf = jnp.full((T + 1, int(upd.shape[1])), ident, jnp.int64)
-            tbl = _monoid_scatter(buf.at[row], monoid)(upd)[:T]
+            tbl = None
+            if pallas is not None:
+                from windflow_tpu import kernels as pk
+                if pk.table_supported(capacity, T) \
+                        and pk.table_leaf_ok(upd.shape, upd.dtype,
+                                             pallas.interpret):
+                    # Pallas segmented reduce over the packed carrier:
+                    # all-integer masked folds — bit-identical to the
+                    # variadic scatter
+                    tbl = pk.dense_monoid_table(
+                        row, [upd], [monoid], [ident], T,
+                        pallas.interpret)[0]
+            if tbl is None:
+                buf = jnp.full((T + 1, int(upd.shape[1])), ident,
+                               jnp.int64)
+                tbl = _monoid_scatter(buf.at[row], monoid)(upd)[:T]
             has = tbl[:, -1] != ident
             ts_t = jnp.where(has, tbl[:, -1] if monoid == "max"
                              else -tbl[:, -1], I64MIN)
@@ -306,16 +328,31 @@ def make_compacted_reduce(capacity: int, table_size: int, monoid: str,
                 off += w
             table = jax.tree_util.tree_unflatten(treedef, outs)
         else:
-            # "sum" (or an unpackable leaf dtype): per-leaf scatters
+            # "sum" (or an unpackable leaf dtype): per-leaf scatters,
+            # re-tiled through the Pallas kernel leaf by leaf where its
+            # shape/dtype gates hold
             def scat(leaf):
                 ident = _monoid_identity(monoid, leaf.dtype)
                 buf = jnp.full((T + 1,) + leaf.shape[1:], ident,
                                leaf.dtype)
                 return _monoid_scatter(buf.at[row], monoid)(leaf)[:T]
 
-            table = jax.tree.map(scat, payload)
-            ts_t = jnp.full(T + 1, I64MIN, jnp.int64).at[row].max(
-                sts)[:T]
+            def lax_ts():
+                return jnp.full(T + 1, I64MIN, jnp.int64).at[row].max(
+                    sts)[:T]
+
+            routed = None
+            if pallas is not None:
+                from windflow_tpu import kernels as pk
+                routed = pk.routed_monoid_tables(
+                    row, payload, monoid, T, pallas.interpret,
+                    lax_leaf=scat, ts=sts, ts_init=int(I64MIN),
+                    lax_ts=lax_ts)
+            if routed is not None:
+                table, ts_t, _ = routed
+            else:
+                table = jax.tree.map(scat, payload)
+                ts_t = lax_ts()
             has = ts_t != I64MIN
 
         # key-ascending view of the dense table: bounded slots ARE keys;

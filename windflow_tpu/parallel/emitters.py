@@ -1498,10 +1498,14 @@ def create_emitter(routing: RoutingMode,
             # key-aligned mesh ingest (ROADMAP item 4b): the graph build
             # marked this key-sharded consumer aligned (host-fed only),
             # so each record stages straight to its owning key shard and
-            # the sharded step skips the data-axis all_gather
+            # the sharded step skips its cross-chip collectives.  The
+            # placement bound is the consumer's dense key/slot space
+            # (mesh._aligned_slot_bound — FFAT/reduce max_keys, stateful
+            # num_key_slots).
+            from windflow_tpu.parallel.mesh import _aligned_slot_bound
             return AlignedMeshStageEmitter(dests, output_batch_size,
                                            key_extractor, mesh,
-                                           dst_op.max_keys)
+                                           _aligned_slot_bound(dst_op))
         if routing == RoutingMode.KEYBY and len(dests) > 1 \
                 and key_extractor is not None:
             # Key-partitioned delivery: each key's tuples always reach the

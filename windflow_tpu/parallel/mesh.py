@@ -115,19 +115,56 @@ def stage_batch(hb: HostBatch, capacity: int, mesh: Mesh) -> DeviceBatch:
         watermark=db.watermark, size=db.known_size)
 
 
+def _aligned_slot_bound(op) -> Optional[int]:
+    """The dense slot space an aligned emitter would place by, or None
+    when this operator kind/configuration cannot take aligned ingest:
+
+    * key-sharded ``FfatWindowsTPU`` with a declared dense key space
+      (the PR 13 original);
+    * declared-``withMaxKeys`` ``ReduceTPU`` — the sharded dense
+      reduce (ROADMAP item-4 leftover: pre-placed lanes let each key
+      shard build ONLY its own partial rows, so the cross-chip table
+      collective — psum for monoids, all_gather+fold for generic
+      combiners — disappears entirely);
+    * ``withDenseKeys`` stateful Map/Filter — pre-placed lanes are
+      exactly the lanes whose slots the shard owns, so the data-axis
+      all_gather AND the psum lane merge both vanish.
+
+    Compacted key spaces stay unaligned (admission runs at the keyed
+    staging boundary of a replica-sharded consumer)."""
+    from windflow_tpu.ops.tpu import ReduceTPU
+    from windflow_tpu.ops.tpu_stateful import _StatefulTPUBase
+    from windflow_tpu.windows.ffat_tpu import FfatWindowsTPU
+    if op.key_extractor is None:
+        return None
+    if isinstance(op, FfatWindowsTPU):
+        if op.max_keys is None or getattr(op, "_compact_keys", False):
+            return None
+        return op.max_keys
+    if isinstance(op, ReduceTPU):
+        return op.max_keys      # None (arbitrary/compacted) = unaligned
+    if isinstance(op, _StatefulTPUBase):
+        return op.num_key_slots if op.dense_keys else None
+    return None
+
+
 def mark_aligned_ingest(graph) -> None:
     """Mark the mesh consumers eligible for KEY-ALIGNED ingest (ROADMAP
     item 4b; ``Config.key_aligned_ingest`` / ``WF_TPU_KEY_ALIGNED=0``
-    kill switch): a key-sharded FfatWindowsTPU with a declared dense key
-    space, fed EXCLUSIVELY by host staging edges under KEYBY routing, is
-    stamped ``_ingest_mode="aligned"`` — the graph wiring then installs
+    kill switch): a key-sharded consumer with a declared dense key/slot
+    space (:func:`_aligned_slot_bound` — FFAT windows, dense
+    ``ReduceTPU``, dense-key stateful Map/Filter), fed EXCLUSIVELY by
+    host staging edges under KEYBY routing, is stamped
+    ``_ingest_mode="aligned"`` — the graph wiring then installs
     :class:`~windflow_tpu.parallel.emitters.AlignedMeshStageEmitter` on
-    those edges and ``_build_step`` compiles the no-all_gather variant
-    (:func:`_ffat_shard_layout` ``"aligned"``).  Device-fed windows keep
-    the data-sharded ingest (a TPU→TPU edge has no host boundary to
-    align at), as do compacted key spaces (their admission runs at the
-    keyed staging boundary of a REPLICA-sharded consumer) and
-    multi-process graphs (each process stages only its local lanes).
+    those edges and the consumer's sharded step compiles its
+    no-all_gather variant (``_ffat_shard_layout`` ``"aligned"`` /
+    ``make_sharded_reduce_step`` / ``make_sharded_stateful_step``
+    ``ingest="aligned"``).  Device-fed consumers keep the data-sharded
+    ingest (a TPU→TPU edge has no host boundary to align at), as do
+    compacted key spaces (their admission runs at the keyed staging
+    boundary of a REPLICA-sharded consumer) and multi-process graphs
+    (each process stages only its local lanes).
 
     Called by ``PipeGraph._build`` after replica construction, before
     edge wiring — the emitter dispatch reads the stamp."""
@@ -136,7 +173,6 @@ def mark_aligned_ingest(graph) -> None:
     if mesh is None or jax.process_count() > 1:
         return
     from windflow_tpu.basic import RoutingMode
-    from windflow_tpu.windows.ffat_tpu import FfatWindowsTPU
     kk = mesh.shape[KEY_AXIS]
     dd = mesh.shape[DATA_AXIS]
     ups = {}
@@ -152,14 +188,13 @@ def mark_aligned_ingest(graph) -> None:
                     ups.setdefault(id(child.operators[0]),
                                    []).append(src)
     for op in graph._topo_operators():
-        if not isinstance(op, FfatWindowsTPU):
+        if not getattr(op, "is_tpu", False):
             continue
-        if op.max_keys is None or op.key_extractor is None \
-                or op.routing != RoutingMode.KEYBY \
-                or op.parallelism != 1 \
-                or getattr(op, "_compact_keys", False):
+        bound = _aligned_slot_bound(op)
+        if bound is None or op.routing != RoutingMode.KEYBY \
+                or op.parallelism != 1:
             continue
-        if op.max_keys % kk:
+        if bound % kk:
             continue        # WF402 territory: the mesh pass reports it
         feeds = ups.get(id(op), [])
         if not feeds or any(u.is_tpu for u in feeds):
@@ -203,6 +238,7 @@ def make_sharded_reduce_step(mesh: Mesh, capacity: int, K: int,
                              comb: Callable, key_fn: Optional[Callable],
                              use_psum: bool = False,
                              monoid: Optional[str] = None,
+                             ingest: str = "data",
                              op_name: str = "mesh.reduce_step"):
     """Sharded ReduceTPU step with the operator's batch contract: returns
     ``fn(payload, ts, valid) -> (table, ts_out, has, n_dropped)`` where
@@ -221,13 +257,72 @@ def make_sharded_reduce_step(mesh: Mesh, capacity: int, K: int,
     ``reduce_gpu.hpp:227-283``).
 
     Non-keyed reduces pass ``key_fn=None`` with ``K == 1`` (the
-    ``thrust::reduce`` global path)."""
+    ``thrust::reduce`` global path).
+
+    ``ingest="aligned"`` (key-aligned mesh ingest, ROADMAP item-4
+    leftover): the host pre-placed every tuple on its key-owner's
+    ``(data, key)`` column (AlignedMeshStageEmitter, dense-range owner
+    ``key // K_local``), so each key shard builds ONLY its own
+    ``K_local`` partial rows from its own ``capacity/kk`` lanes and
+    the cross-chip table combine — ``psum``/``pmax``/``pmin`` of
+    ``[K, ...]`` tables for declared monoids, ``all_gather`` + log-fold
+    for generic combiners — disappears ENTIRELY; only the within-column
+    data-axis gather remains (identity at ``data=1``), and the output
+    tables return key-sharded instead of replicated (same global
+    ``[K]`` contract)."""
     monoid = resolve_monoid(use_psum, monoid)
     n_total = math.prod(mesh.devices.shape)
     if capacity % n_total:
         raise WindFlowError(
             f"capacity {capacity} not divisible by {n_total} devices")
     axes = (DATA_AXIS, KEY_AXIS)
+    if ingest not in ("data", "aligned"):
+        raise WindFlowError(f"unknown reduce ingest layout '{ingest}'")
+    if ingest == "aligned":
+        kk = mesh.shape[KEY_AXIS]
+        dd = mesh.shape[DATA_AXIS]
+        if K % kk:
+            raise WindFlowError(
+                f"max_keys {K} not divisible by key axis {kk}")
+        K_local = K // kk
+
+        def local_aligned(payload, ts, valid):
+            keys = jax.vmap(key_fn)(payload).astype(jnp.int32)
+            base = (jax.lax.axis_index(KEY_AXIS)
+                    * K_local).astype(jnp.int32)
+            lk = keys - base
+            in_range = (keys >= 0) & (keys < K) \
+                & (lk >= 0) & (lk < K_local)
+            # out-of-range keys clip onto an edge column host-side and
+            # mask out here — counted exactly like the unaligned drop
+            n_drop = jax.lax.psum(
+                jnp.sum(valid & ~in_range, dtype=jnp.int64), axes)
+            ok = valid & in_range
+            if dd > 1:
+                # within-column hop only (1/kk of the all_gather bytes):
+                # every data row of a key column folds the same lanes
+                ag = lambda a: jax.lax.all_gather(a, DATA_AXIS, axis=0,
+                                                  tiled=True)
+                payload = jax.tree.map(ag, payload)
+                lk, ts, ok = ag(lk), ag(ts), ag(ok)
+            vals = (payload, ts)
+            comb2 = lambda a, b: (comb(a[0], b[0]),
+                                  jnp.maximum(a[1], b[1]))
+            (table, ts_t), has = _dense_keyed_partial(
+                lk, vals, ok, comb2, K_local)
+            # each shard's rows are FINAL — no cross-chip combine; rows
+            # a shard never saw stay invalid exactly as the collective
+            # path leaves them identity-filled/unfolded
+            ts_out = jnp.where(has, ts_t, jnp.int64(-1))
+            return table, ts_out, has, n_drop
+
+        bspec = P((DATA_AXIS, KEY_AXIS))
+        fn = shard_map(local_aligned, mesh=mesh,
+                       in_specs=(bspec, bspec, bspec),
+                       out_specs=(P(KEY_AXIS), P(KEY_AXIS),
+                                  P(KEY_AXIS), P()),
+                       check_vma=False)
+        return wf_jit(fn, op_name=op_name)
 
     def local(payload, ts, valid):
         if key_fn is not None:
@@ -526,7 +621,7 @@ def make_sharded_ffat_state(agg_spec, K: int, R: int, mesh: Mesh):
 def make_sharded_stateful_step(mesh: Mesh, capacity: int, S: int,
                                body_factory: Callable,
                                key_fn: Callable, dense: bool,
-                               is_filter: bool,
+                               is_filter: bool, ingest: str = "data",
                                op_name: str = "mesh.stateful_step"):
     """Key-sharded stateful Map/Filter step (reference stateful ``Map_GPU``
     whose keyed state is one shared table, ``map_gpu.hpp:114-115``; here the
@@ -539,7 +634,19 @@ def make_sharded_stateful_step(mesh: Mesh, capacity: int, S: int,
     owns (non-owned lanes contribute the body's neutral output), and lane
     results merge across key shards with one ``psum`` — each lane has
     exactly one owner, so the sum selects its real result.  Outputs return
-    data-sharded, matching the batch layout downstream stages expect."""
+    data-sharded, matching the batch layout downstream stages expect.
+
+    ``ingest="aligned"`` (key-aligned mesh ingest; dense slot spaces
+    only — AlignedMeshStageEmitter places by the same ``slot //
+    S_local`` dense-range owner): each key shard's lanes are exactly
+    the lanes whose slots it owns, so BOTH collectives of the default
+    layout vanish — no data-axis all_gather to see foreign lanes, no
+    psum lane merge to reconcile owners (every lane has its owner's
+    verdict in place).  Outputs stay in the aligned ``(data, key)``
+    layout; the only residual hop is the within-column data gather at
+    ``data > 1``.  Per-key arrival order is preserved (the emitter
+    appends each column in arrival order), so state evolution is
+    record-identical to the unaligned layout per key."""
     kk = mesh.shape[KEY_AXIS]
     dd = mesh.shape[DATA_AXIS]
     if S % kk:
@@ -550,6 +657,54 @@ def make_sharded_stateful_step(mesh: Mesh, capacity: int, S: int,
             f"capacity {capacity} not divisible by data axis {dd}")
     S_local = S // kk
     blk = capacity // dd
+    if ingest not in ("data", "aligned"):
+        raise WindFlowError(
+            f"unknown stateful ingest layout '{ingest}'")
+    if ingest == "aligned":
+        if not dense:
+            raise WindFlowError(
+                "key-aligned stateful ingest requires withDenseKeys")
+        if capacity % (dd * kk):
+            raise WindFlowError(
+                f"capacity {capacity} not divisible by the mesh's "
+                f"{dd * kk} devices (key-aligned ingest)")
+        col_cap = capacity // kk        # lanes one key column holds
+        blk_col = capacity // (dd * kk)  # one device's block of them
+        body_a = body_factory(col_cap, S_local)
+
+        def local_aligned(state, payload, valid, _uk, _us):
+            if dd > 1:
+                ag = lambda a: jax.lax.all_gather(a, DATA_AXIS, axis=0,
+                                                  tiled=True)
+                payload = jax.tree.map(ag, payload)
+                valid = ag(valid)
+            keys = jax.vmap(key_fn)(payload).astype(jnp.int32)
+            base = (jax.lax.axis_index(KEY_AXIS)
+                    * S_local).astype(jnp.int32)
+            lslot = keys - base
+            owned = valid & (keys >= 0) & (keys < S) \
+                & (lslot >= 0) & (lslot < S_local)
+            lslot = jnp.where(owned, lslot, jnp.int32(S_local))
+            new_state, out_payload, out_valid = body_a(
+                state, payload, owned, lslot)
+            d = jax.lax.axis_index(DATA_AXIS) * blk_col
+            sl = lambda a: jax.lax.dynamic_slice_in_dim(a, d, blk_col,
+                                                        axis=0)
+            owned_b = sl(owned)
+            if is_filter:
+                # the owner's verdict is in place — un-owned (foreign /
+                # out-of-range) lanes drop, the single-chip contract
+                return (new_state, jax.tree.map(sl, payload),
+                        sl(out_valid) & owned_b)
+            return (new_state, jax.tree.map(sl, out_payload), owned_b)
+
+        bspec = P((DATA_AXIS, KEY_AXIS))
+        fn = shard_map(
+            local_aligned, mesh=mesh,
+            in_specs=(P(KEY_AXIS), bspec, bspec, P(), P()),
+            out_specs=(P(KEY_AXIS), bspec, bspec),
+            check_vma=False)
+        return wf_jit(fn, op_name=op_name, donate_argnums=(0,))
     body = body_factory(capacity, S_local)
 
     def merge_lanes(leaf, owned):

@@ -18,22 +18,36 @@ from windflow_tpu.windows.grouping import (auto_order, dense_rank,
                                            order_and_hist)
 
 
-def _group_order(ids, nbuckets: int, grouping: str):
+def _group_order(ids, nbuckets: int, grouping: str, pallas=None):
     """Stable grouping permutation: ``rank_scatter`` is the O(n) dense-key
     counting sort (grouping.py; beyond two radix passes — TB (key, pane)
     spaces past DIGIT^2 buckets — auto_order falls back to the sort, where
     the counting constant no longer wins), ``argsort`` the comparison-sort
-    baseline.  Bit-identical either way (both order by (id, arrival))."""
+    baseline.  Bit-identical either way (both order by (id, arrival)).
+
+    ``pallas`` (a resolved :class:`windflow_tpu.kernels.PallasMode`)
+    routes the counting grouping through the single-pass Pallas kernel
+    where its gates hold (windflow_tpu/kernels) — same permutation,
+    traced into the same program."""
     if grouping == "rank_scatter":
+        if pallas is not None:
+            from windflow_tpu import kernels as pk
+            if pk.grouping_supported(int(ids.shape[0]), nbuckets):
+                return pk.order_hist(ids, nbuckets, pallas.interpret)[0]
         return auto_order(ids, nbuckets)
     return jnp.argsort(ids, stable=True)
 
 
-def _group_order_hist(ids, nbuckets: int, grouping: str):
+def _group_order_hist(ids, nbuckets: int, grouping: str, pallas=None):
     """``_group_order`` plus the ``[nbuckets]`` histogram of ids — on the
     single-counting-pass grouping the histogram is the ``dense_rank``
-    byproduct, so the CB step's rank arithmetic costs no extra pass."""
+    byproduct, so the CB step's rank arithmetic costs no extra pass.
+    On the Pallas path both come out of the one fused kernel."""
     if grouping == "rank_scatter":
+        if pallas is not None:
+            from windflow_tpu import kernels as pk
+            if pk.grouping_supported(int(ids.shape[0]), nbuckets):
+                return pk.order_hist(ids, nbuckets, pallas.interpret)
         return order_and_hist(ids, nbuckets)
     order = jnp.argsort(ids, stable=True)
     return order, jnp.zeros(nbuckets, jnp.int32) \
@@ -236,7 +250,7 @@ def make_ffat_step(capacity: int, K: int, P: int, R: int, D: int,
                    key_fn: Optional[Callable],
                    key_base_fn: Optional[Callable[[], Any]] = None,
                    sum_like: bool = False, grouping: str = "rank_scatter",
-                   monoid: Optional[str] = None):
+                   monoid: Optional[str] = None, pallas=None):
     """Build the (un-jitted) FFAT per-batch program.
 
     Pure-function form of the operator step so the multi-chip layer
@@ -268,7 +282,13 @@ def make_ffat_step(capacity: int, K: int, P: int, R: int, D: int,
     sorted layout, no segmented scan, no run-end detection.  The declared
     op is commutative, so only float rounding order differs from the
     sequential fold (exactly the tolerance psum already implies; max/min
-    are idempotent — bit-identical either way)."""
+    are idempotent — bit-identical either way).
+
+    ``pallas`` (a resolved :class:`windflow_tpu.kernels.PallasMode`, or
+    None for the pure-lax program): the grouping/rank pass and the
+    declared-monoid sliding fold trace their Pallas kernel bodies into
+    this SAME program where the kernel gates hold — no extra dispatch,
+    record-for-record identical output (docs/PERF.md round 14)."""
     monoid = resolve_monoid(sum_like, monoid)
     NP1 = capacity // P + 2           # pane cells incl. continuation cell
     # total fired across all keys: sum_k panes_k/D + per-key partials
@@ -291,9 +311,21 @@ def make_ffat_step(capacity: int, K: int, P: int, R: int, D: int,
         skey_for_sort = jnp.where(ok, keys, K)
 
         if scatter_combine:
-            rank_p, counts, _, _ = dense_rank(skey_for_sort, K + 1)
-            rank_u = rank_p[:B]
-            n_k = counts[:K]
+            use_pk = False
+            if pallas is not None:
+                from windflow_tpu import kernels as pk
+                use_pk = pk.grouping_supported(B, K + 1)
+            if use_pk:
+                # fused Pallas grouping: rank + histogram in one pass
+                # (bit-identical to dense_rank — same (id, arrival)
+                # counting), traced into this same program
+                _, rank_u, hist_pk = pk.grouping_rank_hist(
+                    skey_for_sort, K + 1, pallas.interpret)
+                n_k = hist_pk[:K]
+            else:
+                rank_p, counts, _, _ = dense_rank(skey_for_sort, K + 1)
+                rank_u = rank_p[:B]
+                n_k = counts[:K]
             lifts = jax.vmap(lift)(payload)
             fill0_u = state["cur_fill"][jnp.minimum(skey_for_sort, K - 1)]
             col_u = jnp.where(
@@ -328,7 +360,7 @@ def make_ffat_step(capacity: int, K: int, P: int, R: int, D: int,
             # itself is the counting permutation's dense_rank byproduct
             # on the single-pass path — free.
             order, hist = _group_order_hist(skey_for_sort, K + 1,
-                                            grouping)
+                                            grouping, pallas)
             sk = skey_for_sort[order]
             slift = jax.tree.map(lambda a: a[order],
                                  jax.vmap(lift)(payload))
@@ -407,8 +439,21 @@ def make_ffat_step(capacity: int, K: int, P: int, R: int, D: int,
             # declared identity-absorbing: the flag lane of the fold is
             # pure overhead here (the CB step never reads the flag output
             # — fired windows always contain data)
-            swin = _sliding_reduce_plain(comb, full_valid, full, R,
-                                         axis=1, monoid=monoid)
+            use_fold = False
+            if pallas is not None:
+                from windflow_tpu import kernels as pk
+                use_fold = pk.fold_supported(full, R, monoid,
+                                             pallas.interpret)
+            if use_fold:
+                # Pallas pane combine: identity fill + blocked sliding
+                # fold in one VMEM-resident kernel (MXU banded matmul
+                # for f32 sums, the lax fold's own doubling schedule
+                # on the VPU otherwise — module docstring)
+                swin = pk.sliding_fold(full, full_valid, R, monoid,
+                                       pallas.interpret)
+            else:
+                swin = _sliding_reduce_plain(comb, full_valid, full, R,
+                                             axis=1, monoid=monoid)
         else:
             _, swin = _sliding_reduce(comb, full_valid, full, R, axis=1)
 
@@ -568,7 +613,7 @@ def make_ffat_tb_step(capacity: int, K: int, P_usec: int, R: int, D: int,
                       drop_tainted: bool = False,
                       grouping: str = "rank_scatter",
                       sum_like: bool = False,
-                      monoid: Optional[str] = None):
+                      monoid: Optional[str] = None, pallas=None):
     """Time-based FFAT per-batch program.
 
     Window ``w`` covers panes ``[w*D, w*D + R)`` — times
@@ -783,7 +828,7 @@ def make_ffat_tb_step(capacity: int, K: int, P_usec: int, R: int, D: int,
                             jnp.int64(K) * NP)
             if K * NP + 1 < (1 << 31):   # counting ids are int32
                 order = _group_order(sid.astype(jnp.int32), K * NP + 1,
-                                     grouping)
+                                     grouping, pallas)
             else:
                 order = jnp.argsort(sid, stable=True)
             ssid = sid[order]

@@ -277,6 +277,13 @@ class FfatWindowsTPU(Operator):
                 self.lift, self.comb, self.key_extractor,
                 monoid=self.monoid, grouping=self._grouping(),
                 ingest=ingest, op_name=f"{self.name}.mesh")
+        # Pallas kernel selection (windflow_tpu/kernels): resolved once
+        # per program build against Config.pallas_kernels + the runtime
+        # backend; the kernels trace into this same wf_jit program, so
+        # fused preludes, regrow rebuilds, and restore all keep them.
+        # Mesh programs above stay on the lax path (kernels inside
+        # shard_map are a future round).
+        pallas = self._pallas_mode()
         comp = self._compactor
         if comp is None:
             lift, key_fn = self.lift, self.key_extractor
@@ -295,13 +302,14 @@ class FfatWindowsTPU(Operator):
                                      drop_tainted=self.overflow_policy
                                      == "drop",
                                      grouping=self._grouping(),
-                                     monoid=self.monoid)
+                                     monoid=self.monoid, pallas=pallas)
         else:
             step = make_ffat_step(capacity, self.max_keys, self.P, self.R,
                                   self.D, lift, self.comb,
                                   key_fn,
                                   monoid=self.monoid,
-                                  grouping=self._grouping())
+                                  grouping=self._grouping(),
+                                  pallas=pallas)
         if comp is not None:
             from windflow_tpu.parallel import compaction
             kernel = step
@@ -346,6 +354,12 @@ class FfatWindowsTPU(Operator):
             donate = (0, 7 if self.is_tb else 6)
         return wf_jit(step, op_name=self._fused_name or self.name,
                       donate_argnums=donate)
+
+    def _pallas_mode(self):
+        """Resolved Pallas gate for this operator's compiled programs
+        (windflow_tpu/kernels; None = lax path)."""
+        from windflow_tpu.kernels import resolve_pallas_for
+        return resolve_pallas_for(self)
 
     def _grouping(self) -> str:
         """Batch-grouping algorithm from the graph config (rank_scatter |
