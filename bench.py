@@ -691,12 +691,15 @@ def run_bench_wire(platform: str, cfg: dict, jax) -> dict:
     }
 
 
-def _e2e_graph(cfg: dict, n_tuples: int, chunks, lat_sink):
+def _e2e_graph(cfg: dict, n_tuples: int, chunks, lat_sink, config=None):
     """Build the whole-framework pipeline (VERDICT r2 item 3: benchmark what
     ``PipeGraph.run()`` sustains, not the raw kernel): columnar byte ingest →
     staging → MapTPU → FilterTPU → FfatWindowsTPU → columnar Sink.  Matches
     the reference's measurement harnesses, which time whole pipelines
-    (BASELINE.md: Source→Map_GPU→Filter_GPU→Sink, ``tests/graph_tests_gpu``)."""
+    (BASELINE.md: Source→Map_GPU→Filter_GPU→Sink, ``tests/graph_tests_gpu``).
+
+    ``config``: optional :class:`windflow_tpu.Config` threaded to the
+    graph — the megastep section forces ``megastep_sweeps`` through it."""
     import windflow_tpu as wf
     from windflow_tpu.io import FrameSource
 
@@ -716,7 +719,7 @@ def _e2e_graph(cfg: dict, n_tuples: int, chunks, lat_sink):
          .withKeyBy(lambda t: t["key"]).withMaxKeys(K).build())
     snk = wf.Sink_Builder(lat_sink).withColumnarSink(defer=4).build()
     g = wf.PipeGraph("bench_e2e", wf.ExecutionMode.DEFAULT,
-                     wf.TimePolicy.INGRESS)
+                     wf.TimePolicy.INGRESS, config=config)
     pipe = g.add_source(src)
     pipe.add(m)
     pipe.chain(f)        # Map+Filter fuse into ONE XLA program (chaining)
@@ -763,11 +766,16 @@ def _measure_e2e_graph(graph_factory, n_tuples: int, CAP: int,
         # wire plane (windflow_tpu/wire.py): the staged run's measured
         # compression — main() folds it into the guarded `wire` section
         wire_stats = (_st.get("Staging") or {}).get("Wire")
+        # megastep plane (windflow_tpu/megastep.py): resolved K and
+        # per-edge scan/fallback accounting of THIS run — the megastep
+        # section reads it for its dispatches_per_batch number
+        megastep_stats = _st.get("Megastep")
     except Exception:  # lint: broad-except-ok (a ledger read must not
         # cost the bench its artifact; the missing roofline.per_hop key
         # fails check_bench_keys loudly instead)
         sweep = None
         wire_stats = None
+        megastep_stats = None
     # steady-state window: from the first sink result (compilation and
     # first-batch warmup done) to the end; the first batch's tuples are out
     # of the window.  The total number is reported alongside.  The steady
@@ -808,6 +816,7 @@ def _measure_e2e_graph(graph_factory, n_tuples: int, CAP: int,
         "elapsed_s": round(elapsed, 3),
         "sweep": sweep,
         "wire_stats": wire_stats,
+        "megastep_stats": megastep_stats,
     }
 
 
@@ -933,6 +942,119 @@ def run_bench_e2e_device(platform: str, cfg: dict, jax,
     return _median_of_runs(
         lambda: _measure_e2e_graph(build, n_tuples, CAP, kernel_tps),
         n_runs)
+
+
+def run_bench_megastep(platform: str, cfg: dict, jax,
+                       kernel_tps: float = 0.0) -> dict:
+    """Megastep A/B (windflow_tpu/megastep.py, guarded by
+    tools/check_bench_keys.py + check_bench_regress.py): the staged e2e
+    pipeline driven at a DISPATCH-BOUND batch size (small cap, many
+    sweeps — the regime the host pacer dominates and the megastep
+    exists to fix), once with ``megastep_sweeps`` forced to K and once
+    with the K=1 kill switch.  Reports the K-run's steady tuples/sec
+    (the guarded floor: CPU >= 10x the r14 54.8k per-batch baseline),
+    the measured speedup over the kill-switch run, and the dispatch
+    accounting the jit registry pins: one ``megastep.*`` program
+    dispatch serves K staged batches, so ``dispatches_per_batch`` over
+    the scanned batches is 1/K exactly — warmup (first-batch compile
+    probe) and EOS-remainder batches ship per-batch and are reported
+    next to it, not hidden in it (docs/OBSERVABILITY.md "Megastep in
+    the ledger")."""
+    import dataclasses
+
+    import numpy as np
+
+    import windflow_tpu as wf
+    from windflow_tpu.megastep import AUTO_K
+    from windflow_tpu.monitoring.jit_registry import default_registry
+
+    _setup_compile_cache(jax)
+
+    # dispatch-bound workload: 1k-row sweeps make the per-batch host
+    # cost (emitter finalize, drain, ring stamps, sink fold) the
+    # dominant term — at the default e2e cap the pipeline is
+    # compute-bound on CPU and folding dispatches cannot show
+    ms_cfg = dict(cfg, cap=1024, keys=64, win=256, slide=64)
+    CAP = ms_cfg["cap"]
+    n_tuples = int(os.environ.get("BENCH_MEGASTEP_TUPLES",
+                                  2048 * CAP))
+    n_runs = int(os.environ.get("BENCH_MEGASTEP_RUNS", "3"))
+    K = int(os.environ.get("BENCH_MEGASTEP_K", str(AUTO_K)))
+    rng = np.random.default_rng(3)
+
+    rec = np.empty(n_tuples, dtype=[("k", "<i8"), ("t", "<i8"),
+                                    ("v", "<f8")])
+    rec["k"] = rng.integers(0, ms_cfg["keys"], n_tuples)
+    rec["t"] = np.arange(n_tuples)   # overwritten by INGRESS stamping
+    rec["v"] = rng.random(n_tuples)
+    blob = rec.tobytes()
+
+    def chunks():
+        for lo in range(0, len(blob), 1 << 20):
+            yield blob[lo:lo + (1 << 20)]
+
+    reg = default_registry()
+
+    def measure(k):
+        config = dataclasses.replace(wf.default_config,
+                                     megastep_sweeps=k)
+        # determinism (same stance as the wire section): periodic
+        # punctuations flush partial megastep groups mid-run and turn
+        # the scanned/fallback split into wall-clock weather
+        config.punctuation_interval_usec = 10 ** 12
+
+        def build(lat_sink):
+            return _e2e_graph(ms_cfg, n_tuples, chunks, lat_sink,
+                              config=config)
+
+        _measure_e2e_graph(build, n_tuples, CAP, kernel_tps)  # warm
+        base = sum(n_disp for name, n_disp in
+                   reg.dispatch_counts().items()
+                   if name.startswith("megastep."))
+        med = _median_of_runs(
+            lambda: _measure_e2e_graph(build, n_tuples, CAP,
+                                       kernel_tps), n_runs)
+        mega_disp = sum(n_disp for name, n_disp in
+                        reg.dispatch_counts().items()
+                        if name.startswith("megastep.")) - base
+        return med, mega_disp
+
+    med_k, disp_k = measure(K)
+    med_1, _ = measure(1)
+
+    ms = med_k.pop("megastep_stats") or {}
+    med_1.pop("megastep_stats", None)
+    edge = (ms.get("edges") or [{}])[0]
+    scanned = edge.get("batches", 0)
+    megasteps = edge.get("megasteps", 0)
+    tps_k, tps_1 = med_k["tuples_per_sec"], med_1["tuples_per_sec"]
+    return {
+        "k": ms.get("k", K),
+        "e2e_tup_s": tps_k,
+        # the guarded floor (check_bench_keys): 10x the r14 CPU
+        # per-batch staged-e2e baseline (54.8k tup/s).  On TPU the
+        # acceptance criterion is ratio_vs_kernel (roofline-relative),
+        # not an absolute rate — the chip may sit behind a tunnel
+        "e2e_floor_tup_s": 548_000 if platform == "cpu" else 0,
+        "e2e_tup_s_k1": tps_1,
+        "speedup_vs_k1": round(tps_k / tps_1, 4) if tps_1 else 0.0,
+        "ratio_vs_kernel": round(tps_k / kernel_tps, 4)
+        if kernel_tps else 0.0,
+        # over the SCANNED batches: one compiled program per K sweeps,
+        # pinned by the registry's megastep.* dispatch count (the
+        # median-of-n run loop makes the count n_runs * megasteps)
+        "dispatches_per_batch": round(megasteps / scanned, 4)
+        if scanned else None,
+        "megastep_dispatches": disp_k,
+        "megasteps": megasteps,
+        "scanned_batches": scanned,
+        "fallback_batches": edge.get("fallback_batches", 0),
+        "warmup_batches": edge.get("warmup_batches", 0),
+        "steady_estimator": med_k["steady_estimator"],
+        "p99_window_latency_ms": med_k["p99_window_latency_ms"],
+        "dispersion": med_k.get("dispersion"),
+        "tuples": n_tuples,
+    }
 
 
 def scaling_step(jax, n: int, K: int, per_chip: int, seed: int = 2):
@@ -1402,6 +1524,30 @@ def main() -> None:
     except Exception as e:
         result["e2e_device_source_error"] = f"{type(e).__name__}: {e}"[:400]
 
+    # the default-config e2e runs above carry the resolved megastep K
+    # (auto: per-batch on CPU, K=8 on accelerator backends) — surface
+    # the scalar, drop the per-edge detail from the artifact
+    for _leg in ("e2e", "e2e_device_source"):
+        if isinstance(result.get(_leg), dict):
+            _ms = result[_leg].pop("megastep_stats", None)
+            result[_leg]["megastep_k"] = (_ms or {}).get("k", 1)
+
+    # megastep section (windflow_tpu/megastep.py, guarded by
+    # tools/check_bench_keys.py + check_bench_regress.py): the staged
+    # e2e pipeline at a dispatch-bound batch size with K sweeps folded
+    # into one compiled program vs the K=1 kill switch — the guarded
+    # floor holds the K-run's CPU steady rate at >= 10x the r14
+    # per-batch baseline, and dispatches_per_batch pins the 1-program-
+    # per-K-sweeps contract via the jit registry
+    try:
+        result["megastep"] = run_bench_megastep(
+            platform, CONFIGS[platform], jax,
+            kernel_tps=result["value"])
+    except Exception as e:  # lint: broad-except-ok (same stance as the
+        # other guarded legs: a megastep regression must fail
+        # check_bench_keys loudly, not kill the bench artifact)
+        result["megastep_error"] = f"{type(e).__name__}: {e}"[:400]
+
     # wire section (windflow_tpu/wire.py, guarded by
     # tools/check_bench_keys.py + check_bench_regress.py): the seeded
     # compression A/B over the e2e record spec — wire bytes/tuple,
@@ -1850,6 +1996,7 @@ def main() -> None:
                  "health": result.get("health"),
                  "shard": result.get("shard"),
                  "wire": result.get("wire"),
+                 "megastep": result.get("megastep"),
                  "durability": result.get("durability"),
                  "e2e": result.get("e2e"),
                  "e2e_device_source": result.get("e2e_device_source"),

@@ -45,7 +45,11 @@ python tools/wf_verify.py --strict \
 # restore), the pallas-kernel contracts (kernel-vs-lax record A/B
 # across window/reduce families incl. regrow + EOS edges, bit-equality
 # of the kernel bodies, zero-dispatch-delta pin, WF607, aligned-ingest
-# extension, kill-switch off-path), and the durability contracts (one chaos kill->restore->record-diff cell
+# extension, kill-switch off-path), the megastep contracts
+# (record-for-record K=1 vs K>1 A/B across operator families, the
+# 1-program-per-K-sweeps dispatch pin, WF608 downgrade preflight,
+# per-batch trace-lane honesty, megastep-aligned durability epochs),
+# and the durability contracts (one chaos kill->restore->record-diff cell
 # per mechanism, checkpoint store layout/GC, WF602 restore validation,
 # sink EOS fence, off-path budget — the full family x kill point x
 # fusion soak matrix is slow-marked for the nightly leg) fail
@@ -61,7 +65,8 @@ python -m pytest tests/test_staging.py tests/test_observability.py \
     tests/test_fusion.py tests/test_durability.py \
     tests/test_shard_plane.py tests/test_tracecheck.py \
     tests/test_key_compaction.py tests/test_reshard.py \
-    tests/test_wire.py tests/test_pallas_kernels.py -q -m 'not slow'
+    tests/test_wire.py tests/test_pallas_kernels.py \
+    tests/test_megastep.py -q -m 'not slow'
 python -m pytest tests/ -q -m 'not slow'
 python __graft_entry__.py 8
 BENCH_PLATFORM=cpu BENCH_E2E_TUPLES=131072 python bench.py | tee bench_ci_out.txt

@@ -105,14 +105,21 @@ def _run_dag(seed, config_rnd):
     # and so is key compaction (windflow_tpu/parallel/compaction.py):
     # compacted and legacy paths of the same keyed consumers must be too
     # — and so are the Pallas kernels (windflow_tpu/kernels): the
-    # kernel-backed and lax builds of the same programs must be too
+    # kernel-backed and lax builds of the same programs must be too —
+    # and so is the megastep executor (windflow_tpu/megastep): forcing
+    # K>1 over these host-fed record edges exercises the K-granular
+    # source pacing, the WF608 preflight walk on every fuzzed topology,
+    # and the downgrade paths' K=1-verbatim contract (the fold itself
+    # rides packed columnar edges — tests/test_megastep.py)
     cfg = wf.Config(host_worker_threads=config_rnd.choice([0, 0, 2, 4]),
                     whole_chain_fusion=config_rnd.choice([True, True,
                                                           False]),
                     key_compaction=config_rnd.choice([True, True,
                                                       False]),
                     pallas_kernels=config_rnd.choice(["auto", "auto",
-                                                      "0"]))
+                                                      "0"]),
+                    megastep_sweeps=config_rnd.choice(["auto", "auto",
+                                                       4]))
     g = wf.PipeGraph("fuzz", mode, wf.TimePolicy.EVENT, config=cfg)
     src_batch = config_rnd.randint(1, 64)
     mp = g.add_source(

@@ -80,10 +80,15 @@ def _run(family, wt, mode, rnd):
     # whole-chain fusion is a CONFIG dimension (windflow_tpu/fusion):
     # fused and unfused sweeps must reproduce the oracle exactly — and
     # so are the Pallas kernels (windflow_tpu/kernels): kernel-backed
-    # and lax builds of the same window programs must too
+    # and lax builds of the same window programs must too — and so is
+    # the megastep executor (windflow_tpu/megastep): forced-K sweeps of
+    # the same spec must match the oracle through the K-granular pacing
+    # and downgrade paths (the fold A/B lives in tests/test_megastep.py)
     cfg = wf.Config(whole_chain_fusion=rnd.random() < 0.7,
                     pallas_kernels="auto" if rnd.random() < 0.7
-                    else "0")
+                    else "0",
+                    megastep_sweeps="auto" if rnd.random() < 0.7
+                    else 4)
     g = wf.PipeGraph(f"meta_{family}_{wt}", mode, wf.TimePolicy.EVENT,
                      config=cfg)
     g.add_source(src).add(op).add_sink(snk)

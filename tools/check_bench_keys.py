@@ -98,6 +98,18 @@ honesty flag — CPU runs emulate the kernels (slower by design), so the
 speedup keys are only comparable across runs with the same flag
 (``check_bench_regress.py`` gates on it).  Guarded here identically.
 
+Since the megastep round the bench also publishes a ``megastep``
+section (``k``, ``e2e_tup_s``, ``speedup_vs_k1``,
+``dispatches_per_batch``, ``ratio_vs_kernel`` — docs/PERF.md round 15 /
+docs/OBSERVABILITY.md "Megastep in the ledger") from a dispatch-bound
+staged-e2e A/B of K folded sweeps vs the K=1 kill switch.  Two hard
+gates ride on it: ``e2e_tup_s`` must clear the section's own
+``e2e_floor_tup_s`` (CPU: 10x the r14 54.8k per-batch baseline), and
+``dispatches_per_batch`` must equal 1/k exactly over the scanned
+batches — any excess means the megastep grew extra device dispatches
+and the 1-program-per-K-sweeps contract broke.  Guarded here
+identically.
+
 Since the fusion round the bench also publishes a ``fusion`` section
 (``fused_chains``, ``dispatches_saved``, ``bytes_saved_per_batch`` —
 docs/PERF.md round 10) from the staged e2e run's sweep ledger: the
@@ -130,6 +142,8 @@ RESHARD_KEYS = ("plan_apply_ms", "rescale_restore_ms", "keys_moved",
                 "post_reshard_imbalance")
 PALLAS_KEYS = ("kernels_active", "ffat_step_speedup_vs_lax",
                "grouping_speedup", "interpret_mode", "record_mismatch")
+MEGASTEP_KEYS = ("k", "e2e_tup_s", "e2e_floor_tup_s", "speedup_vs_k1",
+                 "dispatches_per_batch", "ratio_vs_kernel")
 
 
 def fail(msg: str) -> None:
@@ -171,7 +185,10 @@ def check_source() -> None:
              "docs/OBSERVABILITY.md reshard-executor / "
              "docs/DURABILITY.md rescale-on-restore"),
             ("pallas", PALLAS_KEYS,
-             "Pallas kernels — docs/PERF.md round 14")):
+             "Pallas kernels — docs/PERF.md round 14"),
+            ("megastep", MEGASTEP_KEYS,
+             "megastep executor — docs/PERF.md round 15 / "
+             "docs/OBSERVABILITY.md megastep-in-the-ledger")):
         missing = [k for k in keys if f'"{k}"' not in src] \
             + ([] if f'"{section}"' in src else [section])
         if missing:
@@ -427,6 +444,39 @@ def check_output(path: str) -> None:
         # environmental failure mode — its absence IS the regression
         fail("bench pallas section absent or errored "
              f"(pallas_error={result.get('pallas_error')!r})")
+    msec = result.get("megastep")
+    if isinstance(msec, dict):
+        missing = [k for k in MEGASTEP_KEYS if k not in msec]
+        if missing:
+            fail(f"'megastep' section missing {missing} from bench "
+                 "output")
+        floor = msec.get("e2e_floor_tup_s") or 0
+        tps = msec.get("e2e_tup_s")
+        if isinstance(tps, (int, float)) and floor and tps < floor:
+            # the r15 acceptance floor: the K-folded staged e2e must
+            # hold 10x the r14 per-batch CPU baseline — falling under
+            # it means the megastep stopped scanning (check the
+            # fallback_batches count) or the driver loop regressed
+            fail(f"megastep e2e_tup_s={tps} under the "
+                 f"{floor} floor (docs/PERF.md round 15)")
+        kk, dpb = msec.get("k"), msec.get("dispatches_per_batch")
+        if isinstance(kk, int) and kk > 1:
+            if not isinstance(dpb, (int, float)):
+                fail("megastep ran with K>1 but dispatches_per_batch "
+                     "is absent — no batch was ever scanned (the "
+                     "plane downgraded or the warm check never passed)")
+            if abs(dpb * kk - 1.0) > 1e-6:
+                # the 1-program-per-K-sweeps contract, pinned by the
+                # jit registry's megastep.* dispatch count: over the
+                # scanned batches the ratio is 1/K EXACTLY — warmup
+                # and EOS-remainder batches are reported separately
+                fail(f"megastep dispatches_per_batch={dpb} != 1/{kk} — "
+                     "the folded program grew extra device dispatches")
+    else:
+        # the megastep leg is an in-process staged-e2e A/B with no
+        # environmental failure mode — its absence IS the regression
+        fail("bench megastep section absent or errored "
+             f"(megastep_error={result.get('megastep_error')!r})")
     ver = result.get("verify")
     if isinstance(ver, dict):
         missing = [k for k in VERIFY_KEYS if k not in ver]

@@ -113,6 +113,20 @@ CODES = {
                          "the lax path (unsupported backend, mesh "
                          "graph, or a generic combiner on the MXU "
                          "pane-combine path)"),
+    # Megastep executor (windflow_tpu/megastep.py, docs/PERF.md round
+    # 15): ``WF_TPU_MEGASTEP=K`` forces K staged sweeps folded into one
+    # compiled scan program, but the fold only exists for a
+    # single-dest device staging edge whose tail steps entirely on
+    # device — a host operator, a mesh-sharded or host-interning
+    # stateful tail, a compacted key space (host admission runs per
+    # batch), or a spec-less source keeps the per-batch cadence.
+    # Forcing makes that downgrade NAMED instead of silent — the
+    # WF606/WF607 contract applied to the megastep plane.  "auto"
+    # picks silently.
+    "WF608": ("warning", "megastep forced on but the edge downgraded "
+                         "to per-batch dispatch (host operator, mesh "
+                         "or host-interning tail, compacted key "
+                         "space, or spec-less source)"),
     # -- determinism for replay (WF61x, wfverify — analysis/tracecheck.py):
     #    kernels and callbacks of a durability-enabled graph must
     #    regenerate the committed prefix identically on replay

@@ -194,6 +194,7 @@ def check_graph(graph) -> List[Diagnostic]:
     _kernel_pass(graph, ops, edges, upstreams, diags)
     _wire_pass(graph, ops, edges, upstreams, diags)
     _pallas_pass(graph, ops, diags)
+    _megastep_pass(graph, ops, edges, upstreams, diags)
     _tracecheck_pass(graph, diags)
     return diags
 
@@ -251,6 +252,139 @@ def _pallas_pass(graph, ops, diags) -> None:
                 node=op.name,
                 hint="declare the combiner with withMonoidCombiner/"
                      "withSumCombiner if it is a leafwise monoid"))
+
+
+def _megastep_pass(graph, ops, edges, upstreams, diags) -> None:
+    """WF608: a FORCED megastep width (``WF_TPU_MEGASTEP=K`` /
+    ``Config.megastep_sweeps > 1``) names its downgrades instead of
+    taking them silently — the WF606/WF607 contract applied to the
+    megastep plane.  The fold only exists for a single-destination
+    host→device staging edge whose post-fusion tail steps entirely on
+    device (windflow_tpu/megastep.py ``tail_kind`` — the same
+    classifier ``attach_plane`` consults at build time, so preflight
+    and runtime can never disagree about a reason).  Named cases:
+
+    * a MESH graph (aligned per-shard ingest, collectives per batch);
+    * a multi-destination staging edge (keyed/round-robin fan-out);
+    * a host operator, host-interning stateful, compacted key space,
+      or parallel tail — ``tail_kind``'s reason verbatim;
+    * a spec-less source: packed signatures drift batch to batch, so
+      a K-group never assembles (declare withRecordSpec).
+
+    ``auto`` mode picks per backend silently and never warns; every
+    case above runs correctly at the per-batch (K=1) cadence."""
+    from windflow_tpu.fusion.executor import _is_stateless
+    from windflow_tpu.io.device_source import DeviceSource
+    from windflow_tpu.megastep import megastep_forced, tail_kind
+    from windflow_tpu.ops.sink import Sink
+
+    k = megastep_forced(graph.config)
+    if not k:
+        return
+    if graph.config.mesh is not None:
+        diags.append(Diagnostic(
+            "WF608",
+            f"WF_TPU_MEGASTEP={k} forced on a mesh graph: staging is "
+            "per-shard aligned ingest with collectives every batch, so "
+            "every edge keeps the per-batch (K=1) cadence",
+            hint="single-chip graphs take the fold; scanning sharded "
+                 "programs is a future round (docs/PERF.md round 15)"))
+        return
+
+    down: Dict[int, list] = {}
+    roots = []
+    for edge in edges:
+        if edge[0] == "op":
+            _, a, b = edge
+            down.setdefault(id(a), []).append(b)
+        else:
+            _, mp = edge
+            src = mp.operators[-1]
+            for child in mp.split_children:
+                if child.operators:
+                    down.setdefault(id(src), []).append(
+                        child.operators[0])
+    for op in ops:
+        ups = upstreams.get(id(op))
+        if (ups is None or not ups[1]) and down.get(id(op)):
+            roots.append(op)
+
+    def warn(src, reason: str, node=None) -> None:
+        diags.append(Diagnostic(
+            "WF608",
+            f"WF_TPU_MEGASTEP={k} forced but the staging edge from "
+            f"'{src.name}' keeps per-batch dispatch: {reason}",
+            node=node,
+            hint="the downgrade is correctness-neutral (the per-batch "
+                 "path is the reference semantics); unset "
+                 "WF_TPU_MEGASTEP or restructure the edge to a "
+                 "single-destination device tail (docs/PERF.md round "
+                 "15)"))
+
+    for src in roots:
+        if getattr(src, "record_spec", None) is None and not (
+                isinstance(src, DeviceSource)
+                and src.batch_fn is not None):
+            warn(src, "the source declares/infers no record spec, so "
+                      "packed batch signatures can drift and a K-group "
+                      "never assembles (declare withRecordSpec)",
+                 node=src.name)
+            continue
+        tail = src
+        while True:
+            dests = down.get(id(tail), [])
+            if len(dests) != 1:
+                warn(src, "multi-destination staging edge "
+                          "(keyed/round-robin fan-out ships per batch)",
+                     node=tail.name)
+                tail = None
+                break
+            tail = dests[0]
+            if not (_is_stateless(tail) and getattr(tail, "is_tpu",
+                                                    False)):
+                break
+        if tail is None or isinstance(tail, Sink):
+            # an all-stateless run ending at the sink has no stateful
+            # step to carry — tail_kind's fused-segment reason applies,
+            # but only once the chain actually fused; stay quiet here
+            continue
+        if getattr(tail, "parallelism", 1) != 1 \
+                and not isinstance(tail, _ffat_type()):
+            warn(src, "parallel tail (per-replica state shards the "
+                      "scan carry)", node=tail.name)
+            continue
+        if _will_compact(graph.config, tail):
+            # the compactor only attaches at build time (parallel/
+            # compaction.attach_compaction), so tail_kind cannot see it
+            # on an unstarted graph — predict it from the same criteria
+            warn(src, "compacted key space (host admission runs per "
+                      "batch; Config.key_compaction=False folds this "
+                      "edge)", node=tail.name)
+            continue
+        kind, reason = tail_kind(tail)
+        if kind is None:
+            warn(src, reason, node=tail.name)
+
+
+def _ffat_type():
+    from windflow_tpu.windows.ffat_tpu import FfatWindowsTPU
+    return FfatWindowsTPU
+
+
+def _will_compact(config, op) -> bool:
+    """Predict whether ``attach_compaction`` will hang a KeyCompactor on
+    ``op`` at build time — the single-chip criteria of
+    ``parallel/compaction.attach_compaction`` restated over the
+    unstarted graph (mesh graphs never reach here: the megastep pass
+    returns on them first)."""
+    if not getattr(config, "key_compaction", True):
+        return False
+    from windflow_tpu.ops.tpu import ReduceTPU
+    if isinstance(op, ReduceTPU):
+        return op.key_extractor is not None and op.monoid is not None
+    if isinstance(op, _ffat_type()):
+        return op.key_extractor is not None and op.max_keys is None
+    return False
 
 
 def _wire_pass(graph, ops, edges, upstreams, diags) -> None:

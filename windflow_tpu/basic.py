@@ -324,6 +324,26 @@ class Config:
     # switch restoring the lax path verbatim (no kernel builds, one
     # resolve per program build).
     pallas_kernels: object = os.environ.get("WF_TPU_PALLAS", "auto")
+    # Device-resident sweep megastep (windflow_tpu/megastep.py,
+    # docs/PERF.md round 15): fold K consecutive batch sweeps of a
+    # host→TPU staged edge into ONE wf_jit program — a lax.scan over a
+    # super-batch of K packed wire buffers whose body is the existing
+    # fused per-sweep program (unpack decode + prelude + tail step), so
+    # the host pacer pays one dispatch, one H2D stack, and one D2H
+    # drain per K batches instead of per batch.  The fusion executor's
+    # move lifted one level: per-sweep → per-K-sweeps.  Only edges whose
+    # staging emitter feeds a single megastep-capable tail qualify
+    # (FFAT windows, keyed/dense reduce, dense-key stateful — all
+    # non-mesh, non-compacted); everything else keeps the per-batch
+    # cadence.  Default "auto": K=8 on real accelerator backends, K=1
+    # on the CPU fallback (tier-1 cadence unchanged).  An explicit
+    # integer forces that K anywhere (bench/tests set it directly);
+    # graphs that cannot honor a forced K>1 downgrade to per-batch with
+    # a WF608 preflight warning.  =1 is the kill switch: no plane
+    # attaches and the per-batch path runs verbatim.  Durability epochs
+    # round UP to a multiple of K (quiesce lands only on megastep
+    # boundaries, keeping the chaos A/B diff meaningful).
+    megastep_sweeps: object = os.environ.get("WF_TPU_MEGASTEP", "auto")
     # Key-aligned mesh ingest (parallel/emitters.AlignedMeshStageEmitter
     # + mesh.py ingest="aligned", docs/OBSERVABILITY.md "Wire plane"):
     # host-fed key-sharded FFAT consumers take their batches PRE-PLACED

@@ -229,6 +229,43 @@ _words = staging.lane_words
 _packable_dtype = staging.packable_dtype
 
 
+def unpack_body(dtypes, capacity: int, wire=None):
+    """The raw (un-jitted) unpack closure behind :func:`_get_unpack`:
+    ``b -> (payload_cols, ts, valid, n_valid)``.  Exposed separately so
+    the megastep executor (windflow_tpu/megastep.py) can inline the
+    SAME decode — wire decompression included — into its K-sweep scan
+    body instead of paying one unpack dispatch per batch."""
+    if wire is not None:
+        from windflow_tpu.wire import build_wire_decode
+        decode = build_wire_decode(wire, dtypes, capacity)
+
+        def unpack_fn(b):
+            cols = decode(b)
+            n_valid = b[-1].astype(jnp.int32)
+            return cols[:-1], cols[-1], \
+                jnp.arange(capacity, dtype=jnp.int32) < n_valid, \
+                n_valid
+    else:
+        def unpack_fn(b):
+            cols, off = [], 0
+            for dt in dtypes + ("int64",):
+                d = np.dtype(dt)
+                if d.itemsize == 8:
+                    seg = b[off:off + 2 * capacity]
+                    lo = seg[0::2].astype(jnp.int64)
+                    hi = seg[1::2].astype(jnp.int64)
+                    cols.append(((hi << 32) | lo).astype(d))
+                    off += 2 * capacity
+                else:
+                    cols.append(jax.lax.bitcast_convert_type(
+                        b[off:off + capacity], d))
+                    off += capacity
+            n_valid = b[-1].astype(jnp.int32)
+            return cols[:-1], cols[-1], \
+                jnp.arange(capacity, dtype=jnp.int32) < n_valid, n_valid
+    return unpack_fn
+
+
 def _get_unpack(treedef, dtypes, capacity: int, wire=None):
     """Cached device program re-typing one packed uint32 staging buffer
     into payload columns + ts lane + validity mask (derived on device from
@@ -248,35 +285,8 @@ def _get_unpack(treedef, dtypes, capacity: int, wire=None):
     key = (treedef, dtypes, capacity, wire)
     unpack = _UNPACK_CACHE.get(key)
     if unpack is None:
-        if wire is not None:
-            from windflow_tpu.wire import build_wire_decode
-            decode = build_wire_decode(wire, dtypes, capacity)
-
-            def unpack_fn(b):
-                cols = decode(b)
-                n_valid = b[-1].astype(jnp.int32)
-                return cols[:-1], cols[-1], \
-                    jnp.arange(capacity, dtype=jnp.int32) < n_valid, \
-                    n_valid
-        else:
-            def unpack_fn(b):
-                cols, off = [], 0
-                for dt in dtypes + ("int64",):
-                    d = np.dtype(dt)
-                    if d.itemsize == 8:
-                        seg = b[off:off + 2 * capacity]
-                        lo = seg[0::2].astype(jnp.int64)
-                        hi = seg[1::2].astype(jnp.int64)
-                        cols.append(((hi << 32) | lo).astype(d))
-                        off += 2 * capacity
-                    else:
-                        cols.append(jax.lax.bitcast_convert_type(
-                            b[off:off + capacity], d))
-                        off += capacity
-                n_valid = b[-1].astype(jnp.int32)
-                return cols[:-1], cols[-1], \
-                    jnp.arange(capacity, dtype=jnp.int32) < n_valid, n_valid
-        unpack = wf_jit(unpack_fn, op_name="staging.unpack")
+        unpack = wf_jit(unpack_body(dtypes, capacity, wire=wire),
+                        op_name="staging.unpack")
         _UNPACK_CACHE[key] = unpack
     return unpack
 
@@ -478,9 +488,12 @@ def _np_local(a):
 def _egress_packable(batch: DeviceBatch):
     leaves, treedef = jax.tree.flatten(batch.payload)
     cap = batch.capacity
+    # numpy-leaf batches (the megastep drain's zero-copy per-batch
+    # slices) must take the host fallback: device-packing them would
+    # round-trip already-host-resident lanes through HBM
     ok = all(getattr(l, "ndim", 0) == 1 and l.shape[0] == cap
              and (_packable_dtype(l.dtype) or l.dtype == jnp.bool_)
-             and (not isinstance(l, jax.Array) or l.is_fully_addressable)
+             and isinstance(l, jax.Array) and l.is_fully_addressable
              for l in leaves)
     return ok, leaves, treedef, cap
 

@@ -123,6 +123,12 @@ class PipeGraph:
         # observe-only planes, this one mutates routing); None leaves
         # one `is not None` check per sweep + one per source tick chunk
         self._reshard = None
+        # megastep plane (windflow_tpu/megastep.py): K batch sweeps per
+        # compiled program on the eligible staged edges, built in _build
+        # when Config.megastep_sweeps resolves to K>1; None/inactive
+        # leaves the per-batch cadence verbatim (one check per finalize
+        # on the staging emitters, nothing anywhere else)
+        self._megastep_plane = None
         # checkpoint blobs stashed by restore() for the plane to apply
         # after _build (operator state) and before the first source tick
         self._pending_restore = None
@@ -462,6 +468,20 @@ class PipeGraph:
         if wire_enabled(cfg):
             attach_wire(self)
 
+        # 3f''. megastep plane (windflow_tpu/megastep.py): hook the
+        # eligible staged edges so K consecutive batch sweeps fold into
+        # ONE lax.scan dispatch — built AFTER fusion (the tail may be a
+        # fused segment host) and the wire plane (the scan body inlines
+        # the same wire decode the per-batch unpack runs), before
+        # anything stages.  The durability epoch cadence converts here
+        # from logical sweeps to K-granular driver sweeps (whole
+        # megasteps), so every commit's quiesce lands between megasteps
+        # and each epoch covers the stream extent it covered per-batch.
+        from windflow_tpu.megastep import (attach_plane,
+                                           round_epoch_to_megastep)
+        self._megastep_plane = attach_plane(cfg, self._source_replicas)
+        round_epoch_to_megastep(cfg, self._megastep_plane)
+
         # 3g. reshard executor (windflow_tpu/serving): built LAST — it
         # discovers the keyed emitters the wiring installed, reads the
         # health plane and shard ledger at tick cadence, and mutates
@@ -735,7 +755,12 @@ class PipeGraph:
             # epoch cadence (windflow_tpu/durability): counts sweeps and,
             # every Config.durability_epoch_sweeps-th, quiesces to the
             # aligned barrier and commits a checkpoint epoch.  Off-path
-            # cost is exactly this one check (micro-asserted).
+            # cost is exactly this one check (micro-asserted).  Under an
+            # active megastep plane one driver sweep covers K logical
+            # batch sweeps and this call site sits BETWEEN driver
+            # sweeps, so every quiesce already lands between megasteps;
+            # round_epoch_to_megastep converted the configured cadence
+            # to driver sweeps at build.
             self._durability.on_sweep()
         if self._reshard is not None:
             # executor cadence (windflow_tpu/serving): one counter
@@ -747,6 +772,13 @@ class PipeGraph:
     def _tick_chunk(self, sr) -> int:
         chunk = self.config.source_tick_chunk \
             or sr.op.output_batch_size or 256
+        plane = self._megastep_plane
+        if plane is not None and plane.active \
+                and getattr(sr.emitter, "_megastep", None) is not None:
+            # K-granular pacing: pull K batches' worth per tick so the
+            # staging emitter fills a whole megastep group each sweep
+            # instead of parking K-1 sweeps' batches in the queue
+            chunk *= plane.k
         if self._reshard is not None:
             # admission control (docs/OBSERVABILITY.md "Reshard
             # executor"): when no plan can help a degraded operator,
@@ -1140,6 +1172,12 @@ class PipeGraph:
             # edges, mesh ICI model — the measurement layer the reshard
             # advisor (tools/wf_shard.py) plans against
             "Shard": self._shard_section(),
+            # megastep plane (windflow_tpu/megastep.py): resolved K and
+            # per-edge megastep/fallback counters — docs/OBSERVABILITY.md
+            # "Megastep in the ledger"
+            "Megastep": (self._megastep_plane.summary()
+                         if self._megastep_plane is not None
+                         else {"k": 1, "edges": []}),
             # durability plane (windflow_tpu/durability): epochs
             # committed, checkpoint/restore wall cost + bytes, sink
             # fence dedupe hits — docs/DURABILITY.md

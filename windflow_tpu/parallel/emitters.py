@@ -410,6 +410,39 @@ class BroadcastEmitter(Emitter):
             self._send(d, hb)
 
 
+class _StagedPacket:
+    """One finalized packed batch, pre-``stage_packed``: everything the
+    per-batch ship stamps, captured at finalize time so the megastep
+    plane (windflow_tpu/megastep.py) can queue K of them and either
+    fold them into one scan dispatch or replay the verbatim per-batch
+    ship (``_ship_packed``) in FIFO order.  ``nbytes`` is the WIRE
+    buffer's size at finalize (the H2D ledger credit); ``wm_pane`` is
+    filled in by the megastep edge for time-based window tails."""
+
+    __slots__ = ("buf", "fmt", "wm", "frontier", "ts_min", "ts_max",
+                 "n", "trace", "nbytes", "logical_nbytes", "pool",
+                 "treedef", "dtypes", "capacity", "wm_pane")
+
+    def __init__(self, buf, fmt, wm, frontier, ts_min, ts_max, n,
+                 trace, logical_nbytes, pool, treedef, dtypes,
+                 capacity):
+        self.buf = buf
+        self.fmt = fmt
+        self.wm = wm
+        self.frontier = frontier
+        self.ts_min = ts_min
+        self.ts_max = ts_max
+        self.n = n
+        self.trace = trace
+        self.nbytes = buf.nbytes
+        self.logical_nbytes = logical_nbytes
+        self.pool = pool
+        self.treedef = treedef
+        self.dtypes = dtypes
+        self.capacity = capacity
+        self.wm_pane = None
+
+
 class DeviceStageEmitter(Emitter):
     """Host→TPU boundary (reference CPU→GPU ``Forward_Emitter_GPU`` /
     ``KeyBy_Emitter_GPU`` staging paths): accumulates host records, stages one
@@ -480,6 +513,14 @@ class DeviceStageEmitter(Emitter):
         self._wire_on = False
         self._wire_reseed = 64
         self._wire_encoders = {}
+        # megastep plane (windflow_tpu/megastep.py): attached by
+        # PipeGraph._build when this edge feeds an eligible device tail
+        # and Config.megastep_sweeps resolves to K>1 — finalized packed
+        # batches are OFFERED to the edge, which folds K of them into
+        # one lax.scan dispatch.  None (the K=1 kill switch and every
+        # ineligible edge) leaves exactly one check per finalize and
+        # the verbatim per-batch ship below.
+        self._megastep = None
         # Multi-chip: lay staged batch lanes out data-sharded over the mesh
         # so downstream sharded programs consume them without a reshard
         # (parallel/mesh.py batch_sharding).
@@ -549,7 +590,10 @@ class DeviceStageEmitter(Emitter):
         self._advance_frontier(wm)
         self._ob.add(item, ts, wm)
         if len(self._ob.items) >= self._local_cap:
-            self.flush(wm)
+            # capacity flush: INTERNAL, so a megastep edge keeps
+            # accumulating record-path batches (flush() below is the
+            # external entry point that drains the megastep queue)
+            self._flush_impl(wm)
 
     def emit_columns(self, cols, tss, wm, row_wms=None):
         """Columnar fast path.  Single-chip packable columns take the
@@ -643,12 +687,30 @@ class DeviceStageEmitter(Emitter):
             # inflating bytes-derived ratios (wire-round honesty fix)
             self.stats.h2d_bytes += buf.nbytes
             self.stats.h2d_logical_bytes += logical_nbytes
-        db = stage_packed(buf, self._b_treedef, self._b_dtypes,
-                          b.capacity, b.n, watermark=wm, device=None,
-                          frontier=self._frontier,
-                          ts_max=self._b_ts_max, ts_min=self._b_ts_min,
-                          pool=b.pool, trace=self._new_trace(flightrec.STAGED),
-                          wire=fmt, logical_nbytes=logical_nbytes)
+        pkt = _StagedPacket(buf, fmt, wm, self._frontier,
+                            self._b_ts_min, self._b_ts_max, b.n,
+                            self._new_trace(flightrec.STAGED),
+                            logical_nbytes, b.pool, self._b_treedef,
+                            self._b_dtypes, b.capacity)
+        ms = self._megastep
+        if ms is not None and ms.offer(pkt):
+            return
+        self._ship_packed(pkt)
+
+    def _ship_packed(self, pkt: "_StagedPacket") -> None:
+        """The verbatim per-batch ship of one finalized packed batch —
+        the K=1 path, the megastep warm-up/fallback path, and
+        ``MegastepEdge.drain_remainder``'s partial-group path.  Stamps
+        come from the PACKET (captured at finalize), not the emitter:
+        a queued batch shipped later must not borrow a frontier that
+        advanced past it."""
+        db = stage_packed(pkt.buf, pkt.treedef, pkt.dtypes,
+                          pkt.capacity, pkt.n, watermark=pkt.wm,
+                          device=None, frontier=pkt.frontier,
+                          ts_max=pkt.ts_max, ts_min=pkt.ts_min,
+                          pool=pkt.pool, trace=pkt.trace,
+                          wire=pkt.fmt,
+                          logical_nbytes=pkt.logical_nbytes)
         d = self._next
         self._next = (self._next + 1) % len(self.dests)
         self._send(d, db)
@@ -701,6 +763,16 @@ class DeviceStageEmitter(Emitter):
         self._send(d, db)
 
     def flush(self, wm):
+        """EXTERNAL flush (EOS, punctuation cadence, durability
+        quiesce): ship everything open, then drain any megastep queue
+        per-batch — a checkpoint or a propagated watermark must never
+        overtake packed batches parked for a future megastep."""
+        self._flush_impl(wm)
+        ms = self._megastep
+        if ms is not None:
+            ms.drain_remainder()
+
+    def _flush_impl(self, wm):
         if self._builder is not None:
             self._finalize_builder(fallback_wm=wm)
         if self._col_chunks:
