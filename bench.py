@@ -1057,6 +1057,89 @@ def run_bench_megastep(platform: str, cfg: dict, jax,
     }
 
 
+def run_bench_latency_slo(platform: str, cfg: dict, jax,
+                          kernel_tps: float = 0.0) -> dict:
+    """Latency-mode leg (windflow_tpu/monitoring/latency_ledger.py,
+    guarded by tools/check_bench_keys.py + check_bench_regress.py): a
+    representative source→map→window→sink pipeline driven unthrottled —
+    the p99 this records is the tail AT max sustainable throughput, the
+    operating point named in the row — with the flight recorder and
+    latency ledger ON and a declared SLO budget.  Reports the
+    ledger-decomposed staged→sunk p50/p99, the dominant (operator,
+    segment) pair, per-segment shares, and the SLO verdict state.
+    check_bench_keys hard-fails the shipped shape when the measured p99
+    exceeds 2x the recorded budget — the bench pipelines must run
+    inside their own declared SLO with margin."""
+    import dataclasses
+
+    import numpy as np
+    import windflow_tpu as wf
+
+    budget_ms = float(os.environ.get("BENCH_SLO_MS", "1000"))
+    # many-batch shape (the e2e cap would make the whole CPU run ONE
+    # staged batch — nothing to decompose): 64 batches of 4k tuples
+    slo_cfg = dict(cfg, cap=4096, keys=64, win=256, slide=64)
+    CAP, K = slo_cfg["cap"], slo_cfg["keys"]
+    n = int(os.environ.get("BENCH_SLO_TUPLES", str(64 * CAP)))
+    # aggressive sampling (1-in-2 vs the production 1-in-64) so a
+    # CI-sized run decomposes enough traces for an honest p99
+    config = dataclasses.replace(
+        wf.default_config, flight_recorder=True, trace_sample_every=2,
+        latency_ledger=True, latency_slo_ms=budget_ms)
+    src = (wf.Source_Builder(
+        lambda: iter({"key": i % K, "v0": float(i)} for i in range(n)))
+        .withOutputBatchSize(CAP)
+        .withRecordSpec({"key": np.int32(0), "v0": np.float32(0.0)})
+        .withName("slo_src").build())
+    m = (wf.MapTPU_Builder(
+        lambda t: {"key": t["key"], "v0": t["v0"] * 1.5 + 1.0})
+        .withName("slo_map").build())
+    w = (wf.Ffat_WindowsTPU_Builder(lambda t: t["v0"], lambda a, b: a + b)
+         .withCBWindows(slo_cfg["win"], slo_cfg["slide"])
+         .withKeyBy(lambda t: t["key"]).withMaxKeys(K)
+         .withName("slo_win").build())
+    snk = wf.Sink_Builder(lambda r: None).withName("slo_snk").build()
+    g = wf.PipeGraph("bench_latency_slo", wf.ExecutionMode.DEFAULT,
+                     wf.TimePolicy.INGRESS, config=config)
+    g.add_source(src).add(m).add(w).add_sink(snk)
+    t0 = time.perf_counter()
+    g.start()
+    while not g.is_done():
+        if not g.step():
+            break
+        g.health_tick()     # ledger tick every sweep: worst-case cadence
+    g.wait_end()
+    elapsed = time.perf_counter() - t0
+    g.health_tick()         # final harvest after the sink's EOS flush
+    lp = g.stats()["Latency_plane"]
+    e2e_q = lp.get("e2e_usec") or {}
+    segs = lp.get("segments_total_usec") or {}
+    total = sum(segs.values()) or 1.0
+    dom_op, dom_entry = None, {}
+    for name, entry in (lp.get("per_op") or {}).items():
+        if (entry.get("budget_share") or 0) >= \
+                (dom_entry.get("budget_share") or 0):
+            dom_op, dom_entry = name, entry
+    slo = lp.get("slo") or {}
+    return {
+        # the operating-point label check_bench_keys requires on every
+        # latency row: a p99 is meaningless without the rate it was
+        # measured at
+        "operating_point": "max_sustainable",
+        "tuples_per_sec": round(n / elapsed, 1) if elapsed else 0.0,
+        "slo_budget_ms": budget_ms,
+        "e2e_p50_ms": round((e2e_q.get("p50") or 0) / 1e3, 3),
+        "e2e_p99_ms": round((e2e_q.get("p99") or 0) / 1e3, 3),
+        "traces_decomposed": lp.get("traces_decomposed", 0),
+        "dominant_op": dom_op,
+        "dominant_segment": dom_entry.get("dominant_segment"),
+        "segment_share": {s: round(v / total, 4)
+                          for s, v in segs.items()},
+        "slo_active": bool(slo.get("active")),
+        "tuples": n,
+    }
+
+
 def scaling_step(jax, n: int, K: int, per_chip: int, seed: int = 2):
     """Build one width-``n`` rung of the weak-scaling sweep: the key-sharded
     mesh, the compiled keyed reduce, and its staged inputs.  Shared with the
@@ -1637,7 +1720,25 @@ def main() -> None:
     if result.get("e2e"):
         latency["e2e_p50_ms"] = result["e2e"].get("p50_window_latency_ms")
         latency["e2e_p99_ms"] = result["e2e"].get("p99_window_latency_ms")
+    # every latency row names its operating point (check_bench_keys
+    # rejects unlabeled rows): these numbers come from the default
+    # unthrottled e2e runs above
+    latency["operating_point"] = "default_e2e"
     result["latency"] = latency
+
+    # latency-SLO section (windflow_tpu/monitoring/latency_ledger.py,
+    # guarded by tools/check_bench_keys.py + check_bench_regress.py):
+    # the ledger-decomposed staged->sunk p99 at max sustainable
+    # throughput against a declared budget — check_bench_keys hard-fails
+    # p99 > 2x the recorded SLO, check_bench_regress tripwires the p99
+    # round over round
+    try:
+        result["latency_slo"] = run_bench_latency_slo(
+            platform, CONFIGS[platform], jax, kernel_tps=result["value"])
+    except Exception as e:  # lint: broad-except-ok (same stance as the
+        # other guarded legs: a latency-plane regression must fail
+        # check_bench_keys loudly, not kill the bench artifact)
+        result["latency_slo_error"] = f"{type(e).__name__}: {e}"[:400]
 
     # preflight cost (windflow_tpu/analysis, guarded by
     # tools/check_bench_keys.py): time PipeGraph.check() over the
@@ -1990,6 +2091,7 @@ def main() -> None:
                  "roofline": result.get("roofline"),
                  "fusion": result.get("fusion"),
                  "latency": result.get("latency"),
+                 "latency_slo": result.get("latency_slo"),
                  "preflight": result.get("preflight"),
                  "verify": result.get("verify"),
                  "device": result.get("device"),

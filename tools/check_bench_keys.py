@@ -110,6 +110,18 @@ batches — any excess means the megastep grew extra device dispatches
 and the 1-program-per-K-sweeps contract broke.  Guarded here
 identically.
 
+Since the latency-plane round the bench also publishes a
+``latency_slo`` section (``operating_point``, ``slo_budget_ms``,
+``e2e_p99_ms``, ``dominant_op``/``dominant_segment``,
+``segment_share`` — docs/OBSERVABILITY.md "Latency plane & SLO") from
+a flight-recorder-on pipeline driven at max sustainable throughput:
+the ledger-decomposed staged→sunk tail against a declared budget.
+Every latency row must carry its ``operating_point`` label — a p99
+without the rate it was measured at is not comparable round over
+round — and the measured ``e2e_p99_ms`` hard-fails past 2x the
+recorded ``slo_budget_ms``: the bench pipelines must run inside their
+own declared SLO with margin.  Guarded here identically.
+
 Since the fusion round the bench also publishes a ``fusion`` section
 (``fused_chains``, ``dispatches_saved``, ``bytes_saved_per_batch`` —
 docs/PERF.md round 10) from the staged e2e run's sweep ledger: the
@@ -125,7 +137,12 @@ import sys
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 KEYS = ("ratio_vs_kernel", "staging_share_of_staged_run")
-LATENCY_KEYS = ("batch_p99_ms", "e2e_p50_ms", "e2e_p99_ms")
+LATENCY_KEYS = ("batch_p99_ms", "e2e_p50_ms", "e2e_p99_ms",
+                "operating_point")
+LATENCY_SLO_KEYS = ("operating_point", "tuples_per_sec", "slo_budget_ms",
+                    "e2e_p50_ms", "e2e_p99_ms", "traces_decomposed",
+                    "dominant_op", "dominant_segment", "segment_share",
+                    "slo_active")
 ROOFLINE_KEYS = ("per_hop", "attributed_fraction")
 FUSION_KEYS = ("fused_chains", "dispatches_saved", "bytes_saved_per_batch")
 DEVICE_KEYS = ("compile_ms_total", "recompiles", "flops_per_batch")
@@ -160,6 +177,9 @@ def check_source() -> None:
              "decomposition contract (docs/PERF.md) is broken")
     for section, keys, contract in (
             ("latency", LATENCY_KEYS, "docs/OBSERVABILITY.md"),
+            ("latency_slo", LATENCY_SLO_KEYS,
+             "latency ledger — docs/OBSERVABILITY.md latency plane "
+             "& SLO"),
             ("roofline", ROOFLINE_KEYS,
              "sweep ledger — docs/OBSERVABILITY.md sweep-ledger"),
             ("fusion", FUSION_KEYS,
@@ -195,9 +215,10 @@ def check_source() -> None:
             fail(f"bench.py no longer emits the {section} section keys "
                  f"{missing} ({contract} contract)")
     print("check_bench_keys: OK (bench.py source emits "
-          + ", ".join(KEYS + ("latency", "preflight", "verify", "device",
-                              "health", "shard", "compaction", "fusion",
-                              "durability", "reshard", "pallas")) + ")")
+          + ", ".join(KEYS + ("latency", "latency_slo", "preflight",
+                              "verify", "device", "health", "shard",
+                              "compaction", "fusion", "durability",
+                              "reshard", "pallas")) + ")")
 
 
 def last_json_object(path: str):
@@ -250,6 +271,37 @@ def check_output(path: str) -> None:
         fail("'latency' section missing from bench output")
     if "batch_p99_ms" not in lat:
         fail("'latency.batch_p99_ms' missing from bench output")
+    if not lat.get("operating_point"):
+        # unlabeled latency rows are not comparable round over round:
+        # a p99 means nothing without the rate it was measured at
+        fail("'latency.operating_point' missing — latency rows must "
+             "name their operating point")
+    lslo = result.get("latency_slo")
+    if isinstance(lslo, dict):
+        missing = [k for k in LATENCY_SLO_KEYS if k not in lslo]
+        if missing:
+            fail(f"'latency_slo' section missing {missing} from bench "
+                 "output")
+        if not lslo.get("operating_point"):
+            fail("'latency_slo.operating_point' empty — latency rows "
+                 "must name their operating point")
+        budget = lslo.get("slo_budget_ms")
+        p99 = lslo.get("e2e_p99_ms")
+        if isinstance(budget, (int, float)) and budget > 0 \
+                and isinstance(p99, (int, float)) and p99 > 2 * budget:
+            # the shipped bench pipeline must run inside its own
+            # declared SLO with margin: a p99 past 2x the budget is a
+            # latency regression on the representative shape, not noise
+            fail(f"latency_slo e2e_p99_ms={p99} exceeds 2x the recorded "
+                 f"SLO budget ({budget} ms) on the shipped bench shape")
+        if not lslo.get("traces_decomposed"):
+            fail("latency_slo leg decomposed no traces — the ledger's "
+                 "harvest or the recorder's sampling broke")
+    else:
+        # the latency-SLO leg is an in-process flight-recorder run with
+        # no environmental failure mode — its absence IS the regression
+        fail("bench latency_slo section absent or errored "
+             f"(latency_slo_error={result.get('latency_slo_error')!r})")
     dev_sec = result.get("device")
     if isinstance(dev_sec, dict):
         missing = [k for k in DEVICE_KEYS if k not in dev_sec]

@@ -110,6 +110,13 @@ GUARDED = (
     # (record_mismatch, check_bench_keys).
     ("pallas.ffat_step_speedup_vs_lax", True, None),
     ("pallas.grouping_speedup", True, None),
+    # latency plane: the ledger-decomposed staged->sunk p99 at max
+    # sustainable throughput (docs/OBSERVABILITY.md "Latency plane &
+    # SLO") — LOWER is better.  A whole-pipeline wall tail on a shared
+    # box has no recorded dispersion of its own, so the trailing-history
+    # spread gate below is the honest noise floor; the hard bound (p99
+    # past 2x the recorded SLO budget) lives in check_bench_keys.
+    ("latency_slo.e2e_p99_ms", False, None),
     # megastep executor: the K-folded staged e2e rate is round 15's
     # headline (docs/PERF.md round 15) and the speedup over the K=1
     # kill switch is the claim the fold exists for — both gated on the
@@ -161,6 +168,14 @@ def comparable(cur: dict, prev: dict, path: str) -> bool:
         # are different experiments; only like compares with like
         return dig(cur, "pallas.interpret_mode") == \
             dig(prev, "pallas.interpret_mode")
+    if path.startswith("latency_slo."):
+        # the latency-SLO leg is sized via BENCH_SLO_TUPLES and its tail
+        # only compares at the SAME operating point: a different stream
+        # length or label measures a different experiment
+        return dig(cur, "latency_slo.tuples") == \
+            dig(prev, "latency_slo.tuples") \
+            and dig(cur, "latency_slo.operating_point") == \
+            dig(prev, "latency_slo.operating_point")
     if path.startswith("compaction."):
         # the compaction A/B is seeded per batch width (cfg["cap"]):
         # a different stream shape shifts the hot-set/overflow split

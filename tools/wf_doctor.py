@@ -33,7 +33,11 @@ import sys
 #: mirrors monitoring/health.py (kept literal: this file must not import
 #: the package — the package __init__ imports jax)
 SCHEMA = "wf-postmortem/1"
-STATES = ("OK", "BACKPRESSURED", "STALLED", "FAILED")
+STATES = ("OK", "SLO_VIOLATED", "BACKPRESSURED", "STALLED", "FAILED")
+#: mirrors monitoring/latency_ledger.py SEGMENTS
+LATENCY_SEGMENTS = ("staged_to_emitted", "emitted_to_dispatched",
+                    "dispatched_to_device_done",
+                    "device_done_to_collected", "collected_to_sunk")
 STAGE_NAMES = ("staged", "emitted", "dispatched", "device_done",
                "collected", "sunk")
 SECTIONS = ("stats.json", "events.json", "health.json", "device.json",
@@ -42,7 +46,7 @@ SECTIONS = ("stats.json", "events.json", "health.json", "device.json",
 #: must not reject a bundle written before they existed (same schema) —
 #: this tool's job is exactly the historical crash bundle
 OPTIONAL_SECTIONS = ("sweep.json", "durability.json", "shard.json",
-                     "reshard.json")
+                     "reshard.json", "latency.json")
 #: reshard executor timeline events (windflow_tpu/serving/executor.py)
 RESHARD_EVENTS = ("triggered", "move_keys", "split_hot_key", "admission",
                   "recovered", "scale_down", "move_skipped")
@@ -232,6 +236,64 @@ def validate(bundle: dict) -> None:
                     or e.get("event") not in RESHARD_EVENTS:
                 raise BundleError(
                     f"reshard.json: illegal timeline entry {e!r}")
+    latp = sections.get("latency.json") or {}
+    if latp.get("enabled") and "error" not in latp:
+        for key in ("traces_decomposed", "traces_dropped", "events_lost"):
+            v = latp.get(key)
+            if not isinstance(v, int) or v < 0:
+                raise BundleError(
+                    f"latency.json: {key!r} must be a non-negative "
+                    f"integer, got {v!r}")
+        segs = latp.get("segments_total_usec")
+        if not isinstance(segs, dict):
+            raise BundleError(
+                "latency.json: segments_total_usec must be an object")
+        for seg, v in segs.items():
+            if seg not in LATENCY_SEGMENTS:
+                raise BundleError(
+                    f"latency.json: unknown segment {seg!r} "
+                    f"(want one of {LATENCY_SEGMENTS})")
+            if not isinstance(v, (int, float)) or v < 0:
+                raise BundleError(
+                    f"latency.json: segment {seg!r} total {v!r} is not "
+                    "a non-negative number")
+        per_op = latp.get("per_op")
+        if not isinstance(per_op, dict):
+            raise BundleError("latency.json: per_op must be an object")
+        for op, entry in per_op.items():
+            if not isinstance(entry, dict):
+                raise BundleError(
+                    f"latency.json: operator {op!r} entry is not an "
+                    "object")
+            share = entry.get("budget_share")
+            if not isinstance(share, (int, float)) or not 0 <= share <= 1:
+                raise BundleError(
+                    f"latency.json: operator {op!r} budget_share "
+                    f"{share!r} is not in [0, 1]")
+            dom = entry.get("dominant_segment")
+            if dom is not None and dom not in LATENCY_SEGMENTS:
+                raise BundleError(
+                    f"latency.json: operator {op!r} dominant_segment "
+                    f"{dom!r} is not a known segment")
+            for seg in entry.get("segments_usec") or {}:
+                if seg not in LATENCY_SEGMENTS:
+                    raise BundleError(
+                        f"latency.json: operator {op!r} histogram "
+                        f"segment {seg!r} is not a known segment")
+        slo = latp.get("slo") or {}
+        verdict = slo.get("verdict")
+        if verdict is not None:
+            if not isinstance(verdict, dict) \
+                    or verdict.get("state") != "SLO_VIOLATED":
+                raise BundleError(
+                    f"latency.json: slo.verdict {verdict!r} must be an "
+                    "object with state SLO_VIOLATED")
+            if verdict.get("dominant_op") is not None \
+                    and verdict["dominant_op"] not in per_op:
+                raise BundleError(
+                    f"latency.json: slo.verdict attributes "
+                    f"{verdict['dominant_op']!r} but that operator has "
+                    "no per_op entry")
 
 
 def diagnose(bundle: dict) -> dict:
@@ -288,6 +350,32 @@ def diagnose(bundle: dict) -> dict:
             "dedupe_hits": dur.get("dedupe_hits"),
             "dir": dur.get("dir"),
         }
+    latp = sections.get("latency.json") or {}
+    latency = None
+    if latp.get("enabled") and "error" not in latp:
+        ranked = sorted((latp.get("per_op") or {}).items(),
+                        key=lambda kv: kv[1].get("budget_share") or 0,
+                        reverse=True)
+        top = None
+        if ranked:
+            name, entry = ranked[0]
+            top = {"op": name,
+                   "budget_share": entry.get("budget_share"),
+                   "dominant_segment": entry.get("dominant_segment"),
+                   "megastep_k": entry.get("megastep_k"),
+                   "freshness_floor_usec":
+                       entry.get("freshness_floor_usec")}
+        slo = latp.get("slo") or {}
+        latency = {
+            "traces_decomposed": latp.get("traces_decomposed"),
+            "traces_dropped": latp.get("traces_dropped"),
+            "events_lost": latp.get("events_lost"),
+            "e2e_p99_usec": (latp.get("e2e_usec") or {}).get("p99"),
+            "top_op": top,
+            "slo_budget_ms": slo.get("budget_ms"),
+            "slo_active": slo.get("active"),
+            "slo_verdict": slo.get("verdict") or slo.get("last_verdict"),
+        }
     rsh = sections.get("reshard.json") or {}
     reshard = None
     if rsh.get("enabled") and "error" not in rsh:
@@ -306,6 +394,7 @@ def diagnose(bundle: dict) -> dict:
         "app": manifest.get("app"),
         "reason": manifest.get("reason"),
         "durability": durability,
+        "latency": latency,
         "reshard": reshard,
         "written_at_usec": manifest.get("written_at_usec"),
         "graph_state": health.get("graph_state"),
@@ -419,6 +508,33 @@ def render_text(d: dict) -> str:
                    "store"
                    if du["restored_epoch"] is not None else
                    "restartable with PipeGraph.restore() on this store"))
+    if d.get("latency"):
+        la = d["latency"]
+        n = lambda v: "?" if v is None else v
+        lines.append(
+            f"  latency: {n(la['traces_decomposed'])} trace(s) "
+            f"decomposed (dropped={n(la['traces_dropped'])}, "
+            f"ring events lost={n(la['events_lost'])}), "
+            f"e2e p99 {n(la['e2e_p99_usec'])} µs")
+        if la.get("top_op"):
+            t = la["top_op"]
+            share = t.get("budget_share")
+            lines.append(
+                f"    hottest op '{t['op']}' carries "
+                f"{'?' if share is None else f'{share:.0%}'} of the "
+                f"critical path, dominated by {n(t['dominant_segment'])}"
+                + (f" (megastep K={t['megastep_k']}, freshness floor "
+                   f"{n(t['freshness_floor_usec'])} µs)"
+                   if t.get("megastep_k") else ""))
+        if la.get("slo_budget_ms"):
+            v = la.get("slo_verdict") or {}
+            lines.append(
+                f"    SLO budget {la['slo_budget_ms']} ms — "
+                + ("VIOLATED: " + v.get("message", "?")
+                   if la.get("slo_active")
+                   else "within budget"
+                   + (f" (last violation: {v.get('message')})"
+                      if v else "")))
     if d.get("reshard"):
         r = d["reshard"]
         lines.append(

@@ -1,0 +1,150 @@
+#!/usr/bin/env python
+"""wf_slo: rank latency budget share and emit an adaptive-sizing plan.
+
+CLI face of the latency advisor (windflow_tpu/analysis/latency.py),
+mirroring ``tools/wf_shard.py``: point it at a stats dump carrying a
+``Latency_plane`` section (a ``dump_stats`` JSON, a postmortem
+``stats.json`` / ``latency.json``, or a bare section file) and get
+every operator ranked by its share of the decomposed critical path,
+the dominant segment behind that share, and the concrete per-operator
+``megastep_sweeps``/tick-chunk overrides the PR-18 adaptive sizer
+implements (``plan(...)`` is that executor's contract, exactly as
+``wf_shard.plan`` was the reshard executor's).
+
+Usage::
+
+    python tools/wf_slo.py --stats DUMP          # rank + plan
+    python tools/wf_slo.py ... --json            # machine-readable
+    python tools/wf_slo.py ... --top N           # worst N ops only
+    python tools/wf_slo.py --check --stats DUMP  # SLO gate: exit 1
+        # while the dump's latched SLO_VIOLATED verdict is active
+
+This tool never imports jax (the ``wf_metrics``/``wf_doctor``
+scrape-host stance — the advisor module is loaded file-direct, skipping
+the package __init__).  Exit status: 0 when the plan has at least one
+action (or --check passes), 1 when there is nothing to do (or --check
+finds the SLO violated), 2 on usage/load failures.
+"""
+
+from __future__ import annotations
+
+import argparse
+import importlib.util
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def _load_advisor():
+    """File-direct import of analysis/latency.py (pure stdlib): skips
+    the ``windflow_tpu`` package __init__, which imports jax."""
+    path = os.path.join(REPO, "windflow_tpu", "analysis", "latency.py")
+    spec = importlib.util.spec_from_file_location("_wf_latency", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def fail(msg: str) -> None:
+    print(f"wf_slo: FAIL: {msg}", file=sys.stderr)
+    sys.exit(2)
+
+
+def load_latency_section(path: str) -> dict:
+    """The ``Latency_plane`` section out of a stats dump / postmortem
+    stats.json / bare latency.json file."""
+    try:
+        with open(path) as f:
+            obj = json.load(f)
+    except (OSError, ValueError) as e:
+        fail(f"cannot read stats dump '{path}': {e}")
+    if isinstance(obj, dict) and "segments_total_usec" in obj:
+        return obj                     # bare latency.json section
+    lat = (obj or {}).get("Latency_plane")
+    if not isinstance(lat, dict) or not lat.get("enabled"):
+        fail(f"'{path}' carries no enabled 'Latency_plane' section — "
+             "run the graph with Config.flight_recorder and "
+             "Config.latency_ledger on and dump_stats first")
+    return lat
+
+
+def render_text(p: dict) -> str:
+    budget = p.get("slo_budget_ms")
+    head = (f"e2e p99 {p['e2e_p99_ms']} ms vs budget {budget} ms "
+            f"({'OVER' if p['over_budget'] else 'within'})"
+            if budget else
+            f"e2e p99 {p['e2e_p99_ms']} ms (no SLO declared)")
+    lines = [f"wf_slo: graph '{p.get('graph') or '?'}' — {head}; "
+             f"{p['actionable']} operator(s) with actions"]
+    v = p.get("verdict")
+    if v:
+        tag = "ACTIVE" if p.get("slo_active") else "last"
+        lines.append(f"  verdict ({tag}): {v.get('message')}")
+    for i, o in enumerate(p["ops"], 1):
+        share = o.get("budget_share")
+        lines.append(
+            f"  #{i} {o['op']}: "
+            f"{'?' if share is None else f'{share:.0%}'} of the "
+            f"critical path, dominant {o.get('dominant_segment') or '?'}"
+            + (f", megastep K={o['megastep_k']}"
+               + (f" (freshness floor "
+                  f"{o['freshness_floor_usec']} µs)"
+                  if o.get("freshness_floor_usec") is not None else "")
+               if o.get("megastep_k") else ""))
+        for a in o["actions"]:
+            if a["kind"] in ("set_megastep_sweeps",
+                             "regrow_megastep_sweeps"):
+                lines.append(
+                    f"      PLAN {a['kind']} {a['from_k']}→"
+                    f"{a['recommended_k']} — {a['note']}")
+            elif a["kind"] == "shrink_tick_chunk":
+                lines.append(
+                    f"      PLAN shrink_tick_chunk /"
+                    f"{a['shrink_factor']} — {a['note']}")
+        if not o["actions"]:
+            lines.append("      (no action)")
+    if not p["ops"]:
+        lines.append("  (no decomposed traces yet — is the flight "
+                     "recorder sampling and the graph running?)")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--stats", metavar="DUMP", required=True,
+                    help="stats JSON with a Latency_plane section "
+                         "(dump_stats output, postmortem stats.json, "
+                         "or a bare latency.json)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the ranked plan as JSON")
+    ap.add_argument("--top", type=int, default=0,
+                    help="emit only the worst N operators")
+    ap.add_argument("--check", action="store_true",
+                    help="SLO gate: exit 1 while the dump's latched "
+                         "violation verdict is active")
+    args = ap.parse_args(argv)
+
+    lat = load_latency_section(args.stats)
+    adv = _load_advisor()
+    p = adv.plan(lat, top=args.top)
+    if args.check:
+        if p["slo_active"]:
+            v = p.get("verdict") or {}
+            print(f"wf_slo: SLO VIOLATED — {v.get('message', '?')}")
+            return 1
+        print(f"wf_slo: OK — e2e p99 {p['e2e_p99_ms']} ms"
+              + (f" within budget {p['slo_budget_ms']} ms"
+                 if p.get("slo_budget_ms") else " (no SLO declared)"))
+        return 0
+    if args.json:
+        print(json.dumps(p, indent=2))
+    else:
+        print(render_text(p))
+    return 0 if p["actionable"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -234,6 +234,27 @@ class Config:
     # way.
     health_postmortem_on_crash: bool = bool(int(os.environ.get(
         "WF_TPU_HEALTH_POSTMORTEM", "1")))
+    # Latency ledger (monitoring/latency_ledger.py, docs/OBSERVABILITY.md
+    # "Latency plane & SLO"): per-batch critical-path decomposition of the
+    # flight recorder's span lane — each sampled batch's staged→emitted,
+    # emitted→dispatched (the megastep K-wait), dispatched→device_done,
+    # device_done→collected and collected→sunk segments land in
+    # per-operator per-segment log2 histograms, plus window-freshness
+    # gauges and the megastep freshness floor.  Harvested from the
+    # existing rings only at monitor/stats cadence — zero new hot-path
+    # work; off removes the plane entirely and every call site keeps one
+    # `is not None` check (micro-asserted by tests/test_latency_plane.py).
+    # Requires the flight recorder (off recorder -> no ledger).
+    latency_ledger: bool = bool(int(os.environ.get("WF_TPU_LATENCY", "1")))
+    # Declarative end-to-end latency target in milliseconds (0 = no SLO).
+    # When set, the ledger evaluates the recent staged→sunk p99 against
+    # the budget at watchdog cadence and the health plane raises an
+    # SLO_VIOLATED verdict attributed to the dominant segment of the
+    # dominant operator; analysis/latency.py + tools/wf_slo.py turn the
+    # measured decomposition into the per-operator megastep/tick-chunk
+    # plan the adaptive sizer consumes.
+    latency_slo_ms: float = float(os.environ.get("WF_TPU_LATENCY_SLO_MS",
+                                                 "0"))
     # Sweep ledger (monitoring/sweep_ledger.py, docs/OBSERVABILITY.md):
     # per-operator-hop attribution of jitted dispatches and XLA
     # cost-analysis HBM bytes per staged batch, donation-miss tripwires,

@@ -129,6 +129,12 @@ class PipeGraph:
         # leaves the per-batch cadence verbatim (one check per finalize
         # on the staging emitters, nothing anywhere else)
         self._megastep_plane = None
+        # latency ledger (monitoring/latency_ledger.py): per-batch
+        # critical-path decomposition + SLO verdicts, built in _build
+        # when Config.latency_ledger AND the flight recorder are on;
+        # None leaves one `is not None` check at each cadence/read site
+        # and binds nothing to any replica (micro-asserted)
+        self._latency = None
         # checkpoint blobs stashed by restore() for the plane to apply
         # after _build (operator state) and before the first source tick
         self._pending_restore = None
@@ -481,6 +487,29 @@ class PipeGraph:
                                            round_epoch_to_megastep)
         self._megastep_plane = attach_plane(cfg, self._source_replicas)
         round_epoch_to_megastep(cfg, self._megastep_plane)
+
+        # 3f'''. latency ledger (monitoring/latency_ledger.py): per-batch
+        # critical-path decomposition of the recorder's span lane + the
+        # SLO verdict state machine — built AFTER the recorder (it
+        # harvests the rings at cadence) and the megastep plane (the
+        # per-edge K and freshness floor feed the verdict/advisor).
+        # Window replicas get the ledger bound for the fire-freshness
+        # gauge at their existing sampled-sync site; everything else
+        # keeps `latency = None` (one check, micro-asserted).
+        if getattr(cfg, "latency_ledger", True) \
+                and self._recorder is not None:
+            from windflow_tpu.monitoring.latency_ledger import LatencyLedger
+            from windflow_tpu.windows.ffat_tpu import FfatWindowsTPU
+            self._latency = LatencyLedger(
+                self._recorder,
+                slo_ms=getattr(cfg, "latency_slo_ms", 0.0) or 0.0)
+            self._latency.megastep_plane = self._megastep_plane
+            for op in self._operators:
+                if isinstance(op, FfatWindowsTPU):
+                    for rep in op.replicas:
+                        rep.latency = self._latency
+            if self._health is not None:
+                self._health.latency = self._latency
 
         # 3g. reshard executor (windflow_tpu/serving): built LAST — it
         # discovers the keyed emitters the wiring installed, reads the
@@ -872,6 +901,16 @@ class PipeGraph:
         thread calls this on its cadence — and, like ``sample_gauges``,
         headless runs get the same tick from every ``stats()`` read.  With
         ``Config.health_watchdog`` off this is the whole cost: one check."""
+        if self._latency is not None:
+            # harvest + SLO evaluation BEFORE the watchdog samples, so
+            # the health verdicts read this tick's decomposition (with
+            # the ledger off this is the whole cost: one check)
+            try:
+                self._latency.tick()
+            except Exception:  # lint: broad-except-ok (a telemetry
+                # harvest must never take the watchdog down; the
+                # Latency_plane section surfaces the error on read)
+                pass
         if self._health is not None:
             self._health.sample()
 
@@ -883,6 +922,22 @@ class PipeGraph:
         except Exception as e:  # lint: broad-except-ok (same stance as
             # the device section: a watchdog read must never take the
             # pipeline or a stats dump down)
+            return {"enabled": True, "error": f"{type(e).__name__}: "
+                                              f"{e}"[:200]}
+
+    def _latency_plane_section(self) -> dict:
+        """Guarded like the health/device sections; with
+        ``Config.latency_ledger`` off this is the whole cost: one
+        check.  Harvests before reading so a headless ``stats()`` call
+        sees completed traces without a monitor thread."""
+        if self._latency is None:
+            return {"enabled": False}
+        try:
+            self._latency.harvest()
+            return self._latency.section()
+        except Exception as e:  # lint: broad-except-ok (a decomposition
+            # read must never take the pipeline or a stats dump down —
+            # same stance as every other plane section)
             return {"enabled": True, "error": f"{type(e).__name__}: "
                                               f"{e}"[:200]}
 
@@ -1154,6 +1209,11 @@ class PipeGraph:
                                       self._preflight_diags]),
             },
             "Latency": self._latency_section(),
+            # latency ledger (monitoring/latency_ledger.py): per-batch
+            # critical-path segment decomposition, window freshness,
+            # and the SLO verdict — the measurement layer the adaptive
+            # sizer (analysis/latency.py, tools/wf_slo.py) plans against
+            "Latency_plane": self._latency_plane_section(),
             "Gauges": self.gauges(),
             # health plane (monitoring/health.py): per-operator watchdog
             # verdicts, stall counters + attribution, verdict timeline
@@ -1289,6 +1349,7 @@ class PipeGraph:
         write("jit.json", jit_tables)
         write("sweep.json", self._sweep_section)
         write("shard.json", self._shard_section)
+        write("latency.json", self._latency_plane_section)
         write("durability.json", self._durability_section)
         write("reshard.json", self._reshard_section)
         write("preflight.json", lambda: {

@@ -192,6 +192,11 @@ class MegastepEdge:
         self.batches = 0            # logical batches served by scans
         self.fallback_batches = 0   # per-batch ships while warm
         self.warmup_batches = 0     # per-batch ships while cold
+        # per-packet event-time span accumulation (ts_max - ts_min of the
+        # staged lanes): the measured basis of the K x batch-span
+        # freshness floor the latency ledger surfaces per edge
+        self._span_sum_usec = 0.0
+        self._span_n = 0
 
     # -- eligibility at offer time -------------------------------------------
     def _tail_warm(self, cap: int) -> bool:
@@ -392,14 +397,41 @@ class MegastepEdge:
             xs["wm"] = jnp.asarray(
                 np.array([p.wm_pane for p in group], np.int64))
 
+        # trace lane, per batch at GROUP times: collected+dispatched when
+        # the scan actually launches (so emitted->dispatched measures each
+        # batch's real K-wait) and device_done when the one blocking D2H
+        # drain returns.  Both stamps are shared by the whole K-group, so
+        # they carry shared_k=K — the latency ledger keeps the wall value
+        # (each batch truly waited) but divides device-busy credit by K
+        # instead of smearing the group's compute onto every batch.
+        ring = self.rep.ring
+        traced = [p.trace for p in group if p.trace is not None] \
+            if ring is not None else []
+        if traced:
+            t_disp = current_time_usecs()
+            for tr in traced:
+                ring.record(tr[0], flightrec.COLLECTED, t_disp,
+                            shared=self.k)
+                ring.record(tr[0], flightrec.DISPATCHED, t_disp,
+                            shared=self.k)
         carry, ys = mega(self._carry_init(), xs)
         # the ONE blocking D2H per megastep: materialize the stacked
         # outputs; per-batch slices below are zero-copy numpy views
         host = jax.tree.map(np.asarray, ys)
+        if traced:
+            t_done = current_time_usecs()
+            for tr in traced:
+                ring.record(tr[0], flightrec.DEVICE_DONE, t_done,
+                            shared=self.k)
         pool.release(sup, None)     # outputs ready => device read it
         self._commit_carry(carry)
         self.megasteps += 1
         self.batches += self.k
+        for p in group:
+            if p.ts_max is not None and p.ts_min is not None \
+                    and p.ts_max >= p.ts_min > 0:
+                self._span_sum_usec += p.ts_max - p.ts_min
+                self._span_n += 1
 
         self._emit(group, host)
         self._post_hooks()
@@ -410,7 +442,8 @@ class MegastepEdge:
         spans exactly as its own dispatch would, then rides the tail's
         emitter downstream (the sink stamps SUNK + e2e per batch)."""
         rep, op, kind = self.rep, self.op, self.kind
-        ring = rep.ring
+        lat = rep.latency
+        windowed = kind in ("ffat_cb", "ffat_tb")
         fused = op._fused_prelude is not None
         filt = bool(getattr(op, "_is_filter", False))
         for i, p in enumerate(group):
@@ -418,13 +451,15 @@ class MegastepEdge:
             rep._advance_wm(p.wm)
             rep.stats.inputs_received += p.n
             tr = p.trace
-            if ring is not None and tr is not None:
-                now = current_time_usecs()
-                ring.record(tr[0], flightrec.COLLECTED, now)
-                ring.record(tr[0], flightrec.DISPATCHED, now)
+            # collected/dispatched/device_done stamped at group times in
+            # run() (shared_k=K); here only the freshness gauge fires —
+            # ts_i/valid_i are already host numpy from the one drain, so
+            # fire-time minus window-close costs zero extra syncs
             pay = jax.tree.map(lambda a: a[i], host[0])
             ts_i = host[1][i]
             valid_i = host[2][i]
+            if lat is not None and windowed and tr is not None:
+                lat.note_window_fire(op.name, ts_i, valid_i)
             front = p.frontier if p.frontier >= p.wm else p.wm
             if kind in ("ffat_cb", "ffat_tb"):
                 out = DeviceBatch(pay, ts_i, valid_i, watermark=p.wm,
@@ -465,6 +500,16 @@ class MegastepEdge:
                 if prev is not None:
                     op._maybe_warn_drops(int(prev))
 
+    def freshness_floor_usec(self):
+        """The explicit freshness floor a K-group imposes: a batch's
+        result cannot leave the device sooner than the K x mean batch
+        event-time span it waited to group with (docs/OBSERVABILITY.md
+        "Latency plane & SLO"); None before any scanned batch carried
+        event-time extrema."""
+        if not self._span_n:
+            return None
+        return round(self.k * self._span_sum_usec / self._span_n, 3)
+
     def summary(self) -> dict:
         return {
             "operator": self.op.name,
@@ -474,6 +519,7 @@ class MegastepEdge:
             "batches": self.batches,
             "fallback_batches": self.fallback_batches,
             "warmup_batches": self.warmup_batches,
+            "freshness_floor_usec": self.freshness_floor_usec(),
         }
 
 

@@ -44,12 +44,18 @@ from typing import Dict, Optional
 
 from windflow_tpu.basic import current_time_usecs
 
-#: operator health states, worst last (graph verdict = max by this order)
+#: operator health states, worst last (graph verdict = max by this order).
+#: SLO_VIOLATED sits between OK and BACKPRESSURED: the pipeline is
+#: draining fine, it is just slower than the declared latency budget
+#: (Config.latency_slo_ms; monitoring/latency_ledger.py) — with no SLO
+#: configured the state is unreachable and every transition matches the
+#: pre-SLO plane verbatim.
 OK = "OK"
+SLO_VIOLATED = "SLO_VIOLATED"
 BACKPRESSURED = "BACKPRESSURED"
 STALLED = "STALLED"
 FAILED = "FAILED"
-STATES = (OK, BACKPRESSURED, STALLED, FAILED)
+STATES = (OK, SLO_VIOLATED, BACKPRESSURED, STALLED, FAILED)
 _SEVERITY = {s: i for i, s in enumerate(STATES)}
 
 #: postmortem bundle schema tag (tools/wf_doctor.py validates against it)
@@ -62,7 +68,8 @@ class _OpTrack:
 
     __slots__ = ("name", "state", "since_usec", "last_advance_usec",
                  "last_inputs", "last_frontier", "queue_depth", "frontier",
-                 "compile_storm", "failure", "stall_latched", "hot_shard")
+                 "compile_storm", "failure", "stall_latched", "hot_shard",
+                 "slo")
 
     def __init__(self, name: str, now: int) -> None:
         self.name = name
@@ -85,6 +92,9 @@ class _OpTrack:
         #: degraded and runs at parallelism > 1 — a BACKPRESSURED/
         #: STALLED verdict names the hot SHARD, not just the operator
         self.hot_shard: Optional[dict] = None
+        #: latency-ledger attribution when this operator dominates an
+        #: active SLO violation (monitoring/latency_ledger.py verdict)
+        self.slo: Optional[dict] = None
 
     def verdict(self, now: int) -> dict:
         v = {
@@ -98,6 +108,8 @@ class _OpTrack:
         }
         if self.hot_shard is not None:
             v["hot_shard"] = self.hot_shard
+        if self.slo is not None:
+            v["slo"] = self.slo
         return v
 
 
@@ -131,6 +143,11 @@ class HealthPlane:
         #: auto-bundle just serializes behind the lock and must proceed
         self._bundle_thread = None
         self._lock = threading.Lock()
+        #: latency ledger (monitoring/latency_ledger.py), bound by
+        #: PipeGraph._build when Config.latency_ledger is on; its active
+        #: SLO verdict turns the dominant operator's OK into
+        #: SLO_VIOLATED (None = one attribute check per sample)
+        self.latency = None
         #: the jit registry is process-global and never resets: baseline
         #: its per-op recompile counts now so a storm verdict reflects
         #: THIS graph's run, not a prior graph sharing operator names
@@ -146,6 +163,11 @@ class HealthPlane:
         t0 = time.perf_counter()
         now = now if now is not None else current_time_usecs()
         storms = self._compile_storms()
+        # snapshot the ledger's SLO verdict once, outside the lock — the
+        # ledger ticks on the same monitor thread, so this is a plain
+        # read of its latest published verdict, not a re-evaluation
+        lat = self.latency
+        slo_v = lat.verdict if lat is not None and lat.slo_active else None
         with self._lock:
             changes = {}
             for op in self.graph._operators:
@@ -153,7 +175,8 @@ class HealthPlane:
                 if track is None:   # operator added post-build: track late
                     track = self._tracks[op.name] = _OpTrack(op.name, now)
                 state = self._evaluate_op(op, track, now,
-                                          storms.get(op.name, False))
+                                          storms.get(op.name, False),
+                                          slo_v)
                 if state != track.state:
                     track.state = state
                     track.since_usec = now
@@ -187,7 +210,7 @@ class HealthPlane:
         return verdicts
 
     def _evaluate_op(self, op, track: _OpTrack, now: int,
-                     storm: bool) -> str:
+                     storm: bool, slo_v: Optional[dict] = None) -> str:
         # the queue-depth/min-frontier walk is the graph's (shared with
         # gauges(): the watchdog must judge exactly what the lag gauge
         # reports, or the two drift)
@@ -207,6 +230,7 @@ class HealthPlane:
         track.queue_depth = depth
         track.frontier = frontier
         track.compile_storm = storm
+        track.slo = None   # re-attached below only while the violation holds
         # hot-shard attribution: the replica holding the deepest backlog
         # (ties broken by the most-lagged frontier) — per-replica reads
         # only, so it works with the shard ledger off too; the ledger's
@@ -233,7 +257,14 @@ class HealthPlane:
         if track.failure is not None:
             return FAILED
         if not alive:
-            return OK                      # terminated cleanly
+            # terminated cleanly — but a still-latched SLO verdict keeps
+            # naming the run's latency story for post-run stats() and
+            # postmortem readers (the ledger stops ticking with the
+            # graph, so the latch is the final word)
+            if slo_v is not None and slo_v.get("dominant_op") == op.name:
+                track.slo = slo_v
+                return SLO_VIOLATED
+            return OK
         if track.stall_latched:
             return STALLED
         if depth > 0 and not advanced \
@@ -245,6 +276,15 @@ class HealthPlane:
             return STALLED
         if depth >= self.backpressure_depth or storm:
             return BACKPRESSURED
+        # SLO check LAST: a violation only upgrades an otherwise-OK
+        # operator (FAILED/STALLED/BACKPRESSURED already name a harder
+        # problem and the latency verdict rides along in track.slo
+        # regardless via the ledger section) — and only the verdict's
+        # dominant operator carries the state, so one slow op does not
+        # paint the whole graph red
+        if slo_v is not None and slo_v.get("dominant_op") == op.name:
+            track.slo = slo_v
+            return SLO_VIOLATED
         return OK
 
     def _recompile_counts(self) -> dict:

@@ -226,7 +226,8 @@ def _families(stats: dict,
                        "the active state)")
         for name, v in (health.get("verdicts") or {}).items():
             active = str(v.get("state", "")).lower()
-            for state in ("ok", "backpressured", "stalled", "failed"):
+            for state in ("ok", "slo_violated", "backpressured",
+                          "stalled", "failed"):
                 f_health.add(1 if active == state else 0,
                              dict(base, operator=name, state=state))
         fam("wf_stall_events_total", "counter",
@@ -402,6 +403,65 @@ def _families(stats: dict,
     f_e2e = fam("wf_end_to_end_latency_usec", "histogram",
                 "Staged-to-sunk end-to-end latency (microseconds)")
     _hist_from_stats(f_e2e, lat.get("end_to_end_usec"), base)
+
+    # -- latency plane (critical-path decomposition + SLO) -------------------
+    lplane = stats.get("Latency_plane") or {}
+    if lplane.get("enabled"):
+        f_seg = fam("wf_latency_segment_usec", "histogram",
+                    "Critical-path segment latency per operator "
+                    "(latency-ledger decomposition; `segment` label is "
+                    "one of the five staged->sunk hops)")
+        f_fresh = fam("wf_latency_freshness_usec", "histogram",
+                      "Window fire time minus window-close event time "
+                      "on sampled fired batches (result freshness)")
+        f_share = fam("wf_latency_budget_share", "gauge",
+                      "Operator's share of graph-wide decomposed "
+                      "latency (0..1)")
+        f_busy = fam("wf_latency_device_busy_usec_total", "counter",
+                     "Device-compute microseconds credited to the "
+                     "operator (megastep group spans deflated by K)")
+        f_floor = fam("wf_latency_freshness_floor_usec", "gauge",
+                      "Megastep K x mean batch span: the freshness "
+                      "floor the executor's group-wait imposes")
+        for name, entry in (lplane.get("per_op") or {}).items():
+            lab = dict(base, operator=name)
+            for seg, q in (entry.get("segments_usec") or {}).items():
+                _hist_from_stats(f_seg, q, dict(lab, segment=seg))
+            _hist_from_stats(f_fresh, entry.get("freshness_usec"), lab)
+            if isinstance(entry.get("budget_share"), (int, float)):
+                f_share.add(entry["budget_share"], lab)
+            if isinstance(entry.get("device_busy_usec"), (int, float)):
+                f_busy.add(entry["device_busy_usec"], lab)
+            if isinstance(entry.get("freshness_floor_usec"),
+                          (int, float)):
+                f_floor.add(entry["freshness_floor_usec"], lab)
+        fam("wf_latency_traces_decomposed_total", "counter",
+            "Sampled traces fully decomposed by the latency ledger") \
+            .add(lplane.get("traces_decomposed", 0), base)
+        fam("wf_latency_traces_dropped_total", "counter",
+            "Open traces evicted before their sunk event arrived") \
+            .add(lplane.get("traces_dropped", 0), base)
+        fam("wf_latency_events_lost_total", "counter",
+            "Span-ring events overwritten before harvest") \
+            .add(lplane.get("events_lost", 0), base)
+        slo = lplane.get("slo") or {}
+        if slo.get("budget_ms"):
+            fam("wf_slo_active", "gauge",
+                "1 while the latched SLO_VIOLATED verdict holds") \
+                .add(1 if slo.get("active") else 0, base)
+            fam("wf_slo_entered_total", "counter",
+                "SLO violation episodes entered") \
+                .add(slo.get("entered", 0), base)
+            fam("wf_slo_cleared_total", "counter",
+                "SLO violation episodes cleared (hysteresis)") \
+                .add(slo.get("cleared", 0), base)
+            fam("wf_slo_budget_ms", "gauge",
+                "Declared end-to-end p99 latency budget "
+                "(Config.latency_slo_ms)") \
+                .add(slo.get("budget_ms", 0), base)
+            fam("wf_slo_recent_p99_ms", "gauge",
+                "Rolling-window e2e p99 the SLO is judged against") \
+                .add(slo.get("recent_p99_ms", 0), base)
 
     # -- device plane --------------------------------------------------------
     device = stats.get("Device") or {}

@@ -171,7 +171,7 @@ class ReplicaRing:
     reads, graph/pipegraph.py)."""
 
     __slots__ = ("op_name", "replica_index", "size", "trace", "stage", "t",
-                 "n")
+                 "shared_k", "n")
 
     def __init__(self, op_name: str, replica_index: int, size: int) -> None:
         self.op_name = op_name
@@ -180,25 +180,33 @@ class ReplicaRing:
         self.trace = np.zeros(self.size, np.int64)
         self.stage = np.zeros(self.size, np.int8)
         self.t = np.zeros(self.size, np.int64)
+        # K of the megastep group the event's timestamp is shared with
+        # (0 = the stamp is this batch's own).  The latency ledger uses it
+        # to divide group-shared device time by K instead of crediting the
+        # whole group's compute to every member batch (latency_ledger.py).
+        self.shared_k = np.zeros(self.size, np.int16)
         self.n = 0          # total events ever recorded (wraps the index)
 
     @hot_path
-    def record(self, trace_id: int, stage: int, t_usec: int) -> None:
+    def record(self, trace_id: int, stage: int, t_usec: int,
+               shared: int = 0) -> None:
         if _dbg.ENABLED:
             # the lock-free write is safe ONLY because one thread drains a
             # replica at a time; overlapping record()s are the race the
             # debug mode turns into a diagnostic (context-managed so an
             # exception cannot leave a stale guard entry)
             with _dbg.entry_guard(self, "ReplicaRing.record"):
-                return self._record_impl(trace_id, stage, t_usec)
-        return self._record_impl(trace_id, stage, t_usec)
+                return self._record_impl(trace_id, stage, t_usec, shared)
+        return self._record_impl(trace_id, stage, t_usec, shared)
 
     @hot_path
-    def _record_impl(self, trace_id: int, stage: int, t_usec: int) -> None:
+    def _record_impl(self, trace_id: int, stage: int, t_usec: int,
+                     shared: int = 0) -> None:
         i = self.n % self.size
         self.trace[i] = trace_id
         self.stage[i] = stage
         self.t[i] = t_usec
+        self.shared_k[i] = shared
         self.n += 1
 
     def events(self) -> List[dict]:
@@ -214,6 +222,7 @@ class ReplicaRing:
                 "trace": int(self.trace[i]),
                 "stage": STAGE_NAMES[int(self.stage[i])],
                 "t_usec": int(self.t[i]),
+                "shared_k": int(self.shared_k[i]),
             })
         return out
 

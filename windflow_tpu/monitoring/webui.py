@@ -27,8 +27,12 @@ INDEX_HTML = """<!DOCTYPE html>
   td:first-child, th:first-child { text-align: left; }
   .spark { vertical-align: middle; }
   .hOK { color: #1a7f37; font-weight: 600; }
+  .hSLO_VIOLATED { color: #c2571a; font-weight: 600; }
   .hBACKPRESSURED { color: #b8860b; font-weight: 600; }
   .hSTALLED, .hFAILED { color: #c0392b; font-weight: 600; }
+  .bud { display: inline-block; width: 60px; height: 9px;
+         background: #eceff4; vertical-align: middle; }
+  .bud > div { height: 9px; background: #c2571a; }
   #meta { font-size: 12px; color: #555; margin-bottom: 8px;
           white-space: pre-line; }
   pre { background: #f7f7f7; padding: 8px; font-size: 11px;
@@ -101,10 +105,18 @@ async function render(id) {
   // health plane: graph verdict + stall counter in the meta line, a
   // per-operator state column in the table below
   const health = last.Health || {};
+  // latency plane: rolling-p99-vs-budget headline when an SLO is
+  // declared, and the per-op budget-bar column in the table below
+  const lplane = last.Latency_plane || {};
+  const slo = lplane.slo || {};
+  const sloLine = slo.budget_ms
+    ? `  slo=${slo.active ? "VIOLATED" : "ok"} ` +
+      `p99=${slo.recent_p99_ms}ms/${slo.budget_ms}ms`
+    : "";
   const hLine = (health.enabled
     ? `health=${health.graph_state || "?"} ` +
       `stalls=${health.stall_events ?? 0}`
-    : "health=off") + (last.Aborted ? "  ABORTED" : "");
+    : "health=off") + sloLine + (last.Aborted ? "  ABORTED" : "");
   // wire plane: compression ratio of the staged ingest (logical over
   // wire bytes) — "off"/"raw" make the no-compression cases explicit
   const wire = (last.Staging || {}).Wire || {};
@@ -163,6 +175,10 @@ async function render(id) {
   // under each op row — click the operator name to expand its shards
   // (queue/lag/load per replica, hot-key table for keyed edges)
   const shardOps = (last.Shard || {}).per_op || {};
+  // latency ledger (monitoring/latency_ledger.py): each op's share of
+  // the graph-wide decomposed critical path, drawn as a budget bar;
+  // hover names the op's dominant segment (where its share is spent)
+  const latOps = lplane.per_op || {};
   const shardRow = (name, i) => {
     const sh = shardOps[name];
     if (!sh) return "";
@@ -189,7 +205,7 @@ async function render(id) {
     const ici = (sh.ici || {}).ici_bytes_per_tuple;
     const open = (window._openShards || new Set()).has(i);
     return `<tr id="shard_${i}" style="display:${open ? "" : "none"}">` +
-           `<td colspan="13">` +
+           `<td colspan="14">` +
            `<table><tr><th>shard</th><th>queue</th><th>wm lag</th>` +
            `<th>tuples</th><th>p50</th><th>p99</th><th>disp</th>` +
            `<th>HBM B</th></tr>${rows}</table>` +
@@ -213,6 +229,7 @@ async function render(id) {
     `<th>outputs</th>` +
     `<th>ignored</th><th>p50</th><th>p95</th><th>p99</th>` +
     `<th>disp/batch</th><th>B/tuple</th><th>wire</th>` +
+    `<th>budget</th>` +
     `<th>wm lag</th><th>throughput (tuples/report)</th></tr>` +
     lastOps.map(op => {
       const name = op.Operator_name || op.Name || "?";
@@ -247,6 +264,12 @@ async function render(id) {
         (s, r) => s + (r.Bytes_H2D_logical || 0), 0);
       const wCell = !wSent ? "–"
         : (wLog > wSent ? `${(wLog / wSent).toFixed(2)}x` : "raw");
+      const lp = latOps[name] || {};
+      const bsh = lp.budget_share;
+      const budCell = bsh == null ? "–"
+        : `<span class="bud" title="${esc(lp.dominant_segment || "")}">` +
+          `<div style="width:${Math.round(bsh * 60)}px"></div></span> ` +
+          `${(bsh * 100).toFixed(0)}%`;
       const idx = lastOps.indexOf(op);
       const sub = shardRow(name, idx);
       const nameCell = sub
@@ -259,6 +282,7 @@ async function render(id) {
              `<td>${fmtUs(q.p50)}</td><td>${fmtUs(q.p95)}</td>` +
              `<td>${fmtUs(q.p99)}</td>` +
              `<td>${dpb}</td><td>${bpt}</td><td>${wCell}</td>` +
+             `<td>${budCell}</td>` +
              `<td>${spark(lh.slice(-60), 80, 26)} ${fmtUs(lag)}</td>` +
              `<td>${spark(h.slice(-60), 160, 26)} ${cur}</td></tr>` + sub;
     }).join("") + "</table>";

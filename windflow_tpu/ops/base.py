@@ -76,6 +76,11 @@ class Replica:
         #: a single `is not None` check as the hot path's whole cost
         self.ring = None
         self._traced_seen = 0   # traced batches seen (device_done cadence)
+        #: latency ledger (monitoring/latency_ledger.py), bound by
+        #: PipeGraph._build on WINDOW replicas only when
+        #: Config.latency_ledger is on; None leaves one `is not None`
+        #: check at the sampled-sync site as the whole cost
+        self.latency = None
         self.mode = ExecutionMode.DEFAULT
         self.time_policy = TimePolicy.INGRESS
         #: origin id of the input currently being processed (HostBatch.ids);

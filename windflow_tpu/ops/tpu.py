@@ -85,8 +85,17 @@ class _TPUReplica(Replica):
             if out is not None and sync_every \
                     and self._traced_seen % sync_every == 0:
                 jax.block_until_ready(out.valid)
+                now = current_time_usecs()
                 self.ring.record(batch.trace[0], flightrec.DEVICE_DONE,
-                                 current_time_usecs())
+                                 now)
+                if self.latency is not None:
+                    # window-freshness gauge (latency ledger): fire time
+                    # minus window-close event time over the fired
+                    # records of this already-synced batch — bound only
+                    # on window replicas, and only the 1-in-
+                    # (sample * sync) sampled batch reaches here
+                    self.latency.note_window_fire(self.op.name, out.ts,
+                                                  out.valid, now)
         if out is not None:
             if out.trace is None:
                 # operator steps build fresh DeviceBatches; the trace lane
