@@ -1789,6 +1789,26 @@ def main() -> None:
         # check_bench_keys loudly instead)
         result["preflight_error"] = f"{type(e).__name__}: {e}"[:200]
 
+    # wfir (windflow_tpu/analysis/ir_audit.py, guarded by
+    # tools/check_bench_keys.py): context-free WF9xx audit over EVERY
+    # program this bench process compiled — the real e2e/kernel/megastep
+    # runs above, not a fixture.  `findings` is a hard tripwire: shipped
+    # bench programs audit clean, so any nonzero count is a lowering
+    # regression (a callback, a 64-bit survivor, a donation miss) or an
+    # auditor false positive — both stop the bench leg.
+    try:
+        from windflow_tpu.analysis import ir_audit
+        irep = ir_audit.process_report()
+        result["ir_audit"] = {
+            "programs_audited": irep.programs_audited,
+            "findings": len(irep.findings),
+            "check_ms": round(irep.check_ms, 3),
+        }
+    except Exception as e:  # lint: broad-except-ok (same stance as the
+        # preflight section: the missing key fails check_bench_keys
+        # loudly instead of killing the bench)
+        result["ir_audit_error"] = f"{type(e).__name__}: {e}"[:200]
+
     # health section (windflow_tpu/monitoring/health, guarded by
     # tools/check_bench_keys.py): drive a representative pipeline with the
     # watchdog ON and report stall events (any nonzero is a regression —
@@ -2094,6 +2114,7 @@ def main() -> None:
                  "latency_slo": result.get("latency_slo"),
                  "preflight": result.get("preflight"),
                  "verify": result.get("verify"),
+                 "ir_audit": result.get("ir_audit"),
                  "device": result.get("device"),
                  "health": result.get("health"),
                  "shard": result.get("shard"),

@@ -22,6 +22,26 @@ python tools/wf_verify.py --strict \
     tools.verify_targets:bench_e2e \
     tools.verify_targets:wire_ingest \
     tools.verify_targets:pallas_window \
+    tools.verify_targets:megastep_latency \
+    tools.verify_targets:chaos_window_cb \
+    tools.verify_targets:chaos_window_tb \
+    tools.verify_targets:chaos_reduce \
+    tools.verify_targets:chaos_stateful \
+    tools.verify_targets:chaos_stateless_chain
+
+# wfir stage (IR-level, runs the graphs): --drive feeds a seeded
+# synthetic stream into every composed-only target and audits the
+# lowered StableHLO of EVERY program the runs compile — collectives on
+# promised-collective-free edges, host callbacks, 64-bit survivors,
+# dynamic shapes, donation misses, D2H syncs, lost Mosaic custom calls
+# (WF901-WF907) — plus an orphan sweep over the framework's own staging
+# programs.  Zero extra compiles: the audit parses the compile
+# watcher's existing first-compile lowering.
+python tools/wf_ir.py --strict --drive 8192 \
+    tools.verify_targets:bench_e2e \
+    tools.verify_targets:wire_ingest \
+    tools.verify_targets:pallas_window \
+    tools.verify_targets:megastep_latency \
     tools.verify_targets:chaos_window_cb \
     tools.verify_targets:chaos_window_tb \
     tools.verify_targets:chaos_reduce \
@@ -66,7 +86,8 @@ python -m pytest tests/test_staging.py tests/test_observability.py \
     tests/test_shard_plane.py tests/test_tracecheck.py \
     tests/test_key_compaction.py tests/test_reshard.py \
     tests/test_wire.py tests/test_pallas_kernels.py \
-    tests/test_megastep.py tests/test_latency_plane.py -q -m 'not slow'
+    tests/test_megastep.py tests/test_latency_plane.py \
+    tests/test_ir_audit.py -q -m 'not slow'
 python -m pytest tests/ -q -m 'not slow'
 python __graft_entry__.py 8
 BENCH_PLATFORM=cpu BENCH_E2E_TUPLES=131072 python bench.py | tee bench_ci_out.txt

@@ -151,6 +151,7 @@ DURABILITY_KEYS = ("checkpoint_ms", "restore_ms", "checkpoint_bytes",
                    "overhead_pct")
 SHARD_KEYS = ("imbalance_ratio", "hot_key_share", "ici_bytes_per_tuple")
 VERIFY_KEYS = ("findings", "check_ms")
+IR_AUDIT_KEYS = ("programs_audited", "findings", "check_ms")
 WIRE_KEYS = ("wire_bytes_per_tuple", "compression_ratio",
              "staging_share", "decode_dispatch_delta")
 COMPACTION_KEYS = ("speedup_vs_sorted", "hit_rate", "overflow_share",
@@ -187,6 +188,8 @@ def check_source() -> None:
             ("preflight", ("check_ms",), "docs/ANALYSIS.md"),
             ("verify", VERIFY_KEYS,
              "wfverify — docs/ANALYSIS.md wfverify section"),
+            ("ir_audit", IR_AUDIT_KEYS,
+             "wfir — docs/ANALYSIS.md wfir section"),
             ("device", DEVICE_KEYS,
              "compile watcher — docs/OBSERVABILITY.md device-plane"),
             ("health", HEALTH_KEYS,
@@ -546,6 +549,32 @@ def check_output(path: str) -> None:
         # its absence IS the analysis regression this guard catches
         fail("bench verify section absent or errored "
              f"(preflight_error={result.get('preflight_error')!r})")
+    ira = result.get("ir_audit")
+    if isinstance(ira, dict):
+        missing = [k for k in IR_AUDIT_KEYS if k not in ira]
+        if missing:
+            fail(f"'ir_audit' section missing {missing} from bench "
+                 "output")
+        if not ira.get("programs_audited"):
+            # the bench legs above compiled dozens of wf_jit programs
+            # through the compile watcher: zero captured lowerings means
+            # the registry hook or the capture path broke
+            fail("bench ir_audit audited zero programs — the compile "
+                 "watcher's lowering capture (analysis/ir_audit.py) "
+                 "stopped recording")
+        if ira.get("findings"):
+            # shipped bench programs audit clean on the IR: a nonzero
+            # WF9xx count is a lowering regression (a host callback, a
+            # 64-bit survivor, a donation miss in a compiled program)
+            # or an auditor false positive — both block
+            fail(f"bench ir_audit reported {ira['findings']} WF9xx "
+                 "finding(s) on the shipped bench programs")
+    else:
+        # the IR audit parses lowerings already captured in-process —
+        # device-free, no environmental failure mode: its absence IS
+        # the analysis regression this guard catches
+        fail("bench ir_audit section absent or errored "
+             f"(ir_audit_error={result.get('ir_audit_error')!r})")
     pf = result.get("preflight")
     if isinstance(pf, dict):
         if "check_ms" not in pf:

@@ -99,9 +99,13 @@ def pallas_window():
          .withCBWindows(64, 16)
          .withKeyBy(lambda t: t["key"]).withMaxKeys(64)
          .withSumCombiner().build())
+    # the reduce combines WINDOW OUTPUT records ({key, value, wid}) —
+    # wf_ir --drive actually runs this graph, so the combiner must match
+    # the upstream record structure, not the source spec
     red = (wf.ReduceTPU_Builder(
             lambda a, b: {"key": jnp_max(a["key"], b["key"]),
-                          "v0": jnp_max(a["v0"], b["v0"])})
+                          "value": jnp_max(a["value"], b["value"]),
+                          "wid": jnp_max(a["wid"], b["wid"])})
            .withKeyBy(lambda t: t["key"]).withMaxKeys(64)
            .withMonoidCombiner("max").build())
     g = wf.PipeGraph("verify_pallas_window",
@@ -109,6 +113,37 @@ def pallas_window():
     pipe = g.add_source(src)
     pipe.add(w)
     pipe.add(red)
+    pipe.add_sink(wf.Sink_Builder(lambda r: None).build())
+    return g
+
+
+def megastep_latency():
+    """Megastep + latency-ledger shape (windflow_tpu/megastep.py,
+    monitoring/latency_ledger.py): K=4 staged sweeps folded into one
+    compiled scan program feeding a CB window, with the per-batch
+    latency ledger harvesting trace lanes — the two post-PR-10 hot
+    paths (`MegastepEdge.offer`/`run`/drain, `LatencyLedger.harvest`)
+    ride the verified/audited program set like every older plane."""
+    import numpy as np
+
+    import windflow_tpu as wf
+    src = (wf.Source_Builder(lambda: iter(()))
+           .withOutputBatchSize(4096)
+           .withRecordSpec({"key": np.int32(0),
+                            "v0": np.float32(0.0)}).build())
+    m = wf.MapTPU_Builder(
+        lambda t: {"key": t["key"], "v0": t["v0"] * 0.5}).build()
+    w = (wf.Ffat_WindowsTPU_Builder(lambda t: t["v0"],
+                                    lambda a, b: a + b)
+         .withCBWindows(64, 16)
+         .withKeyBy(lambda t: t["key"]).withMaxKeys(64)
+         .withSumCombiner().build())
+    g = wf.PipeGraph("verify_megastep_latency",
+                     config=wf.Config(megastep_sweeps=4,
+                                      latency_ledger=True))
+    pipe = g.add_source(src)
+    pipe.add(m)
+    pipe.add(w)
     pipe.add_sink(wf.Sink_Builder(lambda r: None).build())
     return g
 

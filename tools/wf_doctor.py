@@ -46,7 +46,7 @@ SECTIONS = ("stats.json", "events.json", "health.json", "device.json",
 #: must not reject a bundle written before they existed (same schema) —
 #: this tool's job is exactly the historical crash bundle
 OPTIONAL_SECTIONS = ("sweep.json", "durability.json", "shard.json",
-                     "reshard.json", "latency.json")
+                     "reshard.json", "latency.json", "ir_audit.json")
 #: reshard executor timeline events (windflow_tpu/serving/executor.py)
 RESHARD_EVENTS = ("triggered", "move_keys", "split_hot_key", "admission",
                   "recovered", "scale_down", "move_skipped")
@@ -236,6 +236,24 @@ def validate(bundle: dict) -> None:
                     or e.get("event") not in RESHARD_EVENTS:
                 raise BundleError(
                     f"reshard.json: illegal timeline entry {e!r}")
+    ira = sections.get("ir_audit.json") or {}
+    if ira.get("enabled") and "error" not in ira:
+        for key in ("programs_audited", "dry_lowered", "suppressed"):
+            v = ira.get(key)
+            if not isinstance(v, int) or v < 0:
+                raise BundleError(
+                    f"ir_audit.json: {key!r} must be a non-negative "
+                    f"integer, got {v!r}")
+        for key in ("findings", "pending"):
+            if not isinstance(ira.get(key), list):
+                raise BundleError(
+                    f"ir_audit.json: {key!r} must be a list")
+        for f in ira["findings"]:
+            if not isinstance(f, dict) \
+                    or not str(f.get("code", "")).startswith("WF9"):
+                raise BundleError(
+                    f"ir_audit.json: finding {f!r} is not an object "
+                    "with a WF9xx code")
     latp = sections.get("latency.json") or {}
     if latp.get("enabled") and "error" not in latp:
         for key in ("traces_decomposed", "traces_dropped", "events_lost"):
@@ -376,6 +394,15 @@ def diagnose(bundle: dict) -> dict:
             "slo_active": slo.get("active"),
             "slo_verdict": slo.get("verdict") or slo.get("last_verdict"),
         }
+    irap = sections.get("ir_audit.json") or {}
+    ir_audit = None
+    if irap.get("enabled") and "error" not in irap:
+        ir_audit = {
+            "programs_audited": irap.get("programs_audited"),
+            "findings": irap.get("findings") or [],
+            "suppressed": irap.get("suppressed"),
+            "pending": irap.get("pending") or [],
+        }
     rsh = sections.get("reshard.json") or {}
     reshard = None
     if rsh.get("enabled") and "error" not in rsh:
@@ -395,6 +422,7 @@ def diagnose(bundle: dict) -> dict:
         "reason": manifest.get("reason"),
         "durability": durability,
         "latency": latency,
+        "ir_audit": ir_audit,
         "reshard": reshard,
         "written_at_usec": manifest.get("written_at_usec"),
         "graph_state": health.get("graph_state"),
@@ -535,6 +563,20 @@ def render_text(d: dict) -> str:
                    else "within budget"
                    + (f" (last violation: {v.get('message')})"
                       if v else "")))
+    if d.get("ir_audit"):
+        ia = d["ir_audit"]
+        finds = ia["findings"]
+        lines.append(
+            f"  IR audit: {ia['programs_audited']} lowered program(s) "
+            f"audited — {len(finds)} WF9xx finding(s)"
+            + (f", {ia['suppressed']} suppressed" if ia.get("suppressed")
+               else "")
+            + (f", pending (never lowered): {ia['pending']}"
+               if ia.get("pending") else ""))
+        for f in finds[:8]:
+            lines.append(
+                f"    {f.get('code')} [{f.get('severity')}] "
+                f"'{f.get('node')}': {f.get('message')}")
     if d.get("reshard"):
         r = d["reshard"]
         lines.append(

@@ -1,0 +1,203 @@
+#!/usr/bin/env python
+"""wf_ir: audit the lowered StableHLO of an application's programs.
+
+CLI face of wfir (``windflow_tpu/analysis/ir_audit.py``), mirroring
+``tools/wf_verify.py``: point it at the module that builds your
+PipeGraph and every program the compile watcher has captured — plus a
+dry lower of the user kernels when the graph never compiled — is audited
+on the IR the chip actually runs: cross-chip collectives on edges the
+aligned-ingest plan promised collective-free (WF901), host callbacks in
+hot-path programs (WF902), 64-bit survivors on TPU (WF903), dynamic
+shapes (WF904), donation misses (WF905), mid-program D2H syncs (WF906),
+and Pallas kernels that lost their Mosaic custom call (WF907).
+
+Usage::
+
+    python tools/wf_ir.py APP_MODULE[:ATTR] [MORE...]
+    python tools/wf_ir.py ... --drive 8192   # feed a seeded synthetic
+                                             # stream into empty sources
+                                             # and RUN each graph so its
+                                             # real programs compile and
+                                             # get audited
+    python tools/wf_ir.py ... --json         # machine-readable
+    python tools/wf_ir.py ... --strict       # exit 1 on warnings too
+
+Verify-target factories (``tools/verify_targets.py``) compose their
+graphs with empty sources (``lambda: iter(())``) — composition is all
+wfverify needs, but an IR audit wants the LOWERED programs.  ``--drive``
+closes that gap: any source whose generator yields nothing is given a
+seeded synthetic generator derived from its declared record spec
+(monotone ``id``/``ts`` lanes, small-domain ints for keys, uniform
+floats), the graph runs to completion on the local backend, and the
+audit then covers every program the run compiled.  Sources that already
+produce data (the chaos cells) keep their own streams.
+
+Inline suppressions (``# wfverify: ok (reason)`` on the kernel ``def``)
+are shared with wfverify and counted.  Exit status: 0 clean, 1
+error-severity findings (or any finding under ``--strict``), 2
+usage/load failures.
+"""
+
+from __future__ import annotations
+
+import argparse
+import importlib.util
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _load_wf_check():
+    spec = importlib.util.spec_from_file_location(
+        "wf_check", os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                 "wf_check.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _synth_gen(record_spec: dict, n: int, seed: int = 0):
+    """A zero-arg generator factory producing ``n`` records matching
+    ``record_spec``: monotone values for ``id``/``ts``-style lanes,
+    ints in [0, 32) for everything integral (safe under the targets'
+    ``max_keys=64`` tables), [0, 1) floats.  Every value is a PURE
+    function of the record index — no hidden RNG state, so a
+    checkpointed target replays deterministically (WF611-clean)."""
+    import numpy as np
+
+    def gen():
+        for i in range(n):
+            # Knuth multiplicative hash of (index, lane) — scrambled
+            # but replay-identical
+            h = (i + seed) * 2654435761
+            rec = {}
+            for j, (name, proto) in enumerate(record_spec.items()):
+                dt = np.asarray(proto).dtype
+                v = (h ^ (j * 0x9E3779B9)) & 0xFFFFFFFF
+                if name in ("id", "ts", "timestamp"):
+                    rec[name] = dt.type(i)
+                elif np.issubdtype(dt, np.integer):
+                    rec[name] = dt.type(v % 32)
+                elif np.issubdtype(dt, np.bool_):
+                    rec[name] = dt.type(i & 1)
+                else:
+                    rec[name] = dt.type((v % 4096) / 4096.0)
+            yield rec
+    return gen
+
+
+def _drive(graph, n: int) -> bool:
+    """Substitute a seeded synthetic stream into every EMPTY source of
+    ``graph`` (generators that already yield records keep their own
+    stream — the chaos cells drive themselves) and run the graph to
+    completion so its programs compile.  Returns True when it ran."""
+    subbed = live = 0
+    for mp in graph._all_pipes():
+        for op in mp.operators:
+            gen_fn = getattr(op, "gen_fn", None)
+            spec = getattr(op, "record_spec", None)
+            if gen_fn is None:
+                continue
+            if next(gen_fn(), None) is None and isinstance(spec, dict):
+                op.gen_fn = _synth_gen(spec, n)
+                subbed += 1
+            else:
+                live += 1
+    if not (subbed or live):
+        return False
+    from windflow_tpu.analysis.diagnostics import PreflightError
+    try:
+        graph.run()
+    except PreflightError as e:
+        # the graph's own pre-flight (which folds this same dry-lower
+        # audit) refused to start — the audit below reports the
+        # findings; nothing compiled, so it takes the dry-lower path
+        print(f"wf_ir: drive blocked by pre-flight: {e}", file=sys.stderr)
+        return False
+    return True
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("apps", nargs="+",
+                    help="APP_MODULE or APP_MODULE:ATTR building the "
+                         "PipeGraph (several allowed)")
+    ap.add_argument("--drive", type=int, default=0, metavar="N",
+                    help="feed N seeded synthetic records into empty "
+                         "sources and run each graph before auditing "
+                         "(0 = audit composed graphs only: captured "
+                         "programs + kernel dry lower)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit per-app reports as one JSON object")
+    ap.add_argument("--strict", action="store_true",
+                    help="exit nonzero on warnings too")
+    args = ap.parse_args(argv)
+
+    load_graph = _load_wf_check().load_graph
+    from windflow_tpu.analysis import ir_audit
+
+    if not ir_audit.ENABLED:
+        print("wf_ir: FAIL: WF_TPU_IR_AUDIT=0 disables capture — "
+              "nothing to audit", file=sys.stderr)
+        return 2
+
+    out = {}
+    total_errors = total_findings = 0
+    claimed = set()
+    for app in args.apps:
+        g = load_graph(app)
+        if args.drive:
+            _drive(g, args.drive)
+        report = ir_audit.audit_graph(g)
+        claimed |= report.op_names
+        errors = [d for d in report.findings if d.severity == "error"]
+        total_errors += len(errors)
+        total_findings += len(report.findings)
+        out[app] = {
+            "graph": g.name,
+            "errors": len(errors),
+            "warnings": len(report.findings) - len(errors),
+            **report.to_json(),
+        }
+        if not args.json:
+            for d in report.findings:
+                print(str(d))
+            print(f"wf_ir: {app} ({g.name}): "
+                  f"{len(errors)} error(s), "
+                  f"{len(report.findings) - len(errors)} warning(s), "
+                  f"{report.suppressed} suppressed, "
+                  f"{report.programs_audited} program(s) "
+                  f"({report.dry_lowered} dry-lowered, "
+                  f"{len(report.pending)} pending) in "
+                  f"{report.to_json()['check_ms']} ms")
+    # orphan sweep: framework programs (staging pack/unpack, fused-away
+    # flush paths) that no graph's wrappers claimed — audited
+    # context-free so every program the process compiled is covered
+    orphans = ir_audit.audit_orphans(claimed)
+    if orphans.programs_audited:
+        errors = [d for d in orphans.findings if d.severity == "error"]
+        total_errors += len(errors)
+        total_findings += len(orphans.findings)
+        out["(framework programs)"] = {
+            "errors": len(errors),
+            "warnings": len(orphans.findings) - len(errors),
+            **orphans.to_json(),
+        }
+        if not args.json:
+            for d in orphans.findings:
+                print(str(d))
+            print(f"wf_ir: (framework programs): "
+                  f"{len(errors)} error(s), "
+                  f"{len(orphans.findings) - len(errors)} warning(s), "
+                  f"{orphans.programs_audited} program(s)")
+    if args.json:
+        print(json.dumps(out, indent=2))
+    if total_errors or (args.strict and total_findings):
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

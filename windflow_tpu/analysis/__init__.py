@@ -1,7 +1,8 @@
-"""Static analysis subsystem: pre-flight graph checking, the ``@hot_path``
-lint contract, and the debug-mode race detector.
+"""Static analysis subsystem: pre-flight graph checking, the wfverify
+and wfir verifiers, the ``@hot_path`` lint contract, and the debug-mode
+race detector.
 
-Three coordinated passes share one :class:`Diagnostic` record type
+Five coordinated passes share one :class:`Diagnostic` record type
 (``WFxxx`` code, severity, graph node / file:line, fix hint):
 
 * ``analysis.preflight`` — ``PipeGraph.check()``: abstract evaluation of
@@ -12,6 +13,12 @@ Three coordinated passes share one :class:`Diagnostic` record type
   recompile hazards (WF81x), donation safety (WF82x), replay
   determinism (WF61x) — folded into ``check()``, standalone as
   ``tools/wf_verify.py``;
+* ``analysis.ir_audit`` — wfir, the WF9xx audit of every lowered
+  program's StableHLO (collectives vs the aligned-ingest promise,
+  host callbacks, 64-bit survivors, dynamic shapes, donation misses,
+  D2H syncs, lost Mosaic custom calls) parsed off the compile watcher's
+  existing first-compile capture — zero extra compiles; folded into
+  ``check()`` as a dry-lower pass, standalone as ``tools/wf_ir.py``;
 * ``analysis.hotpath`` — the ``@hot_path`` annotation enforced statically
   by ``tools/wf_lint.py``;
 * ``analysis.debug_concurrency`` — ``WF_TPU_DEBUG_CONCURRENCY=1`` runtime

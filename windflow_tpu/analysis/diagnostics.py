@@ -176,6 +176,33 @@ CODES = {
     # donation-miss audit (monitoring/sweep_ledger.py)
     "WF821": ("error", "donated operand read after dispatch (the buffer "
                        "is dead once the compiled program owns it)"),
+    # -- wfir: IR-level audit of the LOWERED StableHLO of every wf_jit
+    #    program (analysis/ir_audit.py, tools/wf_ir.py).  The preflight
+    #    checker reasons about the composed graph and wfverify about the
+    #    Python source; this family is proved on the module XLA actually
+    #    compiles — captured from the registry's existing first-compile
+    #    lowering, zero extra compiles (docs/ANALYSIS.md "wfir") -----------
+    "WF900": ("warning", "ir-audit pass failed internally and was "
+                         "skipped (analysis degraded, lowered programs "
+                         "unchecked)"),
+    "WF901": ("error", "cross-chip collective in a program on an edge "
+                       "the aligned-ingest plan promised (or would "
+                       "make) collective-free"),
+    "WF902": ("error", "host callback / infeed-outfeed custom call "
+                       "inside a hot-path program"),
+    "WF903": ("error", "f64/i64 values survived into a TPU-targeted "
+                       "program past the compiled-dtype gates"),
+    "WF904": ("warning", "dynamic-shape op in the lowered module (IR "
+                         "twin of the WF812 recompile hazard)"),
+    "WF905": ("error", "donation miss at IR level: donated operands "
+                       "with no input-output aliasing in the lowered "
+                       "module"),
+    "WF906": ("warning", "mid-program device<->host transfer (scalar "
+                         "D2H sync) in the lowered module"),
+    "WF907": ("warning", "Pallas kernel lowered without a Mosaic "
+                         "custom call on a compiled backend "
+                         "(interpret/lax fallback — the WF607 "
+                         "downgrade, proven on the IR)"),
 }
 
 

@@ -196,6 +196,7 @@ def check_graph(graph) -> List[Diagnostic]:
     _pallas_pass(graph, ops, diags)
     _megastep_pass(graph, ops, edges, upstreams, diags)
     _tracecheck_pass(graph, diags)
+    _ir_audit_pass(graph, diags)
     return diags
 
 
@@ -476,6 +477,28 @@ def _tracecheck_pass(graph, diags) -> None:
         # degrades to a note instead of masking the preflight result)
         diags.append(Diagnostic(
             "WF800", f"wfverify pass failed internally and was skipped "
+                     f"— {type(e).__name__}: {e}"[:300],
+            severity="warning"))
+
+
+def _ir_audit_pass(graph, diags) -> None:
+    """wfir (analysis/ir_audit.py): WF9xx audit of the lowered StableHLO
+    of every program — captured lowerings from the compile watcher's
+    store plus a dry lower of the user kernels over the record specs
+    when the graph has not compiled yet.  Guarded like wfverify: an
+    auditor bug degrades to WF900 'unchecked', never blocks a run."""
+    try:
+        from windflow_tpu.analysis import ir_audit
+        if not ir_audit.enabled(getattr(graph, "config", None)):
+            return
+        report = ir_audit.audit_graph(graph)
+        graph._ir_audit_report = report
+        diags.extend(report.diagnostics)
+    except Exception as e:  # noqa: BLE001 - lint: broad-except-ok (the
+        # auditor parses backend-emitted IR text; any internal failure
+        # degrades to a note instead of masking the preflight result)
+        diags.append(Diagnostic(
+            "WF900", f"ir-audit pass failed internally and was skipped "
                      f"— {type(e).__name__}: {e}"[:300],
             severity="warning"))
 

@@ -376,6 +376,21 @@ class Config:
     # in-program gather everywhere.
     key_aligned_ingest: bool = bool(int(os.environ.get(
         "WF_TPU_KEY_ALIGNED", "1")))
+    # IR-level program audit (analysis/ir_audit.py, tools/wf_ir.py,
+    # docs/ANALYSIS.md "wfir"): parse the StableHLO text of every wf_jit
+    # program off the compile watcher's EXISTING first-compile lowering
+    # (the cost-table capture — zero extra compiles, cold path only) and
+    # flag the WF9xx family: collectives on promised-collective-free
+    # aligned-ingest edges (WF901), host callbacks/infeed (WF902),
+    # f64/i64 on TPU (WF903), dynamic shapes (WF904), donation misses at
+    # IR level (WF905), mid-program host transfers (WF906), and Pallas
+    # programs that lost their Mosaic lowering (WF907).  Findings land in
+    # stats()["IR_audit"], the postmortem's ir_audit.json, and the
+    # preflight table; =0 is the kill switch — no capture, no parsing,
+    # one flag check on the (already cold) first-compile path.  Capture
+    # rides the cost-analysis lowering, so WF_TPU_COST_ANALYSIS=off also
+    # disables it.
+    ir_audit: bool = bool(int(os.environ.get("WF_TPU_IR_AUDIT", "1")))
     # Whole-chain fusion (windflow_tpu/fusion, docs/PERF.md round 10):
     # at graph build, maximal fusible runs of adjacent TPU operators
     # (the fusion advisor's plan — analysis/fusion.py) lower into ONE

@@ -161,6 +161,10 @@ class PipeGraph:
         # last report (diagnostics folded into _preflight_diags; the
         # report keeps the suppressed findings and per-callable counts)
         self._tracecheck_report = None
+        # wfir (analysis/ir_audit.py): the IR auditor's last report —
+        # WF9xx findings over the lowered StableHLO of this graph's
+        # programs (check() stores it; stats()/postmortem re-audit live)
+        self._ir_audit_report = None
         # profiler bridge: directory the last profile() capture actually
         # landed in, so dump_trace()'s cross-reference points at a real
         # capture even when profile(log_dir=...) overrode the config
@@ -982,6 +986,28 @@ class PipeGraph:
             return {"enabled": True, "error": f"{type(e).__name__}: "
                                               f"{e}"[:200]}
 
+    def _ir_audit_section(self) -> dict:
+        """wfir (analysis/ir_audit.py): WF9xx findings over the lowered
+        StableHLO of this graph's compiled programs.  Re-audits the
+        compile watcher's program store at read cadence (cold path, no
+        compiles); guarded like every other plane section.  With
+        ``Config.ir_audit`` off (or ``WF_TPU_IR_AUDIT=0``) this is the
+        whole cost: one check."""
+        try:
+            from windflow_tpu.analysis import ir_audit
+            if not ir_audit.enabled(self.config):
+                return {"enabled": False}
+            report = ir_audit.audit_graph(self, dry_lower=False)
+            self._ir_audit_report = report
+            out = {"enabled": True}
+            out.update(report.to_json())
+            return out
+        except Exception as e:  # lint: broad-except-ok (the auditor
+            # parses backend-emitted IR text at stats cadence —
+            # telemetry degrades, the report still ships)
+            return {"enabled": True, "error": f"{type(e).__name__}: "
+                                              f"{e}"[:200]}
+
     def _shard_section(self) -> dict:
         """Guarded like the health/device/sweep sections: a shard-plane
         read must never take the pipeline or a stats dump down.  With
@@ -1232,6 +1258,11 @@ class PipeGraph:
             # edges, mesh ICI model — the measurement layer the reshard
             # advisor (tools/wf_shard.py) plans against
             "Shard": self._shard_section(),
+            # wfir (analysis/ir_audit.py): WF9xx audit of the lowered
+            # StableHLO of this graph's compiled programs — collectives,
+            # callbacks, donation aliasing, Pallas lowering proven on
+            # the IR the chip actually runs (docs/ANALYSIS.md "wfir")
+            "IR_audit": self._ir_audit_section(),
             # megastep plane (windflow_tpu/megastep.py): resolved K and
             # per-edge megastep/fallback counters — docs/OBSERVABILITY.md
             # "Megastep in the ledger"
@@ -1349,6 +1380,7 @@ class PipeGraph:
         write("jit.json", jit_tables)
         write("sweep.json", self._sweep_section)
         write("shard.json", self._shard_section)
+        write("ir_audit.json", self._ir_audit_section)
         write("latency.json", self._latency_plane_section)
         write("durability.json", self._durability_section)
         write("reshard.json", self._reshard_section)

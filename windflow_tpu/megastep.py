@@ -58,6 +58,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from windflow_tpu import staging
+from windflow_tpu.analysis.hotpath import hot_path
 from windflow_tpu.basic import current_time_usecs
 from windflow_tpu.batch import WM_NONE, DeviceBatch, unpack_body
 from windflow_tpu.monitoring import recorder as flightrec
@@ -197,6 +198,13 @@ class MegastepEdge:
         # freshness floor the latency ledger surfaces per edge
         self._span_sum_usec = 0.0
         self._span_n = 0
+        # preallocated per-megastep scratch (the @hot_path contract on
+        # run(): no per-group allocations).  Refilling per megastep is
+        # safe: the previous group's one blocking D2H drain returned
+        # before the next run() starts, so the device has consumed the
+        # prior H2D of these buffers.
+        self._wm_buf = np.empty(k, np.int64)
+        self._trace_buf = [None] * k
 
     # -- eligibility at offer time -------------------------------------------
     def _tail_warm(self, cap: int) -> bool:
@@ -236,6 +244,7 @@ class MegastepEdge:
                 and a.buf.shape[0] == b.buf.shape[0])
 
     # -- emitter contract ----------------------------------------------------
+    @hot_path
     def offer(self, pkt) -> bool:
         """Queue one finalized packed batch.  False → the caller ships
         it per-batch (tail cold).  A signature change against the queued
@@ -265,6 +274,7 @@ class MegastepEdge:
             self.run()
         return True
 
+    @hot_path
     def drain_remainder(self) -> None:
         """Ship every queued packet per-batch (FIFO) through the
         feeding emitter's verbatim path — external flushes (quiesce,
@@ -362,6 +372,7 @@ class MegastepEdge:
         elif kind == "reduce_dense":
             op._mesh_dropped = carry
 
+    @hot_path
     def run(self) -> None:
         """Execute one full-K megastep: stack the queued buffers into a
         pooled super-buffer, dispatch the scan, commit the carry, then
@@ -392,10 +403,11 @@ class MegastepEdge:
         for i, p in enumerate(group):
             sup[i * nwords:(i + 1) * nwords] = p.buf
             p.pool.release(p.buf, None)     # host copy done, no gate
-        xs = {"buf": jnp.asarray(sup.reshape(self.k, nwords))}
+        xs = {"buf": jax.device_put(sup.reshape(self.k, nwords))}
         if self.kind == "ffat_tb":
-            xs["wm"] = jnp.asarray(
-                np.array([p.wm_pane for p in group], np.int64))
+            for i, p in enumerate(group):
+                self._wm_buf[i] = p.wm_pane
+            xs["wm"] = jax.device_put(self._wm_buf)
 
         # trace lane, per batch at GROUP times: collected+dispatched when
         # the scan actually launches (so emitted->dispatched measures each
@@ -405,11 +417,17 @@ class MegastepEdge:
         # (each batch truly waited) but divides device-busy credit by K
         # instead of smearing the group's compute onto every batch.
         ring = self.rep.ring
-        traced = [p.trace for p in group if p.trace is not None] \
-            if ring is not None else []
-        if traced:
+        traced = self._trace_buf      # preallocated: no per-group list
+        n_traced = 0
+        if ring is not None:
+            for p in group:
+                if p.trace is not None:
+                    traced[n_traced] = p.trace
+                    n_traced += 1
+        if n_traced:
             t_disp = current_time_usecs()
-            for tr in traced:
+            for idx in range(n_traced):
+                tr = traced[idx]
                 ring.record(tr[0], flightrec.COLLECTED, t_disp,
                             shared=self.k)
                 ring.record(tr[0], flightrec.DISPATCHED, t_disp,
@@ -418,11 +436,11 @@ class MegastepEdge:
         # the ONE blocking D2H per megastep: materialize the stacked
         # outputs; per-batch slices below are zero-copy numpy views
         host = jax.tree.map(np.asarray, ys)
-        if traced:
+        if n_traced:
             t_done = current_time_usecs()
-            for tr in traced:
-                ring.record(tr[0], flightrec.DEVICE_DONE, t_done,
-                            shared=self.k)
+            for idx in range(n_traced):
+                ring.record(traced[idx][0], flightrec.DEVICE_DONE,
+                            t_done, shared=self.k)
         pool.release(sup, None)     # outputs ready => device read it
         self._commit_carry(carry)
         self.megasteps += 1
@@ -436,6 +454,7 @@ class MegastepEdge:
         self._emit(group, host)
         self._post_hooks()
 
+    @hot_path
     def _emit(self, group, host) -> None:
         """Per-batch honesty at drain: each of the K logical batches
         advances the tail replica's watermark, counters, and trace

@@ -109,7 +109,7 @@ class OpCompileEntry:
     __slots__ = ("op_name", "compiles", "recompiles", "compile_ms_total",
                  "last_compile_ms", "cost", "cost_by_sig", "memory",
                  "warned", "lock", "dispatches", "donation",
-                 "donation_attempted")
+                 "donation_attempted", "capture_warned")
 
     def __init__(self, op_name: str) -> None:
         self.op_name = op_name
@@ -144,6 +144,9 @@ class OpCompileEntry:
         #: ledger's donation-miss tripwire).
         self.donation: Optional[dict] = None
         self.donation_attempted = False
+        #: one-time "lowering/cost capture failed" warning (an audit
+        #: skip must never be mistaken for an audit pass)
+        self.capture_warned = False
 
     def to_json(self) -> dict:
         return {
@@ -351,8 +354,20 @@ class WfJit:
         optimized-HLO numbers + memory footprint)."""
         cost_src = None
         memory = None
+        capture_err: Optional[BaseException] = None
         try:
             lowered = self._jit.lower(*args, **kwargs)
+            try:
+                # IR auditor (analysis/ir_audit.py): parse this SAME
+                # lowering's StableHLO into the process-wide program
+                # store — zero extra compiles; one flag check when the
+                # WF_TPU_IR_AUDIT kill switch is off
+                from windflow_tpu.analysis import ir_audit
+                ir_audit.record_lowered(self.op_name, sig, lowered)
+            except Exception as e:  # lint: broad-except-ok (audit
+                # capture must degrade like cost capture — warn below,
+                # never break dispatch or lose the cost table)
+                capture_err = e
             if COST_MODE == "compiled":
                 compiled = lowered.compile()
                 cost_src = compiled.cost_analysis()
@@ -375,10 +390,30 @@ class WfJit:
                 cost_src = lowered.cost_analysis()
                 if isinstance(cost_src, (list, tuple)):
                     cost_src = cost_src[0] if cost_src else None
-        except Exception:  # lint: broad-except-ok (cost analysis is a
-            # best-effort probe of backend-specific AOT APIs — any failure
-            # must degrade to "no cost table", never break dispatch)
+        except Exception as e:  # lint: broad-except-ok (cost analysis is
+            # a best-effort probe of backend-specific AOT APIs — any
+            # failure must degrade to "no cost table", never break
+            # dispatch)
             cost_src = None
+            capture_err = e
+        if capture_err is not None:
+            # Surface the skip once per op name: a silently-missing cost
+            # table / IR record used to be indistinguishable from a
+            # program that audited clean.
+            warn_capture = False
+            with entry.lock:
+                if not entry.capture_warned:
+                    entry.capture_warned = True
+                    warn_capture = True
+            if warn_capture:
+                warnings.warn(
+                    f"wf_jit('{self.op_name}'): lowering capture failed "
+                    f"({type(capture_err).__name__}: {capture_err}) — "
+                    "this program has no cost table and no IR-audit "
+                    "record (WF_TPU_COST_ANALYSIS="
+                    f"{COST_MODE}); wfir reports it as pending, not "
+                    "clean.  Warning shown once per op.",
+                    RuntimeWarning, stacklevel=2)
         cost = None
         if isinstance(cost_src, dict):
             cost = {"mode": COST_MODE}

@@ -60,6 +60,7 @@ from typing import Dict, List, Optional
 
 import numpy as np
 
+from windflow_tpu.analysis.hotpath import hot_path
 from windflow_tpu.basic import current_time_usecs
 from windflow_tpu.monitoring.recorder import (COLLECTED, DEVICE_DONE,
                                               DISPATCHED, EMITTED,
@@ -173,6 +174,7 @@ class LatencyLedger:
         self.last_verdict: Optional[dict] = None
 
     # -- harvest (cadence only; reads the rings the hot path writes) --------
+    @hot_path
     def harvest(self) -> None:
         """Consume new ring events since the last harvest, then finalize
         every trace whose ``sunk`` arrived.  All rings are drained before
@@ -209,18 +211,23 @@ class LatencyLedger:
                 self._finalize(ev)
                 self._remember_done(trace)
         if len(self._open) > self.MAX_OPEN:
+            # oldest-first (dict insertion order), no snapshot list of
+            # every open trace just to drop a few
             drop = len(self._open) - self.MAX_OPEN
-            for trace in list(self._open)[:drop]:
+            for _ in range(drop):
+                trace = next(iter(self._open))
                 del self._open[trace]
                 self._remember_done(trace)
             self.traces_dropped += drop
 
+    @hot_path
     def _remember_done(self, trace: int) -> None:
         if len(self._done_recent) == self._done_recent.maxlen:
             self._done_set.discard(self._done_recent[0])
         self._done_recent.append(trace)
         self._done_set.add(trace)
 
+    @hot_path
     def _finalize(self, events: list) -> None:
         """Running-max boundary walk: for each stage in pipeline order
         take its LATEST occurrence (the sink-side ``collected`` of a
@@ -251,7 +258,10 @@ class LatencyLedger:
             self.segment_totals[seg] += dt
         self.e2e.add(e2e)
         self.traces_decomposed += 1
-        self._recent.append((e2e, [(o, s, d) for o, s, d, _k in segs]))
+        brief = []
+        for op_name, seg, dt, _shared in segs:
+            brief.append((op_name, seg, dt))
+        self._recent.append((e2e, brief))
 
     # -- freshness gauges (called from sampled-sync sites only) -------------
     def note_window_fire(self, op_name: str, ts, valid,
