@@ -1140,6 +1140,84 @@ def run_bench_latency_slo(platform: str, cfg: dict, jax,
     }
 
 
+def run_bench_tenant(platform: str, cfg: dict, jax) -> dict:
+    """Tenant-plane leg (windflow_tpu/monitoring/tenant_ledger.py,
+    guarded by tools/check_bench_keys.py + check_bench_regress.py): two
+    seeded tenants in ONE process — a Zipf-hot keyed pipeline and a
+    uniform one — with the shared ledger attributing HBM/dispatch/byte
+    totals per tenant.  Reports the reconciliation fraction (attributed
+    staged bytes over process staged bytes — check_bench_keys hard-fails
+    under 0.9), the worst budget pressure, and the ledger's measured
+    self-cost as a share of the run (same <2% stance as the flight
+    recorder and the health watchdog)."""
+    import dataclasses
+
+    import numpy as np
+    import windflow_tpu as wf
+    from windflow_tpu.monitoring.tenant_ledger import default_ledger
+
+    ledger = default_ledger()
+    ledger.reset()
+    CAP, K = 2048, 64
+    n = int(os.environ.get("BENCH_TENANT_TUPLES", str(16 * 2048)))
+    budget = 64 * 1024 * 1024   # generous: pressure stays well under 1
+    total = 0.0
+
+    def leg(tenant: str, prefix: str, keys) -> None:
+        nonlocal total
+        config = dataclasses.replace(
+            wf.default_config, tenant=tenant, hbm_budget_bytes=budget)
+        src = (wf.Source_Builder(
+            lambda: iter({"key": keys(i), "v0": float(i)}
+                         for i in range(n)))
+            .withOutputBatchSize(CAP)
+            .withRecordSpec({"key": np.int32(0), "v0": np.float32(0.0)})
+            .withName(f"{prefix}_src").build())
+        m = (wf.MapTPU_Builder(
+            lambda t: {"key": t["key"], "v0": t["v0"] * 1.5 + 1.0})
+            .withName(f"{prefix}_map").build())
+        w = (wf.Ffat_WindowsTPU_Builder(
+            lambda t: t["v0"], lambda a, b: a + b)
+            .withCBWindows(256, 64)
+            .withKeyBy(lambda t: t["key"]).withMaxKeys(K)
+            .withName(f"{prefix}_win").build())
+        snk = wf.Sink_Builder(lambda r: None) \
+            .withName(f"{prefix}_snk").build()
+        g = wf.PipeGraph(f"bench_tenant_{prefix}",
+                         wf.ExecutionMode.DEFAULT, wf.TimePolicy.INGRESS,
+                         config=config)
+        g.add_source(src).add(m).add(w).add_sink(snk)
+        t0 = time.perf_counter()
+        g.start()
+        while not g.is_done():
+            if not g.step():
+                break
+            g.health_tick()     # ledger tick every sweep, throttled
+        g.wait_end()
+        total += time.perf_counter() - t0
+        g.health_tick()         # final harvest before freeze-at-finalize
+
+    # seeded Zipf-hot keys (key 0 carries ~3/4) vs uniform round-robin
+    leg("tenant_hot", "th", lambda i: 0 if i % 4 else i % K)
+    leg("tenant_uni", "tu", lambda i: i % K)
+
+    sec = ledger.section()
+    pressures = [((t.get("budget") or {}).get("pressure") or 0.0)
+                 for t in (sec.get("tenants") or {}).values()]
+    frac = (sec.get("attributed") or {}).get("staged_fraction")
+    over = sec.get("overhead") or {}
+    return {
+        "tenants": len(sec.get("tenants") or {}),
+        "hbm_attributed_fraction":
+            round(frac, 4) if frac is not None else None,
+        "budget_pressure": round(max(pressures), 6) if pressures else 0.0,
+        "ledger_overhead_pct": round(
+            100.0 * (over.get("collect_ms_total") or 0.0)
+            / (total * 1e3), 3) if total else 0.0,
+        "tuples": 2 * n,
+    }
+
+
 def scaling_step(jax, n: int, K: int, per_chip: int, seed: int = 2):
     """Build one width-``n`` rung of the weak-scaling sweep: the key-sharded
     mesh, the compiled keyed reduce, and its staged inputs.  Shared with the
@@ -1740,6 +1818,19 @@ def main() -> None:
         # check_bench_keys loudly, not kill the bench artifact)
         result["latency_slo_error"] = f"{type(e).__name__}: {e}"[:400]
 
+    # tenant section (windflow_tpu/monitoring/tenant_ledger.py, guarded
+    # by tools/check_bench_keys.py + check_bench_regress.py): two seeded
+    # tenants in one process — check_bench_keys hard-fails when the
+    # ledger attributes under 90% of the process's staged bytes or its
+    # measured self-cost crosses 2% of the run
+    try:
+        result["tenant"] = run_bench_tenant(platform, CONFIGS[platform],
+                                            jax)
+    except Exception as e:  # lint: broad-except-ok (same stance as the
+        # other guarded legs: a tenant-plane regression must fail
+        # check_bench_keys loudly, not kill the bench artifact)
+        result["tenant_error"] = f"{type(e).__name__}: {e}"[:400]
+
     # preflight cost (windflow_tpu/analysis, guarded by
     # tools/check_bench_keys.py): time PipeGraph.check() over the
     # representative e2e pipeline shape so the static-analysis cost every
@@ -2112,6 +2203,7 @@ def main() -> None:
                  "fusion": result.get("fusion"),
                  "latency": result.get("latency"),
                  "latency_slo": result.get("latency_slo"),
+                 "tenant": result.get("tenant"),
                  "preflight": result.get("preflight"),
                  "verify": result.get("verify"),
                  "ir_audit": result.get("ir_audit"),

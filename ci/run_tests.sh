@@ -87,7 +87,7 @@ python -m pytest tests/test_staging.py tests/test_observability.py \
     tests/test_key_compaction.py tests/test_reshard.py \
     tests/test_wire.py tests/test_pallas_kernels.py \
     tests/test_megastep.py tests/test_latency_plane.py \
-    tests/test_ir_audit.py -q -m 'not slow'
+    tests/test_ir_audit.py tests/test_tenant_plane.py -q -m 'not slow'
 python -m pytest tests/ -q -m 'not slow'
 python __graft_entry__.py 8
 BENCH_PLATFORM=cpu BENCH_E2E_TUPLES=131072 python bench.py | tee bench_ci_out.txt

@@ -162,6 +162,8 @@ PALLAS_KEYS = ("kernels_active", "ffat_step_speedup_vs_lax",
                "grouping_speedup", "interpret_mode", "record_mismatch")
 MEGASTEP_KEYS = ("k", "e2e_tup_s", "e2e_floor_tup_s", "speedup_vs_k1",
                  "dispatches_per_batch", "ratio_vs_kernel")
+TENANT_KEYS = ("tenants", "hbm_attributed_fraction", "budget_pressure",
+               "ledger_overhead_pct")
 
 
 def fail(msg: str) -> None:
@@ -211,7 +213,9 @@ def check_source() -> None:
              "Pallas kernels — docs/PERF.md round 14"),
             ("megastep", MEGASTEP_KEYS,
              "megastep executor — docs/PERF.md round 15 / "
-             "docs/OBSERVABILITY.md megastep-in-the-ledger")):
+             "docs/OBSERVABILITY.md megastep-in-the-ledger"),
+            ("tenant", TENANT_KEYS,
+             "tenant plane — docs/OBSERVABILITY.md tenant-plane")):
         missing = [k for k in keys if f'"{k}"' not in src] \
             + ([] if f'"{section}"' in src else [section])
         if missing:
@@ -532,6 +536,31 @@ def check_output(path: str) -> None:
         # environmental failure mode — its absence IS the regression
         fail("bench megastep section absent or errored "
              f"(megastep_error={result.get('megastep_error')!r})")
+    tenant = result.get("tenant")
+    if isinstance(tenant, dict):
+        missing = [k for k in TENANT_KEYS if k not in tenant]
+        if missing:
+            fail(f"'tenant' section missing {missing} from bench "
+                 "output")
+        frac = tenant.get("hbm_attributed_fraction")
+        if not isinstance(frac, (int, float)) or frac < 0.9:
+            # the reconciliation floor (docs/OBSERVABILITY.md tenant
+            # plane): the ledger must attribute at least 90% of the
+            # process's staged device bytes to tenants — under it the
+            # per-tenant numbers are not trustworthy enough to schedule
+            # against
+            fail(f"tenant hbm_attributed_fraction={frac!r} below the "
+                 "0.9 reconciliation floor on the seeded two-tenant "
+                 "leg")
+        ovh = tenant.get("ledger_overhead_pct")
+        if isinstance(ovh, (int, float)) and ovh > 2.0:
+            fail(f"tenant ledger overhead {ovh}% exceeds the 2% budget "
+                 "(docs/OBSERVABILITY.md tenant plane)")
+    else:
+        # the tenant leg is an in-process seeded two-graph run with no
+        # environmental failure mode — its absence IS the regression
+        fail("bench tenant section absent or errored "
+             f"(tenant_error={result.get('tenant_error')!r})")
     ver = result.get("verify")
     if isinstance(ver, dict):
         missing = [k for k in VERIFY_KEYS if k not in ver]

@@ -126,6 +126,13 @@ GUARDED = (
     # this guards the trend.
     ("megastep.e2e_tup_s", True, "megastep.dispersion.rel_spread"),
     ("megastep.speedup_vs_k1", True, "megastep.dispersion.rel_spread"),
+    # tenant plane: the two-tenant leg is SEEDED, so the attributed
+    # fraction is deterministic — any drop means the ledger stopped
+    # reconciling (a new staging path it does not see, or a register
+    # baseline bug), not weather.  HIGHER is better; the hard 0.9 floor
+    # and the 2% overhead budget live in check_bench_keys — this guards
+    # the trend.
+    ("tenant.hbm_attributed_fraction", True, None),
 )
 
 
@@ -176,6 +183,10 @@ def comparable(cur: dict, prev: dict, path: str) -> bool:
             dig(prev, "latency_slo.tuples") \
             and dig(cur, "latency_slo.operating_point") == \
             dig(prev, "latency_slo.operating_point")
+    if path.startswith("tenant."):
+        # the tenant leg is seeded per tuple count (BENCH_TENANT_TUPLES):
+        # a different stream stages different bytes to reconcile
+        return dig(cur, "tenant.tuples") == dig(prev, "tenant.tuples")
     if path.startswith("compaction."):
         # the compaction A/B is seeded per batch width (cfg["cap"]):
         # a different stream shape shifts the hot-set/overflow split

@@ -33,7 +33,8 @@ import sys
 #: mirrors monitoring/health.py (kept literal: this file must not import
 #: the package — the package __init__ imports jax)
 SCHEMA = "wf-postmortem/1"
-STATES = ("OK", "SLO_VIOLATED", "BACKPRESSURED", "STALLED", "FAILED")
+STATES = ("OK", "SLO_VIOLATED", "OVER_BUDGET", "BACKPRESSURED",
+          "STALLED", "FAILED")
 #: mirrors monitoring/latency_ledger.py SEGMENTS
 LATENCY_SEGMENTS = ("staged_to_emitted", "emitted_to_dispatched",
                     "dispatched_to_device_done",
@@ -46,7 +47,8 @@ SECTIONS = ("stats.json", "events.json", "health.json", "device.json",
 #: must not reject a bundle written before they existed (same schema) —
 #: this tool's job is exactly the historical crash bundle
 OPTIONAL_SECTIONS = ("sweep.json", "durability.json", "shard.json",
-                     "reshard.json", "latency.json", "ir_audit.json")
+                     "reshard.json", "latency.json", "ir_audit.json",
+                     "tenant.json")
 #: reshard executor timeline events (windflow_tpu/serving/executor.py)
 RESHARD_EVENTS = ("triggered", "move_keys", "split_hot_key", "admission",
                   "recovered", "scale_down", "move_skipped")
@@ -312,6 +314,62 @@ def validate(bundle: dict) -> None:
                     f"latency.json: slo.verdict attributes "
                     f"{verdict['dominant_op']!r} but that operator has "
                     "no per_op entry")
+    ten = sections.get("tenant.json") or {}
+    if ten.get("enabled") and "error" not in ten:
+        tenants = ten.get("tenants")
+        if not isinstance(tenants, dict):
+            raise BundleError("tenant.json: tenants must be an object")
+        for tname, agg in tenants.items():
+            if not isinstance(agg, dict):
+                raise BundleError(
+                    f"tenant.json: tenant {tname!r} entry is not an "
+                    "object")
+            for key in ("dispatches", "h2d_bytes", "d2h_bytes",
+                        "resident_state_bytes"):
+                v = agg.get(key)
+                if v is not None and (not isinstance(v, int) or v < 0):
+                    raise BundleError(
+                        f"tenant.json: tenant {tname!r} field {key!r} "
+                        f"must be a non-negative integer, got {v!r}")
+            budget = agg.get("budget")
+            if budget is not None:
+                if not isinstance(budget, dict):
+                    raise BundleError(
+                        f"tenant.json: tenant {tname!r} budget is not "
+                        "an object")
+                pressure = budget.get("pressure")
+                if pressure is not None and (
+                        not isinstance(pressure, (int, float))
+                        or pressure < 0):
+                    raise BundleError(
+                        f"tenant.json: tenant {tname!r} budget pressure "
+                        f"{pressure!r} is not a non-negative number")
+                v = budget.get("verdict")
+                if v is not None:
+                    if not isinstance(v, dict) \
+                            or v.get("state") != "OVER_BUDGET":
+                        raise BundleError(
+                            f"tenant.json: tenant {tname!r} verdict "
+                            f"{v!r} must be an object with state "
+                            "OVER_BUDGET")
+                    if v.get("heaviest_op") is not None \
+                            and v["heaviest_op"] \
+                            not in (agg.get("per_op") or {}):
+                        raise BundleError(
+                            f"tenant.json: tenant {tname!r} verdict "
+                            f"attributes {v['heaviest_op']!r} but that "
+                            "operator has no per_op entry")
+        attributed = ten.get("attributed")
+        if attributed is not None:
+            if not isinstance(attributed, dict):
+                raise BundleError(
+                    "tenant.json: attributed must be an object")
+            frac = attributed.get("staged_fraction")
+            if frac is not None and (not isinstance(frac, (int, float))
+                                     or frac < 0):
+                raise BundleError(
+                    f"tenant.json: attributed staged_fraction {frac!r} "
+                    "is not a non-negative number")
 
 
 def diagnose(bundle: dict) -> dict:
@@ -403,6 +461,34 @@ def diagnose(bundle: dict) -> dict:
             "suppressed": irap.get("suppressed"),
             "pending": irap.get("pending") or [],
         }
+    tenp = sections.get("tenant.json") or {}
+    tenancy = None
+    if tenp.get("enabled") and "error" not in tenp:
+        worst = None
+        for tname, agg in (tenp.get("tenants") or {}).items():
+            if not isinstance(agg, dict):
+                continue
+            budget = agg.get("budget") or {}
+            row = {
+                "tenant": tname,
+                "graphs": agg.get("graphs") or [],
+                "resident_state_bytes":
+                    agg.get("resident_state_bytes"),
+                "budget_bytes": budget.get("budget_bytes"),
+                "pressure": budget.get("pressure"),
+                "over_budget": bool(budget.get("active")),
+                "heaviest_op": agg.get("heaviest_op"),
+                "verdict": budget.get("verdict")
+                    or budget.get("last_verdict"),
+            }
+            if worst is None or (row["pressure"] or -1.0) \
+                    > (worst["pressure"] or -1.0):
+                worst = row
+        tenancy = {
+            "tenants_total": len(tenp.get("tenants") or {}),
+            "worst": worst,
+            "attributed": tenp.get("attributed") or {},
+        }
     rsh = sections.get("reshard.json") or {}
     reshard = None
     if rsh.get("enabled") and "error" not in rsh:
@@ -423,6 +509,7 @@ def diagnose(bundle: dict) -> dict:
         "durability": durability,
         "latency": latency,
         "ir_audit": ir_audit,
+        "tenancy": tenancy,
         "reshard": reshard,
         "written_at_usec": manifest.get("written_at_usec"),
         "graph_state": health.get("graph_state"),
@@ -577,6 +664,30 @@ def render_text(d: dict) -> str:
             lines.append(
                 f"    {f.get('code')} [{f.get('severity')}] "
                 f"'{f.get('node')}': {f.get('message')}")
+    if d.get("tenancy"):
+        tn = d["tenancy"]
+        frac = (tn.get("attributed") or {}).get("staged_fraction")
+        lines.append(
+            f"  tenancy: {tn['tenants_total']} tenant(s) in process"
+            + (f", attribution {frac:.0%} of staged bytes"
+               if isinstance(frac, (int, float)) else ""))
+        w = tn.get("worst")
+        if w:
+            n = lambda v: "?" if v is None else v
+            press = w.get("pressure")
+            lines.append(
+                f"    worst pressure: '{w['tenant']}' at "
+                f"{'?' if press is None else f'{press:.2f}x'} "
+                f"({n(w['resident_state_bytes'])} B resident"
+                + (f" / {w['budget_bytes']} B budget"
+                   if w.get("budget_bytes") else "")
+                + (f", heaviest op {w['heaviest_op']}"
+                   if w.get("heaviest_op") else "") + ")")
+            v = w.get("verdict")
+            if v:
+                tag = "OVER BUDGET (latched)" if w["over_budget"] \
+                    else "last verdict"
+                lines.append(f"    {tag}: {v.get('message')}")
     if d.get("reshard"):
         r = d["reshard"]
         lines.append(

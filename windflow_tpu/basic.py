@@ -255,6 +255,34 @@ class Config:
     # plan the adaptive sizer consumes.
     latency_slo_ms: float = float(os.environ.get("WF_TPU_LATENCY_SLO_MS",
                                                  "0"))
+    # Tenant plane (monitoring/tenant_ledger.py, docs/OBSERVABILITY.md
+    # "Tenant plane"): the tenant label this graph's telemetry is
+    # attributed under when N PipeGraphs share one process/mesh (ROADMAP
+    # item 2 — the multi-tenant serving shape).  "" (the default)
+    # resolves to the graph's own app name at build, so single-app
+    # deployments need no configuration; several graphs sharing one
+    # label pool their attribution under one tenant row.
+    tenant: str = os.environ.get("WF_TPU_TENANT", "")
+    # Kill switch for the tenant plane.  On, every graph registers into
+    # the process-level tenant registry at build and the shared ledger
+    # attributes HBM bytes, dispatches/compile wall-ms, H2D/D2H wire
+    # bytes, modeled ICI bytes and latency budget share per tenant — all
+    # read from telemetry the other planes already maintain, only at
+    # monitor/stats cadence (zero per-batch hot-path work).  Off removes
+    # the plane entirely and every call site keeps one `is not None`
+    # check (micro-asserted by tests/test_tenant_plane.py).
+    tenant_ledger: bool = bool(int(os.environ.get("WF_TPU_TENANT_LEDGER",
+                                                  "1")))
+    # Per-tenant HBM budget in bytes (0 = no budget declared).  When
+    # set, the tenant ledger evaluates the tenant's attributed device
+    # bytes against the budget at watchdog cadence; sustained overage
+    # enters a latched OVER_BUDGET health verdict attributed to the
+    # tenant's heaviest op (the SLO_VIOLATED contract applied to
+    # memory), and analysis/tenancy.py + tools/wf_tenant.py turn the
+    # measured pressure into the drain/rescale/throttle plan the PR-20
+    # tenant scheduler consumes.
+    hbm_budget_bytes: int = int(os.environ.get(
+        "WF_TPU_HBM_BUDGET_BYTES", "0"))
     # Sweep ledger (monitoring/sweep_ledger.py, docs/OBSERVABILITY.md):
     # per-operator-hop attribution of jitted dispatches and XLA
     # cost-analysis HBM bytes per staged batch, donation-miss tripwires,

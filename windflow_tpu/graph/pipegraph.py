@@ -135,6 +135,13 @@ class PipeGraph:
         # None leaves one `is not None` check at each cadence/read site
         # and binds nothing to any replica (micro-asserted)
         self._latency = None
+        # tenant plane (monitoring/tenant_ledger.py): this graph's handle
+        # into the PROCESS-level tenant ledger — per-tenant HBM/dispatch/
+        # byte attribution + budget verdicts across every co-resident
+        # PipeGraph, built in _build when Config.tenant_ledger is on;
+        # None leaves one `is not None` check at each cadence/read site
+        # and registers nothing anywhere (micro-asserted)
+        self._tenant = None
         # checkpoint blobs stashed by restore() for the plane to apply
         # after _build (operator state) and before the first source tick
         self._pending_restore = None
@@ -515,6 +522,22 @@ class PipeGraph:
             if self._health is not None:
                 self._health.latency = self._latency
 
+        # 3f''''. tenant plane (monitoring/tenant_ledger.py): register
+        # this graph with the PROCESS-level tenant ledger — built AFTER
+        # every other plane (attribution baselines must see the final
+        # operator/wrapper set, and the ledger reads the shard/latency
+        # planes at collect cadence).  Config.tenant defaults to the app
+        # name; Config.hbm_budget_bytes > 0 arms the budget state
+        # machine whose latched OVER_BUDGET verdict the health plane
+        # paints on the tenant's heaviest op.
+        if getattr(cfg, "tenant_ledger", True):
+            from windflow_tpu.monitoring.tenant_ledger import default_ledger
+            tenant = getattr(cfg, "tenant", "") or self.name
+            self._tenant = default_ledger().register(
+                self, tenant, getattr(cfg, "hbm_budget_bytes", 0))
+            if self._health is not None:
+                self._health.tenant = self._tenant
+
         # 3g. reshard executor (windflow_tpu/serving): built LAST — it
         # discovers the keyed emitters the wiring installed, reads the
         # health plane and shard ledger at tick cadence, and mutates
@@ -859,6 +882,15 @@ class PipeGraph:
         return restore_graph(self, checkpoint_dir)
 
     def _finalize(self, dump: bool = True, aborted: bool = False) -> None:
+        if self._tenant is not None:
+            # freeze this graph's attribution in the process tenant
+            # ledger before teardown, so the tenant roll-up keeps its
+            # history after the replicas are gone (guarded: shutdown
+            # telemetry must never block shutdown)
+            try:
+                self._tenant.freeze()
+            except Exception:  # lint: broad-except-ok (see above)
+                pass
         if self._durability is not None:
             # flush + close the checkpoint store (counters stay readable:
             # stats() reads the cached section fields, not the KV)
@@ -915,6 +947,16 @@ class PipeGraph:
                 # harvest must never take the watchdog down; the
                 # Latency_plane section surfaces the error on read)
                 pass
+        if self._tenant is not None:
+            # budget state machine tick BEFORE the watchdog samples, so
+            # the health verdicts read this tick's OVER_BUDGET latch
+            # (with the ledger off this is the whole cost: one check)
+            try:
+                self._tenant.tick()
+            except Exception:  # lint: broad-except-ok (a telemetry
+                # collect must never take the watchdog down; the Tenant
+                # section surfaces the error on read)
+                pass
         if self._health is not None:
             self._health.sample()
 
@@ -940,6 +982,23 @@ class PipeGraph:
             self._latency.harvest()
             return self._latency.section()
         except Exception as e:  # lint: broad-except-ok (a decomposition
+            # read must never take the pipeline or a stats dump down —
+            # same stance as every other plane section)
+            return {"enabled": True, "error": f"{type(e).__name__}: "
+                                              f"{e}"[:200]}
+
+    def _tenant_section(self) -> dict:
+        """Guarded like the health/latency sections; with
+        ``Config.tenant_ledger`` off this is the whole cost: one
+        check.  Reports the WHOLE process tenant table (every
+        co-resident graph), focused on this graph's row/tenant — one
+        tenant's stats dump is enough for the advisor to plan across
+        tenants."""
+        if self._tenant is None:
+            return {"enabled": False}
+        try:
+            return self._tenant.section()
+        except Exception as e:  # lint: broad-except-ok (an attribution
             # read must never take the pipeline or a stats dump down —
             # same stance as every other plane section)
             return {"enabled": True, "error": f"{type(e).__name__}: "
@@ -1159,6 +1218,9 @@ class PipeGraph:
             # shard-plane cross-reference: per-shard load + hot keys for
             # the operators whose spans this trace carries
             "shard": self._shard_section(),
+            # tenant-plane cross-reference: which tenant this graph's
+            # spans bill to, and the process tenant roll-up at dump time
+            "tenant": self._tenant_section(),
         })
         root, ext = os.path.splitext(path)
         base = root[:-len("_trace")] if root.endswith("_trace") else root
@@ -1240,6 +1302,12 @@ class PipeGraph:
             # and the SLO verdict — the measurement layer the adaptive
             # sizer (analysis/latency.py, tools/wf_slo.py) plans against
             "Latency_plane": self._latency_plane_section(),
+            # tenant plane (monitoring/tenant_ledger.py): per-tenant
+            # HBM/ICI/dispatch attribution + budget verdicts across
+            # every PipeGraph in the process — the measurement layer
+            # the tenant advisor (analysis/tenancy.py, tools/
+            # wf_tenant.py) and PR 20's tenant scheduler plan against
+            "Tenant": self._tenant_section(),
             "Gauges": self.gauges(),
             # health plane (monitoring/health.py): per-operator watchdog
             # verdicts, stall counters + attribution, verdict timeline
@@ -1382,6 +1450,7 @@ class PipeGraph:
         write("shard.json", self._shard_section)
         write("ir_audit.json", self._ir_audit_section)
         write("latency.json", self._latency_plane_section)
+        write("tenant.json", self._tenant_section)
         write("durability.json", self._durability_section)
         write("reshard.json", self._reshard_section)
         write("preflight.json", lambda: {

@@ -52,10 +52,18 @@ from windflow_tpu.basic import current_time_usecs
 #: pre-SLO plane verbatim.
 OK = "OK"
 SLO_VIOLATED = "SLO_VIOLATED"
+#: the tenant plane's budget verdict (monitoring/tenant_ledger.py):
+#: the tenant this operator belongs to holds more resident device state
+#: than Config.hbm_budget_bytes for ENTER_AFTER consecutive ticks.  One
+#: notch above SLO_VIOLATED (memory overage starves co-resident tenants;
+#: a slow pipeline only starves itself) and below BACKPRESSURED — with
+#: no budget declared the state is unreachable and every transition
+#: matches the pre-tenant plane verbatim.
+OVER_BUDGET = "OVER_BUDGET"
 BACKPRESSURED = "BACKPRESSURED"
 STALLED = "STALLED"
 FAILED = "FAILED"
-STATES = (OK, SLO_VIOLATED, BACKPRESSURED, STALLED, FAILED)
+STATES = (OK, SLO_VIOLATED, OVER_BUDGET, BACKPRESSURED, STALLED, FAILED)
 _SEVERITY = {s: i for i, s in enumerate(STATES)}
 
 #: postmortem bundle schema tag (tools/wf_doctor.py validates against it)
@@ -69,7 +77,7 @@ class _OpTrack:
     __slots__ = ("name", "state", "since_usec", "last_advance_usec",
                  "last_inputs", "last_frontier", "queue_depth", "frontier",
                  "compile_storm", "failure", "stall_latched", "hot_shard",
-                 "slo")
+                 "slo", "over_budget")
 
     def __init__(self, name: str, now: int) -> None:
         self.name = name
@@ -95,6 +103,10 @@ class _OpTrack:
         #: latency-ledger attribution when this operator dominates an
         #: active SLO violation (monitoring/latency_ledger.py verdict)
         self.slo: Optional[dict] = None
+        #: tenant-ledger attribution when this operator is the heaviest
+        #: op of a tenant in active budget overage
+        #: (monitoring/tenant_ledger.py verdict)
+        self.over_budget: Optional[dict] = None
 
     def verdict(self, now: int) -> dict:
         v = {
@@ -110,6 +122,8 @@ class _OpTrack:
             v["hot_shard"] = self.hot_shard
         if self.slo is not None:
             v["slo"] = self.slo
+        if self.over_budget is not None:
+            v["over_budget"] = self.over_budget
         return v
 
 
@@ -148,6 +162,13 @@ class HealthPlane:
         #: SLO verdict turns the dominant operator's OK into
         #: SLO_VIOLATED (None = one attribute check per sample)
         self.latency = None
+        #: tenant handle (monitoring/tenant_ledger.GraphTenantHandle),
+        #: bound by PipeGraph._build when Config.tenant_ledger is on;
+        #: its active OVER_BUDGET verdict turns the heaviest operator's
+        #: OK into OVER_BUDGET (None = one attribute check per sample —
+        #: the kill-switch contract, micro-asserted by
+        #: tests/test_tenant_plane.py)
+        self.tenant = None
         #: the jit registry is process-global and never resets: baseline
         #: its per-op recompile counts now so a storm verdict reflects
         #: THIS graph's run, not a prior graph sharing operator names
@@ -168,6 +189,12 @@ class HealthPlane:
         # read of its latest published verdict, not a re-evaluation
         lat = self.latency
         slo_v = lat.verdict if lat is not None and lat.slo_active else None
+        # same stance for the tenant ledger's budget verdict: the ledger
+        # ticks at the same cadence, this is a read of its latest
+        # published verdict (None unless THIS graph holds the tenant's
+        # heaviest op — only that graph paints the verdict)
+        ten = self.tenant
+        ob_v = ten.health_verdict() if ten is not None else None
         with self._lock:
             changes = {}
             for op in self.graph._operators:
@@ -176,7 +203,7 @@ class HealthPlane:
                     track = self._tracks[op.name] = _OpTrack(op.name, now)
                 state = self._evaluate_op(op, track, now,
                                           storms.get(op.name, False),
-                                          slo_v)
+                                          slo_v, ob_v)
                 if state != track.state:
                     track.state = state
                     track.since_usec = now
@@ -210,7 +237,8 @@ class HealthPlane:
         return verdicts
 
     def _evaluate_op(self, op, track: _OpTrack, now: int,
-                     storm: bool, slo_v: Optional[dict] = None) -> str:
+                     storm: bool, slo_v: Optional[dict] = None,
+                     ob_v: Optional[dict] = None) -> str:
         # the queue-depth/min-frontier walk is the graph's (shared with
         # gauges(): the watchdog must judge exactly what the lag gauge
         # reports, or the two drift)
@@ -231,6 +259,7 @@ class HealthPlane:
         track.frontier = frontier
         track.compile_storm = storm
         track.slo = None   # re-attached below only while the violation holds
+        track.over_budget = None   # ditto for the budget verdict
         # hot-shard attribution: the replica holding the deepest backlog
         # (ties broken by the most-lagged frontier) — per-replica reads
         # only, so it works with the shard ledger off too; the ledger's
@@ -261,10 +290,16 @@ class HealthPlane:
             # naming the run's latency story for post-run stats() and
             # postmortem readers (the ledger stops ticking with the
             # graph, so the latch is the final word)
+            state = OK
             if slo_v is not None and slo_v.get("dominant_op") == op.name:
                 track.slo = slo_v
-                return SLO_VIOLATED
-            return OK
+                state = SLO_VIOLATED
+            if ob_v is not None and ob_v.get("heaviest_op") == op.name:
+                # resident state outlives the run — a latched budget
+                # verdict is post-run truth, same as the SLO latch
+                track.over_budget = ob_v
+                state = OVER_BUDGET
+            return state
         if track.stall_latched:
             return STALLED
         if depth > 0 and not advanced \
@@ -282,10 +317,18 @@ class HealthPlane:
         # regardless via the ledger section) — and only the verdict's
         # dominant operator carries the state, so one slow op does not
         # paint the whole graph red
+        state = OK
         if slo_v is not None and slo_v.get("dominant_op") == op.name:
             track.slo = slo_v
-            return SLO_VIOLATED
-        return OK
+            state = SLO_VIOLATED
+        # budget check after SLO: both verdicts attach to their tracks,
+        # and when one operator carries both, OVER_BUDGET (the more
+        # severe state) wins the state slot — the co-resident tenants
+        # it starves are a harder problem than its own latency
+        if ob_v is not None and ob_v.get("heaviest_op") == op.name:
+            track.over_budget = ob_v
+            state = OVER_BUDGET
+        return state
 
     def _recompile_counts(self) -> dict:
         """Summed compile-watcher recompiles per operator.  A registry

@@ -114,7 +114,12 @@ class MonitoringThread:
         except Exception:  # lint: broad-except-ok (crash-path stats()
             # may touch a dead backend; END_APP must still reach the
             # dashboard with whatever payload survives)
+            # the degraded payload still names the tenant, so an aborted
+            # app keeps its attribution on the dashboard's tenant roll-up
             report = {"PipeGraph_name": self.graph.name, "Aborted": True,
+                      "Tenant": {"enabled": False, "tenant":
+                                 getattr(self.graph.config, "tenant", "")
+                                 or self.graph.name},
                       "stats_error": "stats() raised during termination"}
         try:
             self._send_report(TYPE_END_APP, report)

@@ -143,6 +143,14 @@ def _families(stats: dict,
     base = dict(base_labels or {})
     if "app" not in base and stats.get("PipeGraph_name"):
         base["app"] = stats["PipeGraph_name"]
+    # tenant label (monitoring/tenant_ledger.py): every sample of this
+    # report is billed to the graph's tenant — the disambiguator that
+    # keeps two same-topology apps' operator samples apart in the
+    # dashboard's merged multi-app exposition
+    tenant_section = stats.get("Tenant") or {}
+    if "tenant" not in base and isinstance(tenant_section, dict) \
+            and tenant_section.get("tenant"):
+        base["tenant"] = tenant_section["tenant"]
     fams: List[MetricFamily] = []
 
     def fam(name, mtype, help_text) -> MetricFamily:
@@ -226,8 +234,8 @@ def _families(stats: dict,
                        "the active state)")
         for name, v in (health.get("verdicts") or {}).items():
             active = str(v.get("state", "")).lower()
-            for state in ("ok", "slo_violated", "backpressured",
-                          "stalled", "failed"):
+            for state in ("ok", "slo_violated", "over_budget",
+                          "backpressured", "stalled", "failed"):
                 f_health.add(1 if active == state else 0,
                              dict(base, operator=name, state=state))
         fam("wf_stall_events_total", "counter",
@@ -462,6 +470,71 @@ def _families(stats: dict,
             fam("wf_slo_recent_p99_ms", "gauge",
                 "Rolling-window e2e p99 the SLO is judged against") \
                 .add(slo.get("recent_p99_ms", 0), base)
+
+    # -- tenant plane --------------------------------------------------------
+    # per-tenant attribution across every graph in the process
+    # (monitoring/tenant_ledger.py).  Each sample carries the report's
+    # base labels PLUS the ROW's tenant label: the section is the whole
+    # process table, so in a multi-app merge the `app` label keeps the
+    # same tenant's rows from different reports distinct.
+    if tenant_section.get("enabled"):
+        f_thbm = fam("wf_tenant_hbm_bytes", "gauge",
+                     "Resident device state bytes attributed to the "
+                     "tenant (the budget basis)")
+        f_tbud = fam("wf_tenant_hbm_budget_bytes", "gauge",
+                     "Declared per-tenant HBM budget "
+                     "(Config.hbm_budget_bytes)")
+        f_tpr = fam("wf_tenant_budget_pressure", "gauge",
+                    "Resident bytes over budget (1.0 = at budget)")
+        f_tob = fam("wf_tenant_over_budget", "gauge",
+                    "1 while the tenant's latched OVER_BUDGET verdict "
+                    "holds")
+        f_toe = fam("wf_tenant_over_budget_entered_total", "counter",
+                    "OVER_BUDGET episodes entered (sustained overage)")
+        f_tdis = fam("wf_tenant_dispatches_total", "counter",
+                     "Jitted dispatches attributed to the tenant's "
+                     "operators (per-wrapper counters)")
+        f_tcms = fam("wf_tenant_compile_ms_total", "counter",
+                     "Compile wall-ms attributed to the tenant since "
+                     "its graphs registered")
+        f_th2d = fam("wf_tenant_h2d_bytes_total", "counter",
+                     "Host-to-device wire bytes staged by the tenant's "
+                     "graphs")
+        f_td2h = fam("wf_tenant_d2h_bytes_total", "counter",
+                     "Device-to-host bytes fetched by the tenant's "
+                     "sinks")
+        f_tici = fam("wf_tenant_ici_bytes_per_tuple", "gauge",
+                     "Modeled ICI collective bytes per tuple across "
+                     "the tenant's sharded programs (shard ledger)")
+        f_tlat = fam("wf_tenant_latency_share", "gauge",
+                     "Tenant's share of the process's decomposed "
+                     "latency (latency plane; 0..1)")
+        for tname, agg in (tenant_section.get("tenants") or {}).items():
+            if not isinstance(agg, dict):
+                continue
+            lab = dict(base, tenant=tname)
+            f_thbm.add(agg.get("resident_state_bytes", 0), lab)
+            f_tdis.add(agg.get("dispatches", 0), lab)
+            f_tcms.add(agg.get("compile_ms", 0.0), lab)
+            f_th2d.add(agg.get("h2d_bytes", 0), lab)
+            f_td2h.add(agg.get("d2h_bytes", 0), lab)
+            if isinstance(agg.get("ici_bytes_per_tuple"), (int, float)):
+                f_tici.add(agg["ici_bytes_per_tuple"], lab)
+            if isinstance(agg.get("latency_share"), (int, float)):
+                f_tlat.add(agg["latency_share"], lab)
+            budget = agg.get("budget") or {}
+            if budget.get("budget_bytes"):
+                f_tbud.add(budget["budget_bytes"], lab)
+                if isinstance(budget.get("pressure"), (int, float)):
+                    f_tpr.add(budget["pressure"], lab)
+                f_tob.add(1 if budget.get("active") else 0, lab)
+                f_toe.add(budget.get("entered", 0), lab)
+        attributed = tenant_section.get("attributed") or {}
+        if isinstance(attributed.get("staged_fraction"), (int, float)):
+            fam("wf_tenant_attributed_staged_fraction", "gauge",
+                "Tenants' attributed staged bytes over the process "
+                "staged-transfer total (the CI reconciliation gate)") \
+                .add(attributed["staged_fraction"], base)
 
     # -- device plane --------------------------------------------------------
     device = stats.get("Device") or {}

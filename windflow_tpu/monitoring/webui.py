@@ -28,6 +28,7 @@ INDEX_HTML = """<!DOCTYPE html>
   .spark { vertical-align: middle; }
   .hOK { color: #1a7f37; font-weight: 600; }
   .hSLO_VIOLATED { color: #c2571a; font-weight: 600; }
+  .hOVER_BUDGET { color: #8e44ad; font-weight: 600; }
   .hBACKPRESSURED { color: #b8860b; font-weight: 600; }
   .hSTALLED, .hFAILED { color: #c0392b; font-weight: 600; }
   .bud { display: inline-block; width: 60px; height: 9px;
@@ -44,6 +45,7 @@ INDEX_HTML = """<!DOCTYPE html>
 <div id="apps"><h2>Applications</h2><div id="applist">loading…</div></div>
 <div id="main"><h2 id="title">select an application</h2>
   <div id="meta"></div>
+  <div id="tenants"></div>
   <div id="ops"></div>
   <details><summary>graph diagram</summary><div id="diagram"></div></details>
 </div>
@@ -135,6 +137,47 @@ async function render(id) {
     `live_buffers=${live.count ?? "?"} ` +
     `(${((live.bytes || 0) / 1048576).toFixed(1)}MB)  ` +
     `hbm: ${hbm || "(no allocator stats — host-only backend)"}`;
+  // tenant plane (monitoring/tenant_ledger.py): process-wide roll-up —
+  // one row per tenant with a budget bar (resident bytes vs declared
+  // HBM budget; the bar overflows red past 1.0) and the attribution
+  // fraction headline.  Rendered from this app's report, which carries
+  // the WHOLE process table.
+  const tplane = last.Tenant || {};
+  const tEl = document.getElementById("tenants");
+  if (tplane.enabled && tplane.tenants &&
+      Object.keys(tplane.tenants).length) {
+    const frac = (tplane.attributed || {}).staged_fraction;
+    const fmtB = b => b >= 1048576 ? `${(b / 1048576).toFixed(1)}MB`
+      : b >= 1024 ? `${(b / 1024).toFixed(1)}kB` : `${b}B`;
+    tEl.innerHTML =
+      `<table><tr><th>tenant` +
+      `${frac != null ? ` (attributed ${(frac * 100).toFixed(0)}%)`
+                      : ""}</th>` +
+      `<th>graphs</th><th>resident</th><th>budget</th>` +
+      `<th>dispatches</th><th>H2D</th><th>verdict</th></tr>` +
+      Object.entries(tplane.tenants).map(([name, t]) => {
+        const bud = t.budget || {};
+        const pr = bud.pressure;
+        const over = bud.active;
+        const budCell = !bud.budget_bytes ? "–"
+          : `<span class="bud"><div style="width:` +
+            `${Math.round(Math.min(1, pr || 0) * 60)}px` +
+            `${over ? ";background:#c0392b" : ""}"></div></span> ` +
+            `${fmtB(bud.budget_bytes)} (${(pr || 0).toFixed(2)}x)`;
+        const vCell = over
+          ? `<span class="hOVER_BUDGET">OVER_BUDGET</span>` +
+            ` → ${esc((bud.verdict || {}).heaviest_op || "?")}`
+          : "ok";
+        return `<tr><td>${esc(name)}</td>` +
+               `<td>${(t.graphs || []).map(esc).join(", ")}</td>` +
+               `<td>${fmtB(t.resident_state_bytes || 0)}</td>` +
+               `<td>${budCell}</td><td>${t.dispatches ?? 0}</td>` +
+               `<td>${fmtB(t.h2d_bytes || 0)}</td>` +
+               `<td>${vCell}</td></tr>`;
+      }).join("") + "</table>";
+  } else {
+    tEl.innerHTML = "";
+  }
   // per-operator history: throughput (delta Outputs_sent) and
   // watermark-lag gauge between reports
   const hist = {}, lagHist = {};

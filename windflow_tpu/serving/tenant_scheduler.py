@@ -1,0 +1,130 @@
+"""Tenant scheduler (PR-20 stub): consume the tenancy advisor's plan.
+
+The tenant ledger measures (monitoring/tenant_ledger.py), the tenancy
+advisor plans (analysis/tenancy.py), and PR 20's scheduler will ACT —
+the ledger→advisor→executor progression the reshard plane already
+completed (shard_ledger → resharding → ReshardExecutor).  This module
+pins the executor-facing half of that contract NOW so the advisor's
+output shape is load-bearing before the executor exists:
+
+* :meth:`TenantScheduler.ingest` accepts exactly what
+  ``analysis.tenancy.plan(...)`` returns (``advisor: "tenancy/1"``),
+  validates every action against :data:`ACTION_KINDS` and the fields
+  each kind promises, and queues them per tenant.  A malformed plan is
+  rejected loudly (``ValueError``) — PR 20 must not discover contract
+  drift at apply time.
+* :meth:`TenantScheduler.pending` / :meth:`TenantScheduler.section`
+  expose the queue for stats/tests.
+* :meth:`TenantScheduler.apply_next` is the PR-20 seam: today it pops
+  the action, records it on a bounded timeline with ``applied: False``,
+  and returns it — the real executor replaces the body, keeping the
+  signature.  ``throttle_admission`` will reuse the reshard executor's
+  admission machinery; ``drain_shards`` its move path; ``rescale_tenant``
+  the rescale-on-restore path (docs/DURABILITY.md);
+  ``rebalance_hot_tenant`` a placement change.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from typing import Dict, List, Optional
+
+#: the advisor revision this scheduler consumes (tenancy.plan "advisor")
+PLAN_SCHEMA = "tenancy/1"
+
+#: action kind -> the fields analysis.tenancy._actions promises for it
+ACTION_KINDS = {
+    "throttle_admission": ("factor",),
+    "rescale_tenant": ("shed_bytes",),
+    "drain_shards": ("op",),
+    "rebalance_hot_tenant": ("latency_share",),
+}
+
+_TIMELINE_CAP = 64
+
+
+class TenantScheduler:
+    """Process-scoped consumer of tenancy plans (PR-20 executor seam)."""
+
+    def __init__(self) -> None:
+        self._queue: deque = deque()
+        self.plans_ingested = 0
+        self.actions_queued = 0
+        self.rejected_plans = 0
+        self.timeline: deque = deque(maxlen=_TIMELINE_CAP)
+
+    # -- contract ------------------------------------------------------------
+    def ingest(self, plan: dict) -> int:
+        """Validate + queue one advisor plan; returns actions queued.
+        Raises ``ValueError`` on contract drift so PR 20 cannot silently
+        consume a plan shape the advisor no longer emits."""
+        if not isinstance(plan, dict) \
+                or plan.get("advisor") != PLAN_SCHEMA:
+            self.rejected_plans += 1
+            raise ValueError(
+                f"not a {PLAN_SCHEMA} plan: advisor="
+                f"{plan.get('advisor') if isinstance(plan, dict) else plan!r}")
+        tenants = plan.get("tenants")
+        if not isinstance(tenants, list):
+            self.rejected_plans += 1
+            raise ValueError("plan.tenants must be a list")
+        queued = 0
+        for row in tenants:
+            tname = row.get("tenant")
+            for act in row.get("actions") or []:
+                kind = act.get("kind")
+                if kind not in ACTION_KINDS:
+                    self.rejected_plans += 1
+                    raise ValueError(
+                        f"tenant {tname!r}: unknown action kind {kind!r} "
+                        f"(want one of {tuple(ACTION_KINDS)})")
+                for field in ACTION_KINDS[kind]:
+                    if field not in act:
+                        self.rejected_plans += 1
+                        raise ValueError(
+                            f"tenant {tname!r}: {kind} action missing "
+                            f"required field {field!r}")
+                self._queue.append({"tenant": tname, **act})
+                queued += 1
+        self.plans_ingested += 1
+        self.actions_queued += queued
+        return queued
+
+    # -- PR-20 seam ----------------------------------------------------------
+    def apply_next(self) -> Optional[dict]:
+        """Pop + record the next queued action.  PR-20 replaces this
+        body with the real executors; until then every action lands on
+        the timeline with ``applied: False`` so tests (and the eventual
+        executor) see exactly what would have run."""
+        if not self._queue:
+            return None
+        act = self._queue.popleft()
+        entry = dict(act, applied=False)
+        self.timeline.append(entry)
+        return entry
+
+    # -- introspection -------------------------------------------------------
+    def pending(self) -> List[dict]:
+        return list(self._queue)
+
+    def section(self) -> dict:
+        """JSON-able snapshot (future stats()["Tenant_scheduler"])."""
+        return {
+            "schema": PLAN_SCHEMA,
+            "plans_ingested": self.plans_ingested,
+            "rejected_plans": self.rejected_plans,
+            "actions_queued": self.actions_queued,
+            "pending": list(self._queue),
+            "timeline": list(self.timeline),
+        }
+
+
+_default: Optional[TenantScheduler] = None
+
+
+def default_scheduler() -> TenantScheduler:
+    """Process singleton, mirroring tenant_ledger.default_ledger()."""
+    global _default
+    if _default is None:
+        _default = TenantScheduler()
+    return _default
