@@ -87,7 +87,8 @@ python -m pytest tests/test_staging.py tests/test_observability.py \
     tests/test_key_compaction.py tests/test_reshard.py \
     tests/test_wire.py tests/test_pallas_kernels.py \
     tests/test_megastep.py tests/test_latency_plane.py \
-    tests/test_ir_audit.py tests/test_tenant_plane.py -q -m 'not slow'
+    tests/test_ir_audit.py tests/test_tenant_plane.py \
+    tests/test_calibration.py -q -m 'not slow'
 python -m pytest tests/ -q -m 'not slow'
 python __graft_entry__.py 8
 BENCH_PLATFORM=cpu BENCH_E2E_TUPLES=131072 python bench.py | tee bench_ci_out.txt
@@ -100,6 +101,14 @@ rm -f bench_ci_out.txt
 # (warns locally); the bench leg above just appended the run under
 # judgment
 CI="${CI:-1}" python tools/check_bench_regress.py
+# calibration gate: probe the CI backend, then verify the written store
+# is fresh + valid for THIS device kind (exit 1 = stale/corrupt/missing,
+# exit 2 = kill switch set — CI must never silently run uncalibrated
+# while claiming otherwise).  The store is CI-local scratch, not an
+# artifact: production stores come from `wf_calibrate` on real chips.
+python tools/wf_calibrate.py --out /tmp/wf_ci_calibration.json
+python tools/wf_calibrate.py --check /tmp/wf_ci_calibration.json
+rm -f /tmp/wf_ci_calibration.json
 # host worker-pool smoke (reduced size; reports pool overhead on 1 core)
 BENCH_HOST_TUPLES=4000 BENCH_HOST_VEC=2048 BENCH_HOST_REPS=1 python bench_host.py
 # nightly leg (CI_NIGHTLY=1): the slow-marked tail — the RSS soaks, the

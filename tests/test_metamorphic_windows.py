@@ -97,8 +97,14 @@ def _run(family, wt, mode, rnd):
 
 
 @pytest.mark.parametrize("wt", ["cb", "tb"])
+# the ffat_tpu cells are the two slowest of the sweep (~4-5s each: four
+# full device runs apiece); they ride the nightly leg (calibration-round
+# headroom pass) — tier-1 keeps the device operator covered against the
+# oracle in test_ffat_spec_sweep and record-for-record in test_windows
 @pytest.mark.parametrize("family", ["keyed", "parallel", "paned",
-                                    "mapreduce", "ffat_host", "ffat_tpu"])
+                                    "mapreduce", "ffat_host",
+                                    pytest.param("ffat_tpu",
+                                                 marks=pytest.mark.slow)])
 def test_window_sweep(family, wt):
     # Device operators are DEFAULT-mode only, exactly as the reference's
     # GPU builders reject non-DEFAULT modes (SURVEY.md §2.5 invariants).
@@ -117,6 +123,7 @@ def test_window_sweep(family, wt):
                 assert got == oracle, (family, wt, mode, got, oracle)
 
 
+@pytest.mark.slow   # 3 full merge+split DAG runs (~6s): nightly leg
 def test_merge_and_split_with_tpu_window_stage():
     """One DAG combining graph-level MERGE and SPLIT with a device window
     stage: two sources merge, a MapTPU transforms, a split sends even keys

@@ -48,6 +48,7 @@ def test_stateful_map_running_sum_exact(par):
         seen[k] = v
 
 
+@pytest.mark.slow   # parallelism x batch soak (~6s): nightly leg (calibration-round headroom pass)
 def test_stateful_map_metamorphic_totals():
     """Varying parallelism/batch size must reproduce identical per-key final
     totals (positive values: max running sum == total)."""
@@ -81,7 +82,10 @@ def test_stateful_map_metamorphic_totals():
     assert reference == totals
 
 
-@pytest.mark.parametrize("par", [1, 2, 3])
+# par=1 (serial) vs par=2 (parallel replicas) are the two distinct
+# ordering behaviors; the par=3 cell (~5s) rides the nightly leg
+@pytest.mark.parametrize("par", [1, 2,
+                                 pytest.param(3, marks=pytest.mark.slow)])
 def test_stateful_filter_first_n_per_key(par):
     """Keep only the first 3 tuples of each key — a pure state-dependent,
     order-sensitive predicate; state updates must apply even for dropped

@@ -6,6 +6,8 @@ costs about the same as a uniform one."""
 
 import time
 
+import pytest
+
 import windflow_tpu as wf
 
 
@@ -68,6 +70,7 @@ def test_assoc_running_sum_matches_wavefront():
         assert sorted(got) == sorted(_oracle(records))
 
 
+@pytest.mark.slow   # 16k-capacity timing VERDICT (~6s): nightly leg; the fast assoc A/B above keeps tier-1 coverage
 def test_assoc_single_hot_key_no_skew_penalty():
     """All tuples share ONE key at a large capacity: the wavefront would run
     `capacity` sequential sweeps; the associative scan must stay within ~2x

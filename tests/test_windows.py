@@ -338,6 +338,7 @@ def test_ffat_tpu_tb():
         assert (acc.count, acc.total) == exp, f"batch={batch}"
 
 
+@pytest.mark.slow   # ring-policy soak: nightly leg (calibration-round headroom pass)
 def test_ffat_tpu_tb_small_ring_and_lateness():
     """A tight pane ring still produces exact results when batches arrive in
     order (ring >= window span + batch time spread), and lateness delays
@@ -425,6 +426,7 @@ def test_ffat_tpu_tb_out_of_order():
     assert st["Late_tuples_dropped"] == 0
 
 
+@pytest.mark.slow   # ring-policy soak: nightly leg (calibration-round headroom pass)
 def test_ffat_tpu_tb_watermark_jump():
     """An idle gap far wider than the pane ring (watermark jumps hundreds of
     panes between batches): pre-gap windows fire exactly before the ring
@@ -541,6 +543,7 @@ def test_ffat_tpu_tb_late_drops_counted():
     assert on_time_ok > 0.8 * len(exp_on_time)
 
 
+@pytest.mark.slow   # ring-policy soak: nightly leg (calibration-round headroom pass)
 def test_ffat_tpu_tb_overflow_policies():
     """TB ring overflow (one batch spanning far more panes than the ring):
     'drop' (default) suppresses windows that lost data and counts them —
@@ -620,6 +623,7 @@ def test_ffat_tpu_tb_forward_parallelism_rejected():
     assert got == exp
 
 
+@pytest.mark.slow   # ring-policy soak: nightly leg (calibration-round headroom pass)
 def test_ffat_tpu_tb_ring_regrows_on_overflow():
     """An auto-sized TB pane ring whose first batch under-represents the
     steady state (dense burst, then 1 tuple per pane) must GROW to the
@@ -663,6 +667,7 @@ def test_ffat_tpu_tb_ring_regrows_on_overflow():
         assert got.get(w) == 4, (w, got.get(w))
 
 
+@pytest.mark.slow   # ring-policy soak: nightly leg (calibration-round headroom pass)
 def test_ffat_tpu_tb_auto_ring_error_policy_grows_not_raises():
     """overflow_policy='error' with an AUTO-sized ring: the preemptive
     span regrow resizes before anything could evict, so the policy never
@@ -740,6 +745,7 @@ def test_ffat_tpu_sum_combiner_tb_scatter_add_path():
         assert got == exp, (declare, len(got), len(exp))
 
 
+@pytest.mark.slow   # ring-policy soak: nightly leg (calibration-round headroom pass)
 def test_ffat_tpu_tb_ring_grows_under_merged_channel_lag():
     """The fuzz-found eviction class (r5, 5000-tuple soak seeds
     8019/8034) distilled: two merged sources where one runs ~200 panes

@@ -129,6 +129,19 @@ realized savings of the whole-chain fusion executor
 (windflow_tpu/fusion).  Guarded here identically — the section ships
 (zeroed) even under the WF_TPU_FUSE=0 kill switch, so its absence is a
 bench regression, not a configuration.
+
+Since the calibration round the bench also stamps every result with
+``backend``/``device_kind``/``jax_version`` and publishes a
+``calibration`` section (the provenance summary: which constants the
+modeled numbers were computed from, and whether a calibration store
+replaced the defaults — docs/OBSERVABILITY.md "Calibration plane").
+Provenance is also a HARD honesty gate here: every provenance tag in
+the output must come from the measured/modeled/calibrated(age)/
+interpret vocabulary, and a run stamped ``backend == "tpu"`` whose
+pallas section still reports ``interpret_mode`` true is lying about
+its numbers — the TPU acceptance leg (``tpu_acceptance``: the ROADMAP
+item-1 criteria next to their measured values) must never be fed by
+the interpreter.
 """
 
 import json
@@ -159,11 +172,26 @@ COMPACTION_KEYS = ("speedup_vs_sorted", "hit_rate", "overflow_share",
 RESHARD_KEYS = ("plan_apply_ms", "rescale_restore_ms", "keys_moved",
                 "post_reshard_imbalance")
 PALLAS_KEYS = ("kernels_active", "ffat_step_speedup_vs_lax",
-               "grouping_speedup", "interpret_mode", "record_mismatch")
+               "grouping_speedup", "interpret_mode", "record_mismatch",
+               "provenance")
 MEGASTEP_KEYS = ("k", "e2e_tup_s", "e2e_floor_tup_s", "speedup_vs_k1",
                  "dispatches_per_batch", "ratio_vs_kernel")
 TENANT_KEYS = ("tenants", "hbm_attributed_fraction", "budget_pressure",
                "ledger_overhead_pct")
+CALIBRATION_KEYS = ("schema", "enabled", "constants")
+STAMP_KEYS = ("backend", "device_kind", "jax_version")
+TPU_ACCEPTANCE_KEYS = ("grouping_speedup", "grouping_speedup_target",
+                       "grouping_speedup_met", "e2e_wire_bytes_per_tuple",
+                       "ici_bytes_per_tuple", "megastep_ratio_vs_kernel",
+                       "interpret_mode")
+# the full provenance vocabulary (docs/OBSERVABILITY.md "Calibration
+# plane"): three fixed tags plus the age-stamped calibrated(...) form
+PROVENANCE_FIXED = ("measured", "modeled", "interpret")
+
+
+def legal_provenance(tag) -> bool:
+    return tag in PROVENANCE_FIXED or (
+        isinstance(tag, str) and tag.startswith("calibrated("))
 
 
 def fail(msg: str) -> None:
@@ -215,7 +243,17 @@ def check_source() -> None:
              "megastep executor — docs/PERF.md round 15 / "
              "docs/OBSERVABILITY.md megastep-in-the-ledger"),
             ("tenant", TENANT_KEYS,
-             "tenant plane — docs/OBSERVABILITY.md tenant-plane")):
+             "tenant plane — docs/OBSERVABILITY.md tenant-plane"),
+            # the calibration section's inner keys come from
+            # provenance_summary() (not bench.py literals) — the static
+            # pass guards the section name + the hardware stamp;
+            # check_output validates the summary's shape dynamically
+            ("calibration", STAMP_KEYS,
+             "calibration plane — docs/OBSERVABILITY.md "
+             "calibration-plane"),
+            ("tpu_acceptance", TPU_ACCEPTANCE_KEYS,
+             "TPU acceptance leg — ROADMAP item 1 / "
+             "docs/OBSERVABILITY.md calibration-plane")):
         missing = [k for k in keys if f'"{k}"' not in src] \
             + ([] if f'"{section}"' in src else [section])
         if missing:
@@ -614,6 +652,60 @@ def check_output(path: str) -> None:
         # analysis regression this guard exists to catch
         fail("bench preflight timing absent or errored "
              f"(preflight_error={result.get('preflight_error')!r})")
+    for k in STAMP_KEYS:
+        if not result.get(k):
+            # an unstamped result can be diffed against any hardware's
+            # history — check_bench_regress's comparability gate needs
+            # the stamp to refuse cross-hardware comparisons
+            fail(f"bench result missing the {k!r} hardware stamp "
+                 "(docs/OBSERVABILITY.md calibration plane)")
+    calib = result.get("calibration")
+    if isinstance(calib, dict):
+        missing = [k for k in CALIBRATION_KEYS if k not in calib]
+        if missing:
+            fail(f"'calibration' section missing {missing} from bench "
+                 "output")
+        for key, slot in (calib.get("constants") or {}).items():
+            tag = (slot or {}).get("provenance") \
+                if isinstance(slot, dict) else None
+            if not legal_provenance(tag):
+                fail(f"calibration constant {key!r} carries illegal "
+                     f"provenance {tag!r} — the vocabulary is "
+                     "measured/modeled/calibrated(age)/interpret")
+    else:
+        # the provenance summary is pure-host bookkeeping with no
+        # environmental failure mode — its absence IS the regression
+        fail("bench calibration section absent or errored "
+             f"(calibration_error={result.get('calibration_error')!r})")
+    if pal.get("provenance") is not None \
+            and not legal_provenance(pal["provenance"]):
+        fail(f"pallas provenance {pal['provenance']!r} is not in the "
+             "measured/modeled/calibrated(age)/interpret vocabulary")
+    if result.get("backend") == "tpu":
+        # the honesty gate: a TPU-stamped row whose kernel timings came
+        # from the Pallas interpreter is not a TPU measurement — the
+        # fallback must never masquerade as acceptance evidence
+        if pal.get("interpret_mode"):
+            fail("result stamped backend=tpu but the pallas section "
+                 "ran under the interpreter (interpret_mode=true) — "
+                 "interpreter timings must never be recorded as TPU "
+                 "measurements")
+        acc = result.get("tpu_acceptance")
+        if not isinstance(acc, dict):
+            fail("backend=tpu result has no 'tpu_acceptance' section "
+                 "(ROADMAP item 1 acceptance numbers)")
+        missing = [k for k in TPU_ACCEPTANCE_KEYS if k not in acc]
+        if missing:
+            fail(f"'tpu_acceptance' section missing {missing} from "
+                 "bench output")
+        if acc.get("interpret_mode"):
+            fail("tpu_acceptance leg claims interpret-mode numbers — "
+                 "acceptance evidence must be compiled-chip measurements")
+        for k in ("grouping_provenance", "wire_provenance",
+                  "ici_provenance", "megastep_provenance"):
+            if k in acc and not legal_provenance(acc[k]):
+                fail(f"tpu_acceptance {k}={acc[k]!r} is not a legal "
+                     "provenance tag")
     if isinstance(result.get("e2e"), dict):
         missing = [k for k in ("e2e_p50_ms", "e2e_p99_ms") if k not in lat]
         if missing:

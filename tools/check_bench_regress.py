@@ -149,6 +149,15 @@ def comparable(cur: dict, prev: dict, path: str) -> bool:
     the same tuple count (CI runs the bench reduced via
     BENCH_E2E_TUPLES; comparing a 131k-tuple run against a 4M-tuple
     round would trip on configuration, not performance)."""
+    # hardware gate first (docs/OBSERVABILITY.md "Calibration plane"):
+    # rows recorded on different backends or device kinds measure
+    # different machines, whatever the leg.  A MISSING stamp is a
+    # wildcard — history predating the stamp stays comparable; only a
+    # PRESENT-and-different stamp refuses.
+    for stamp in ("backend", "device_kind"):
+        a, b = cur.get(stamp), prev.get(stamp)
+        if a is not None and b is not None and a != b:
+            return False
     if path.startswith(("e2e.", "e2e_device_source.", "latency.e2e")):
         leg = "e2e_device_source" if path.startswith("e2e_device_source") \
             else "e2e"

@@ -33,8 +33,18 @@ import sys
 #: mirrors monitoring/health.py (kept literal: this file must not import
 #: the package — the package __init__ imports jax)
 SCHEMA = "wf-postmortem/1"
-STATES = ("OK", "SLO_VIOLATED", "OVER_BUDGET", "BACKPRESSURED",
-          "STALLED", "FAILED")
+STATES = ("OK", "ROOFLINE_DEGRADED", "SLO_VIOLATED", "OVER_BUDGET",
+          "BACKPRESSURED", "STALLED", "FAILED")
+#: mirrors monitoring/calibration.py (SCHEMA + the provenance
+#: vocabulary — calibrated tags carry an age suffix, e.g.
+#: "calibrated(3h)")
+CALIBRATION_SCHEMA = "wf-calibration/1"
+PROVENANCE_FIXED = ("measured", "modeled", "interpret")
+
+
+def _legal_provenance(tag) -> bool:
+    return tag in PROVENANCE_FIXED or (
+        isinstance(tag, str) and tag.startswith("calibrated("))
 #: mirrors monitoring/latency_ledger.py SEGMENTS
 LATENCY_SEGMENTS = ("staged_to_emitted", "emitted_to_dispatched",
                     "dispatched_to_device_done",
@@ -48,7 +58,7 @@ SECTIONS = ("stats.json", "events.json", "health.json", "device.json",
 #: this tool's job is exactly the historical crash bundle
 OPTIONAL_SECTIONS = ("sweep.json", "durability.json", "shard.json",
                      "reshard.json", "latency.json", "ir_audit.json",
-                     "tenant.json")
+                     "tenant.json", "roofline.json", "calibration.json")
 #: reshard executor timeline events (windflow_tpu/serving/executor.py)
 RESHARD_EVENTS = ("triggered", "move_keys", "split_hot_key", "admission",
                   "recovered", "scale_down", "move_skipped")
@@ -370,6 +380,72 @@ def validate(bundle: dict) -> None:
                 raise BundleError(
                     f"tenant.json: attributed staged_fraction {frac!r} "
                     "is not a non-negative number")
+    calib = sections.get("calibration.json") or {}
+    if calib and "error" not in calib:
+        if calib.get("schema") != CALIBRATION_SCHEMA:
+            raise BundleError(
+                f"calibration.json: schema {calib.get('schema')!r} "
+                f"(want {CALIBRATION_SCHEMA!r})")
+        consts = calib.get("constants")
+        if not isinstance(consts, dict):
+            raise BundleError(
+                "calibration.json: constants must be an object")
+        for key, slot in consts.items():
+            if not isinstance(slot, dict):
+                raise BundleError(
+                    f"calibration.json: constant {key!r} entry is not "
+                    "an object")
+            v = slot.get("value")
+            if not isinstance(v, (int, float)) or v < 0:
+                raise BundleError(
+                    f"calibration.json: constant {key!r} value {v!r} is "
+                    "not a non-negative number")
+            if not _legal_provenance(slot.get("provenance")):
+                raise BundleError(
+                    f"calibration.json: constant {key!r} provenance "
+                    f"{slot.get('provenance')!r} is not in the "
+                    "measured/modeled/calibrated(age)/interpret "
+                    "vocabulary")
+    rfl = sections.get("roofline.json") or {}
+    if rfl.get("enabled") and "error" not in rfl:
+        per_hop = rfl.get("per_hop")
+        if not isinstance(per_hop, dict):
+            raise BundleError("roofline.json: per_hop must be an object")
+        for op, hop in per_hop.items():
+            if not isinstance(hop, dict):
+                raise BundleError(
+                    f"roofline.json: hop {op!r} entry is not an object")
+            for key in ("achieved_tuples_per_sec", "bytes_per_tuple",
+                        "ratio_vs_roofline"):
+                v = hop.get(key)
+                if v is not None and (not isinstance(v, (int, float))
+                                      or v < 0):
+                    raise BundleError(
+                        f"roofline.json: hop {op!r} field {key!r} "
+                        f"{v!r} is not a non-negative number")
+            prov = hop.get("bytes_per_tuple_provenance")
+            if prov is not None and not _legal_provenance(prov):
+                raise BundleError(
+                    f"roofline.json: hop {op!r} bytes provenance "
+                    f"{prov!r} is not a legal tag")
+        if not _legal_provenance(rfl.get("bandwidth_provenance")):
+            raise BundleError(
+                f"roofline.json: bandwidth_provenance "
+                f"{rfl.get('bandwidth_provenance')!r} is not a legal "
+                "tag")
+        v = rfl.get("verdict")
+        if v is not None:
+            if not isinstance(v, dict) \
+                    or v.get("state") != "ROOFLINE_DEGRADED":
+                raise BundleError(
+                    f"roofline.json: verdict {v!r} must be an object "
+                    "with state ROOFLINE_DEGRADED")
+            if v.get("dominant_op") is not None \
+                    and v["dominant_op"] not in per_hop:
+                raise BundleError(
+                    f"roofline.json: verdict attributes "
+                    f"{v['dominant_op']!r} but that hop has no per_hop "
+                    "entry")
 
 
 def diagnose(bundle: dict) -> dict:
@@ -489,6 +565,44 @@ def diagnose(bundle: dict) -> dict:
             "worst": worst,
             "attributed": tenp.get("attributed") or {},
         }
+    calp = sections.get("calibration.json") or {}
+    calibration = None
+    if calp and "error" not in calp:
+        consts = calp.get("constants") or {}
+        calibration = {
+            "enabled": bool(calp.get("enabled")),
+            "source": calp.get("source"),
+            "device_kind": calp.get("device_kind"),
+            "calibrated_constants": sorted(
+                k for k, s in consts.items()
+                if isinstance(s, dict)
+                and str(s.get("provenance", "")).startswith("calibrated(")),
+            "modeled_constants": sorted(
+                k for k, s in consts.items()
+                if isinstance(s, dict)
+                and s.get("provenance") == "modeled"),
+        }
+    rflp = sections.get("roofline.json") or {}
+    roofline = None
+    if rflp.get("enabled") and "error" not in rflp:
+        worst_hop = None
+        for op, hop in (rflp.get("per_hop") or {}).items():
+            if not isinstance(hop, dict):
+                continue
+            ratio = hop.get("ratio_vs_roofline")
+            if ratio is None:
+                continue
+            if worst_hop is None or ratio < worst_hop["ratio"]:
+                worst_hop = {"op": op, "ratio": ratio,
+                             "achieved_tuples_per_sec":
+                                 hop.get("achieved_tuples_per_sec")}
+        roofline = {
+            "hops": len(rflp.get("per_hop") or {}),
+            "dominant_op": rflp.get("dominant_op"),
+            "bandwidth_provenance": rflp.get("bandwidth_provenance"),
+            "worst_hop": worst_hop,
+            "verdict": rflp.get("verdict") or rflp.get("last_verdict"),
+        }
     rsh = sections.get("reshard.json") or {}
     reshard = None
     if rsh.get("enabled") and "error" not in rsh:
@@ -510,6 +624,8 @@ def diagnose(bundle: dict) -> dict:
         "latency": latency,
         "ir_audit": ir_audit,
         "tenancy": tenancy,
+        "calibration": calibration,
+        "roofline": roofline,
         "reshard": reshard,
         "written_at_usec": manifest.get("written_at_usec"),
         "graph_state": health.get("graph_state"),
@@ -688,6 +804,34 @@ def render_text(d: dict) -> str:
                 tag = "OVER BUDGET (latched)" if w["over_budget"] \
                     else "last verdict"
                 lines.append(f"    {tag}: {v.get('message')}")
+    if d.get("calibration"):
+        c = d["calibration"]
+        cal = c.get("calibrated_constants") or []
+        mod = c.get("modeled_constants") or []
+        lines.append(
+            "  calibration: "
+            + (f"store '{c['source']}' for {c.get('device_kind') or '?'}"
+               if c.get("enabled") else "no store loaded")
+            + f" — {len(cal)} calibrated / {len(mod)} modeled constant(s)")
+        if cal:
+            lines.append(f"    calibrated: {', '.join(cal)}")
+    if d.get("roofline"):
+        r = d["roofline"]
+        lines.append(
+            f"  roofline: {r['hops']} fused hop(s) tracked "
+            f"(bandwidth {r.get('bandwidth_provenance') or '?'})"
+            + (f", dominant op '{r['dominant_op']}'"
+               if r.get("dominant_op") else ""))
+        w = r.get("worst_hop")
+        if w and isinstance(w.get("ratio"), (int, float)):
+            lines.append(
+                f"    lowest ratio vs roofline: '{w['op']}' at "
+                f"{w['ratio']:.3f}")
+        v = r.get("verdict")
+        if v:
+            lines.append(
+                f"    ROOFLINE DEGRADED: '{v.get('dominant_op')}' at "
+                f"{v.get('ratio_vs_baseline')}x of trailing baseline")
     if d.get("reshard"):
         r = d["reshard"]
         lines.append(

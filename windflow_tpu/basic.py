@@ -492,6 +492,35 @@ class Config:
     # path).  0 (default) records scale-down candidates without acting.
     reshard_scale_down_ticks: int = int(os.environ.get(
         "WF_TPU_RESHARD_SCALE_DOWN_TICKS", "0"))
+    # Calibration store (monitoring/calibration.py, tools/wf_calibrate.py,
+    # docs/OBSERVABILITY.md "Calibration plane"): path of a versioned
+    # calibration.json (probe-measured values for the modeled constants:
+    # ICI B/s, H2D tunnel B/s, HBM B/s, dispatch overhead, sampled-sync
+    # cost, kernel step time) keyed by device kind + jax version.  When
+    # set, the shard ledger's ICI model, the tenant ledger, the live
+    # roofline, and bench's gap_diagnosis compute from the calibrated
+    # constants and their provenance tags flip `modeled` →
+    # `calibrated(<age>)`; stale past WF_TPU_CALIBRATION_TTL_S (default
+    # 7 days) or a device-kind mismatch degrades back to `modeled` with
+    # a one-time warning.  "" (default) runs uncalibrated;
+    # WF_TPU_CALIBRATION=0 is the kill switch — no store loads anywhere
+    # and every read site keeps one `is not None` check (micro-asserted
+    # by tests/test_calibration.py).
+    calibration: str = os.environ.get("WF_TPU_CALIBRATION", "")
+    # Live roofline plane (monitoring/calibration.RooflineLedger): the
+    # bench-only roofline decomposition as a monitor-cadence gauge —
+    # per-hop achieved tup/s (deltas over counters the replicas already
+    # keep; zero per-batch work) joined with the sweep ledger's
+    # bytes/tuple and the calibrated bandwidth into stats()["Roofline"]
+    # + wf_roofline_* OpenMetrics families, plus a latched advisory
+    # ROOFLINE_DEGRADED health verdict when the dominant hop's
+    # throughput collapses vs its own trailing baseline (the SLO
+    # plane's enter/latch/clear hysteresis).  Requires the sweep ledger
+    # for the bytes join (rates-only without it).  WF_TPU_ROOFLINE=0
+    # removes the plane: no ledger attaches and each call site keeps
+    # one `is not None` check (micro-asserted).
+    roofline_plane: bool = bool(int(os.environ.get("WF_TPU_ROOFLINE",
+                                                   "1")))
     # Multi-chip execution: a jax.sharding.Mesh with ("data", "key") axes
     # (see windflow_tpu.parallel.mesh.make_mesh).  When set, staging emitters
     # lay batches out data-sharded across the mesh and mesh-aware TPU

@@ -52,6 +52,18 @@ def _staging_pool_stats() -> dict:
     return staging.default_pool().stats()
 
 
+def _calibration_summary() -> dict:
+    """Provenance frame of every modeled constant (monitoring/
+    calibration.py), for dump_trace metadata and the postmortem's
+    calibration.json — guarded like every other telemetry read."""
+    try:
+        from windflow_tpu.monitoring import calibration
+        return calibration.provenance_summary()
+    except Exception as e:  # lint: broad-except-ok (a provenance read
+        # must never take a trace dump or postmortem down)
+        return {"error": f"{type(e).__name__}: {e}"[:200]}
+
+
 def _rss_kb() -> float:
     """Resident set size in KiB (reference ``get_MemUsage``,
     ``monitoring.hpp:52-70``)."""
@@ -142,6 +154,13 @@ class PipeGraph:
         # None leaves one `is not None` check at each cadence/read site
         # and registers nothing anywhere (micro-asserted)
         self._tenant = None
+        # roofline plane (monitoring/calibration.RooflineLedger): the
+        # live achieved-vs-roofline gauge + the advisory
+        # ROOFLINE_DEGRADED verdict, built in _build when
+        # Config.roofline_plane is on; None leaves one `is not None`
+        # check at each cadence/read site and reads no counter anywhere
+        # (micro-asserted by tests/test_calibration.py)
+        self._roofline = None
         # checkpoint blobs stashed by restore() for the plane to apply
         # after _build (operator state) and before the first source tick
         self._pending_restore = None
@@ -537,6 +556,32 @@ class PipeGraph:
                 self, tenant, getattr(cfg, "hbm_budget_bytes", 0))
             if self._health is not None:
                 self._health.tenant = self._tenant
+
+        # 3f'''''. calibration store + roofline plane (monitoring/
+        # calibration.py): Config.calibration installs the probe-measured
+        # constants process-wide (the shard ICI model, the tenant
+        # ledger, gap_diagnosis, and the roofline ceiling all read
+        # through calibration.constant — their provenance tags flip
+        # `modeled` → `calibrated(<age>)`), and the RooflineLedger turns
+        # the replicas' existing throughput counters into the live
+        # achieved-vs-roofline gauge at monitor cadence.  Built after
+        # the sweep/tenant planes (the bytes join reads the sweep
+        # section) and before the reshard executor.
+        from windflow_tpu.monitoring import calibration as _calib
+        if getattr(cfg, "calibration", "") and not _calib.killed():
+            try:
+                _calib.set_default_store(_calib.load(cfg.calibration))
+            except Exception as e:  # lint: broad-except-ok (a corrupt
+                # store must degrade the process to its modeled
+                # defaults with a warning, never fail graph build)
+                import warnings as _w
+                _w.warn(f"Config.calibration={cfg.calibration!r} failed "
+                        f"to load ({e}) — running uncalibrated",
+                        RuntimeWarning)
+        if getattr(cfg, "roofline_plane", True):
+            self._roofline = _calib.RooflineLedger(self)
+            if self._health is not None:
+                self._health.roofline = self._roofline
 
         # 3g. reshard executor (windflow_tpu/serving): built LAST — it
         # discovers the keyed emitters the wiring installed, reads the
@@ -957,6 +1002,16 @@ class PipeGraph:
                 # collect must never take the watchdog down; the Tenant
                 # section surfaces the error on read)
                 pass
+        if self._roofline is not None:
+            # roofline rate tick BEFORE the watchdog samples, so the
+            # health verdicts read this tick's collapse latch (with the
+            # plane off this is the whole cost: one check)
+            try:
+                self._roofline.tick()
+            except Exception:  # lint: broad-except-ok (a telemetry
+                # rate read must never take the watchdog down; the
+                # Roofline section surfaces the error on read)
+                pass
         if self._health is not None:
             self._health.sample()
 
@@ -999,6 +1054,22 @@ class PipeGraph:
         try:
             return self._tenant.section()
         except Exception as e:  # lint: broad-except-ok (an attribution
+            # read must never take the pipeline or a stats dump down —
+            # same stance as every other plane section)
+            return {"enabled": True, "error": f"{type(e).__name__}: "
+                                              f"{e}"[:200]}
+
+    def _roofline_section(self) -> dict:
+        """Guarded like the health/latency/tenant sections; with
+        ``Config.roofline_plane`` off this is the whole cost: one
+        check.  Ticks once before reading so a headless ``stats()``
+        call sees current rates without a monitor thread."""
+        if self._roofline is None:
+            return {"enabled": False}
+        try:
+            self._roofline.tick()
+            return self._roofline.section()
+        except Exception as e:  # lint: broad-except-ok (a rate/ratio
             # read must never take the pipeline or a stats dump down —
             # same stance as every other plane section)
             return {"enabled": True, "error": f"{type(e).__name__}: "
@@ -1221,6 +1292,10 @@ class PipeGraph:
             # tenant-plane cross-reference: which tenant this graph's
             # spans bill to, and the process tenant roll-up at dump time
             "tenant": self._tenant_section(),
+            # calibration cross-reference: where every modeled constant
+            # behind the trace's derived numbers currently comes from
+            # (measured/modeled/calibrated provenance + store age)
+            "calibration": _calibration_summary(),
         })
         root, ext = os.path.splitext(path)
         base = root[:-len("_trace")] if root.endswith("_trace") else root
@@ -1308,6 +1383,12 @@ class PipeGraph:
             # the tenant advisor (analysis/tenancy.py, tools/
             # wf_tenant.py) and PR 20's tenant scheduler plan against
             "Tenant": self._tenant_section(),
+            # roofline plane (monitoring/calibration.RooflineLedger):
+            # per-hop achieved tup/s vs the calibrated bandwidth
+            # ceiling, with measured/modeled/calibrated provenance on
+            # every column and the latched ROOFLINE_DEGRADED verdict —
+            # docs/OBSERVABILITY.md "Calibration plane"
+            "Roofline": self._roofline_section(),
             "Gauges": self.gauges(),
             # health plane (monitoring/health.py): per-operator watchdog
             # verdicts, stall counters + attribution, verdict timeline
@@ -1451,6 +1532,8 @@ class PipeGraph:
         write("ir_audit.json", self._ir_audit_section)
         write("latency.json", self._latency_plane_section)
         write("tenant.json", self._tenant_section)
+        write("roofline.json", self._roofline_section)
+        write("calibration.json", _calibration_summary)
         write("durability.json", self._durability_section)
         write("reshard.json", self._reshard_section)
         write("preflight.json", lambda: {

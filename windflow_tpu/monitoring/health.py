@@ -51,6 +51,14 @@ from windflow_tpu.basic import current_time_usecs
 #: configured the state is unreachable and every transition matches the
 #: pre-SLO plane verbatim.
 OK = "OK"
+#: the roofline plane's advisory verdict (monitoring/calibration.py
+#: RooflineLedger): the dominant hop's achieved throughput collapsed
+#: against its own trailing baseline for ENTER_AFTER consecutive ticks.
+#: The lowest non-OK notch — purely advisory (nothing is failing, the
+#: pipeline just got slower than itself), so every harder state
+#: outranks it; with the plane off the state is unreachable and every
+#: transition matches the pre-roofline plane verbatim.
+ROOFLINE_DEGRADED = "ROOFLINE_DEGRADED"
 SLO_VIOLATED = "SLO_VIOLATED"
 #: the tenant plane's budget verdict (monitoring/tenant_ledger.py):
 #: the tenant this operator belongs to holds more resident device state
@@ -63,7 +71,8 @@ OVER_BUDGET = "OVER_BUDGET"
 BACKPRESSURED = "BACKPRESSURED"
 STALLED = "STALLED"
 FAILED = "FAILED"
-STATES = (OK, SLO_VIOLATED, OVER_BUDGET, BACKPRESSURED, STALLED, FAILED)
+STATES = (OK, ROOFLINE_DEGRADED, SLO_VIOLATED, OVER_BUDGET, BACKPRESSURED,
+          STALLED, FAILED)
 _SEVERITY = {s: i for i, s in enumerate(STATES)}
 
 #: postmortem bundle schema tag (tools/wf_doctor.py validates against it)
@@ -77,7 +86,7 @@ class _OpTrack:
     __slots__ = ("name", "state", "since_usec", "last_advance_usec",
                  "last_inputs", "last_frontier", "queue_depth", "frontier",
                  "compile_storm", "failure", "stall_latched", "hot_shard",
-                 "slo", "over_budget")
+                 "slo", "over_budget", "roofline")
 
     def __init__(self, name: str, now: int) -> None:
         self.name = name
@@ -107,6 +116,10 @@ class _OpTrack:
         #: op of a tenant in active budget overage
         #: (monitoring/tenant_ledger.py verdict)
         self.over_budget: Optional[dict] = None
+        #: roofline-ledger attribution when this operator is the
+        #: dominant hop of an active throughput-collapse verdict
+        #: (monitoring/calibration.RooflineLedger)
+        self.roofline: Optional[dict] = None
 
     def verdict(self, now: int) -> dict:
         v = {
@@ -124,6 +137,8 @@ class _OpTrack:
             v["slo"] = self.slo
         if self.over_budget is not None:
             v["over_budget"] = self.over_budget
+        if self.roofline is not None:
+            v["roofline"] = self.roofline
         return v
 
 
@@ -169,6 +184,12 @@ class HealthPlane:
         #: the kill-switch contract, micro-asserted by
         #: tests/test_tenant_plane.py)
         self.tenant = None
+        #: roofline ledger (monitoring/calibration.RooflineLedger),
+        #: bound by PipeGraph._build when Config.roofline_plane is on;
+        #: its active collapse verdict turns the dominant hop's OK into
+        #: the advisory ROOFLINE_DEGRADED (None = one attribute check
+        #: per sample, micro-asserted by tests/test_calibration.py)
+        self.roofline = None
         #: the jit registry is process-global and never resets: baseline
         #: its per-op recompile counts now so a storm verdict reflects
         #: THIS graph's run, not a prior graph sharing operator names
@@ -195,6 +216,10 @@ class HealthPlane:
         # heaviest op — only that graph paints the verdict)
         ten = self.tenant
         ob_v = ten.health_verdict() if ten is not None else None
+        # and the roofline ledger's collapse verdict — same plain-read
+        # stance (the ledger ticks on the same monitor thread)
+        rfl = self.roofline
+        rf_v = rfl.health_verdict() if rfl is not None else None
         with self._lock:
             changes = {}
             for op in self.graph._operators:
@@ -203,7 +228,7 @@ class HealthPlane:
                     track = self._tracks[op.name] = _OpTrack(op.name, now)
                 state = self._evaluate_op(op, track, now,
                                           storms.get(op.name, False),
-                                          slo_v, ob_v)
+                                          slo_v, ob_v, rf_v)
                 if state != track.state:
                     track.state = state
                     track.since_usec = now
@@ -238,7 +263,8 @@ class HealthPlane:
 
     def _evaluate_op(self, op, track: _OpTrack, now: int,
                      storm: bool, slo_v: Optional[dict] = None,
-                     ob_v: Optional[dict] = None) -> str:
+                     ob_v: Optional[dict] = None,
+                     rf_v: Optional[dict] = None) -> str:
         # the queue-depth/min-frontier walk is the graph's (shared with
         # gauges(): the watchdog must judge exactly what the lag gauge
         # reports, or the two drift)
@@ -260,6 +286,7 @@ class HealthPlane:
         track.compile_storm = storm
         track.slo = None   # re-attached below only while the violation holds
         track.over_budget = None   # ditto for the budget verdict
+        track.roofline = None      # ditto for the roofline collapse
         # hot-shard attribution: the replica holding the deepest backlog
         # (ties broken by the most-lagged frontier) — per-replica reads
         # only, so it works with the shard ledger off too; the ledger's
@@ -291,6 +318,11 @@ class HealthPlane:
             # postmortem readers (the ledger stops ticking with the
             # graph, so the latch is the final word)
             state = OK
+            if rf_v is not None and rf_v.get("dominant_op") == op.name:
+                # advisory and lowest-severity: attached first so a
+                # latched SLO/budget verdict takes the state slot
+                track.roofline = rf_v
+                state = ROOFLINE_DEGRADED
             if slo_v is not None and slo_v.get("dominant_op") == op.name:
                 track.slo = slo_v
                 state = SLO_VIOLATED
@@ -318,6 +350,14 @@ class HealthPlane:
         # dominant operator carries the state, so one slow op does not
         # paint the whole graph red
         state = OK
+        # roofline check FIRST among the verdict upgrades: advisory and
+        # lowest-severity, so an SLO/budget verdict on the same operator
+        # overwrites the state slot (the attribution stays in
+        # track.roofline regardless), and only the collapse verdict's
+        # dominant hop carries the state
+        if rf_v is not None and rf_v.get("dominant_op") == op.name:
+            track.roofline = rf_v
+            state = ROOFLINE_DEGRADED
         if slo_v is not None and slo_v.get("dominant_op") == op.name:
             track.slo = slo_v
             state = SLO_VIOLATED

@@ -234,8 +234,9 @@ def _families(stats: dict,
                        "the active state)")
         for name, v in (health.get("verdicts") or {}).items():
             active = str(v.get("state", "")).lower()
-            for state in ("ok", "slo_violated", "over_budget",
-                          "backpressured", "stalled", "failed"):
+            for state in ("ok", "roofline_degraded", "slo_violated",
+                          "over_budget", "backpressured", "stalled",
+                          "failed"):
                 f_health.add(1 if active == state else 0,
                              dict(base, operator=name, state=state))
         fam("wf_stall_events_total", "counter",
@@ -269,7 +270,11 @@ def _families(stats: dict,
             if isinstance(h.get("dispatches_per_batch"), (int, float)):
                 f_sd.add(h["dispatches_per_batch"], lab)
             if isinstance(h.get("bytes_per_tuple"), (int, float)):
-                f_sb.add(h["bytes_per_tuple"], lab)
+                # cost-table attribution, never a byte counter — the
+                # provenance label says so on the wire (calibration.py)
+                f_sb.add(h["bytes_per_tuple"],
+                         dict(lab, provenance=h.get("bytes_provenance",
+                                                    "modeled")))
             if isinstance(h.get("excess_vs_model"), (int, float)):
                 f_sx.add(h["excess_vs_model"], lab)
             miss = (h.get("donation_miss") or {}).get("bytes_per_batch")
@@ -354,7 +359,11 @@ def _families(stats: dict,
                 f_shh.add(load["hot_key_share"], lab)
             ici = entry.get("ici") or {}
             if isinstance(ici.get("ici_bytes_per_tuple"), (int, float)):
-                f_ici.add(ici["ici_bytes_per_tuple"], lab)
+                # structural collective model — labeled so a dashboard
+                # can never mistake it for a measured counter
+                f_ici.add(ici["ici_bytes_per_tuple"],
+                          dict(lab, provenance=ici.get("provenance",
+                                                       "modeled")))
 
     # -- durability plane ----------------------------------------------------
     dur = stats.get("Durability") or {}
@@ -519,7 +528,12 @@ def _families(stats: dict,
             f_th2d.add(agg.get("h2d_bytes", 0), lab)
             f_td2h.add(agg.get("d2h_bytes", 0), lab)
             if isinstance(agg.get("ici_bytes_per_tuple"), (int, float)):
-                f_tici.add(agg["ici_bytes_per_tuple"], lab)
+                # summed shard-plane model per tenant — same provenance
+                # labeling stance as wf_shard_ici_bytes_per_tuple
+                f_tici.add(agg["ici_bytes_per_tuple"],
+                           dict(lab,
+                                provenance=agg.get("ici_provenance")
+                                or "modeled"))
             if isinstance(agg.get("latency_share"), (int, float)):
                 f_tlat.add(agg["latency_share"], lab)
             budget = agg.get("budget") or {}
@@ -535,6 +549,50 @@ def _families(stats: dict,
                 "Tenants' attributed staged bytes over the process "
                 "staged-transfer total (the CI reconciliation gate)") \
                 .add(attributed["staged_fraction"], base)
+
+    # -- roofline plane + calibration provenance -----------------------------
+    # live achieved-vs-roofline gauge (monitoring/calibration.
+    # RooflineLedger) plus the info family naming where every modeled
+    # constant currently comes from — measured/modeled/calibrated(age)
+    roofline = stats.get("Roofline") or {}
+    if roofline.get("enabled"):
+        f_rtps = fam("wf_roofline_achieved_tuples_per_sec", "gauge",
+                     "Per-hop achieved throughput at monitor cadence "
+                     "(measured: deltas over replica counters)")
+        f_rbpt = fam("wf_roofline_bytes_per_tuple", "gauge",
+                     "Per-hop bytes/tuple the roofline ratio uses "
+                     "(sweep ledger cost tables; see provenance label)")
+        f_rrat = fam("wf_roofline_ratio_vs_roofline", "gauge",
+                     "Achieved bytes/sec over the calibrated bandwidth "
+                     "ceiling (1.0 = at the roofline)")
+        for name, hop in (roofline.get("per_hop") or {}).items():
+            lab = dict(base, operator=name)
+            if isinstance(hop.get("achieved_tuples_per_sec"),
+                          (int, float)):
+                f_rtps.add(hop["achieved_tuples_per_sec"], lab)
+            if isinstance(hop.get("bytes_per_tuple"), (int, float)):
+                f_rbpt.add(hop["bytes_per_tuple"],
+                           dict(lab, provenance=hop.get(
+                               "bytes_per_tuple_provenance", "modeled")))
+            if isinstance(hop.get("ratio_vs_roofline"), (int, float)):
+                f_rrat.add(hop["ratio_vs_roofline"], lab)
+        fam("wf_roofline_degraded", "gauge",
+            "1 while the latched ROOFLINE_DEGRADED advisory verdict "
+            "holds (dominant hop collapsed vs its trailing baseline)") \
+            .add(1 if roofline.get("verdict") else 0, base)
+        calib = roofline.get("calibration") or {}
+        consts = calib.get("constants") or {}
+        if consts:
+            # info-style family (value 1): one sample per modeled
+            # constant with its current provenance as a label — the
+            # queryable "is this number measured?" surface
+            f_prov = fam("wf_provenance", "gauge",
+                         "Provenance of each modeled constant (info "
+                         "family: 1 per constant, see labels)")
+            for key, slot in sorted(consts.items()):
+                if isinstance(slot, dict) and slot.get("provenance"):
+                    f_prov.add(1, dict(base, constant=key,
+                                       provenance=slot["provenance"]))
 
     # -- device plane --------------------------------------------------------
     device = stats.get("Device") or {}

@@ -758,6 +758,13 @@ class ShardLedger:
             # devices receives the cap*(dd-1)/dd lanes it lacks
             total = kk * cap * bpt * (dd - 1)
             kind = "all_gather(data)"
+        # the TIME half divides by the link bandwidth — a probe-measured
+        # value while a fresh calibration store covers it (provenance
+        # `calibrated(<age>)`), the nominal WF_TPU_ICI_BYTES_PER_SEC
+        # default otherwise (`modeled`)
+        from windflow_tpu.monitoring import calibration
+        ici_bps, ici_prov = calibration.constant("ici_bytes_per_sec",
+                                                 ICI_BYTES_PER_SEC)
         return {
             "collective": kind,
             "mesh": {"data": dd, "key": kk},
@@ -765,10 +772,14 @@ class ShardLedger:
             "ici_bytes_per_tuple": round(total / cap, 2),
             # the TIME half of the model: per-dispatch collective bytes
             # over the fabric, serialized through each chip's share at
-            # the nominal link bandwidth (WF_TPU_ICI_BYTES_PER_SEC)
+            # the calibrated-or-nominal link bandwidth
             "ici_usec_per_dispatch": round(
-                (total / n) / ICI_BYTES_PER_SEC * 1e6, 3),
-            "ici_bandwidth_assumed_bps": ICI_BYTES_PER_SEC,
+                (total / n) / ici_bps * 1e6, 3),
+            "ici_bandwidth_assumed_bps": ici_bps,
+            "ici_bandwidth_provenance": ici_prov,
+            # the BYTES half is always structural — the collective shape
+            # is derived, never measured on CPU
+            "provenance": calibration.MODELED,
             "model": "structural (XLA cost tables carry no collective "
                      "terms; see docs/OBSERVABILITY.md shard plane)",
         }
@@ -795,6 +806,7 @@ class ShardLedger:
         worst = (0.0, None)     # (imbalance ratio, op name)
         hot = (0.0, None)       # (hot key share, op name)
         ici_bpt_total = 0.0
+        ici_time_prov = None    # provenance of the ICI TIME model
         sketch_usec = 0.0
         for op in g._operators:
             ba = _steady_cost_bytes(op) if op.is_tpu else None
@@ -871,7 +883,9 @@ class ShardLedger:
                 # per key-shard slice of the collective volume (each
                 # shard participates symmetrically in the gather/psum)
                 ici_bpt_total += ici["ici_bytes_per_tuple"]
+                ici_time_prov = ici["ici_bandwidth_provenance"]
             per_op[op.name] = entry
+        from windflow_tpu.monitoring import calibration
         return {
             "enabled": True,
             "per_op": per_op,
@@ -882,6 +896,10 @@ class ShardLedger:
                 "hot_key_share": round(hot[0], 4) if hot[1] else None,
                 "hot_key_op": hot[1],
                 "ici_bytes_per_tuple": round(ici_bpt_total, 2),
+                # the collective-shape bytes are structural everywhere;
+                # the time column inherits the bandwidth's provenance
+                "ici_provenance": calibration.MODELED,
+                "ici_time_provenance": ici_time_prov,
                 "sketch_host_update_usec": round(sketch_usec, 1),
                 "keyed_edges_sketched": len(self._sketches),
             },

@@ -249,6 +249,10 @@ class SweepLedger:
                 hop["bytes_per_batch"] = round(bpb, 1)
                 hop["bytes_per_tuple"] = round(bpb / cap, 2) if cap \
                     else None
+                # XLA cost-table estimates, not byte counters — tagged
+                # so downstream joins (roofline, tenant) name their
+                # basis (monitoring/calibration.py vocabulary)
+                hop["bytes_provenance"] = "modeled"
                 if primary_ba is not None and cap:
                     # steady-state number: a short run's EOS flush or
                     # other one-shot programs dilute the amortized
@@ -354,6 +358,8 @@ class SweepLedger:
             "logical_bytes": logical_h2d,
             "compression_ratio": round(logical_h2d / wire_h2d, 4)
             if wire_h2d else None,
+            # real byte counters on the staged path, not a model
+            "bytes_provenance": "measured",
         }
         return {
             "enabled": True,
@@ -369,6 +375,9 @@ class SweepLedger:
             },
             "totals": {
                 "bytes_per_tuple": round(tot_bpt, 2),
+                # the hop bytes are cost-table attributions (modeled);
+                # the wire bytes above are real counters (measured)
+                "bytes_provenance": "modeled",
                 "dispatches_per_batch": round(tot_dpb, 3),
                 "donation_miss_bytes_per_batch": round(tot_miss, 1),
                 "dispatches": tot_disp,

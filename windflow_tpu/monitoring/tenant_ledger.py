@@ -287,8 +287,12 @@ class _GraphEntry:
         # plane) — both optional planes, both read guarded
         try:
             if g._shard is not None:
-                row["ici_bytes_per_tuple"] = (
-                    g._shard.section()["totals"]["ici_bytes_per_tuple"])
+                totals = g._shard.section()["totals"]
+                row["ici_bytes_per_tuple"] = totals["ici_bytes_per_tuple"]
+                # the shard plane's collective model, never a counter —
+                # carried so tenant aggregation stays honest about it
+                row["ici_provenance"] = totals.get("ici_provenance",
+                                                   "modeled")
         except Exception:  # lint: broad-except-ok (optional plane)
             pass
         try:
@@ -488,6 +492,13 @@ class TenantLedger:
                 "ici_bytes_per_tuple": round(
                     sum(r.get("ici_bytes_per_tuple", 0.0)
                         for r in trows), 2),
+                # the summed ICI column is the shard plane's structural
+                # model in every contributing graph (calibration.py
+                # vocabulary; the time column's bandwidth may still be
+                # calibrated — see stats()["Shard"] totals)
+                "ici_provenance": next(
+                    (r["ici_provenance"] for r in trows
+                     if "ici_provenance" in r), None),
                 "latency_usec_total": round(
                     sum(r.get("latency_usec_total", 0.0)
                         for r in trows), 3),

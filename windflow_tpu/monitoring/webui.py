@@ -245,7 +245,12 @@ async function render(id) {
       `(${((h.share || 0) * 100).toFixed(1)}%)`).join(", ");
     const imb = load.imbalance_ratio != null
       ? ` imbalance=${load.imbalance_ratio}` : "";
+    // calibration provenance (monitoring/calibration.py): the ICI
+    // column is the shard plane's structural model, never a counter —
+    // marked "~" with the provenance in the hover title so a modeled
+    // number can never read as ground truth
     const ici = (sh.ici || {}).ici_bytes_per_tuple;
+    const iciProv = (sh.ici || {}).ici_bandwidth_provenance || "modeled";
     const open = (window._openShards || new Set()).has(i);
     return `<tr id="shard_${i}" style="display:${open ? "" : "none"}">` +
            `<td colspan="14">` +
@@ -254,7 +259,9 @@ async function render(id) {
            `<th>HBM B</th></tr>${rows}</table>` +
            `<small>${load.basis ? `load basis=${esc(load.basis)}` : ""}` +
            `${imb}${hot ? ` hot keys: ${hot}` : ""}` +
-           `${ici != null ? ` ICI=${ici} B/tuple` : ""}</small>` +
+           `${ici != null ? ` <span title="provenance: modeled ` +
+             `(structural collective model; bandwidth ${esc(iciProv)})">` +
+             `ICI≈${ici} B/tuple</span>` : ""}</small>` +
            `</td></tr>`;
   };
   window._openShards = window._openShards || new Set();
@@ -290,8 +297,12 @@ async function render(id) {
         : "–";
       const hop = sweepHops[name] || {};
       const don = hop.donation_miss ? " <b>!don</b>" : "";
+      // "~" marks a modeled cell (XLA cost-table attribution, not a
+      // byte counter) — hover for the provenance tag (calibration.py)
       const bpt = hop.bytes_per_tuple == null ? "–"
-        : `${hop.bytes_per_tuple}${don}`;
+        : `<span title="provenance: ` +
+          `${esc(hop.bytes_provenance || "modeled")} ` +
+          `(XLA cost-table estimate)">~${hop.bytes_per_tuple}</span>${don}`;
       // whole-chain fusion: a member hop dispatches nothing — its
       // program folded into the fused host hop it names here
       const dpb = hop.fused_into
